@@ -1,0 +1,48 @@
+"""Test harness config: force a virtual 8-device CPU platform BEFORE
+jax initializes, so sharding/DP tests run anywhere (the driver runs the
+real-TPU path separately via bench.py / __graft_entry__.py)."""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The container's sitecustomize imports jax at interpreter start (TPU
+# tunnel plugin), freezing env-derived config before we run — override
+# through jax.config instead of the environment.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from znicz_tpu.utils import prng  # noqa: E402
+from znicz_tpu.utils.config import reset_root  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def fresh_state():
+    """Deterministic seed + pristine config tree per test."""
+    reset_root()
+    prng.seed_all(1234)
+    yield
+
+
+def make_blobs(n_per_class: int, n_classes: int, dim: int,
+               spread: float = 0.35, seed: int = 7):
+    """Synthetic gaussian-blob classification data (datasets are not
+    downloadable in this environment; functional tests use these the
+    way the reference used Wine — a fast, surely-learnable problem)."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0.0, 1.0, size=(n_classes, dim))
+    data = np.concatenate([
+        centers[c] + spread * rng.normal(size=(n_per_class, dim))
+        for c in range(n_classes)]).astype(np.float32)
+    labels = np.repeat(np.arange(n_classes), n_per_class).astype(np.int32)
+    order = rng.permutation(len(data))
+    return data[order], labels[order]

@@ -1,0 +1,92 @@
+"""Per-op correctness for the fully-connected family: numpy oracle vs
+XLA path agreement (the reference's cross-backend test pattern,
+SURVEY.md §4: ``znicz/tests/unit/test_all2all.py``)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops import all2all
+
+
+def build_unit(cls, device, x, n_out=5, **kwargs):
+    wf = DummyWorkflow()
+    source = DummyUnit(wf, output=Vector(np.asarray(x), name="x"))
+    unit = cls(wf, n_out, **kwargs)
+    unit.link_attrs(source, ("input", "output"))
+    unit.initialize(device=device)
+    return unit
+
+
+def run_both(cls, x, n_out=5, **kwargs):
+    """Run the same unit math on both backends with identical weights."""
+    np_unit = build_unit(cls, NumpyDevice(), x, n_out, **kwargs)
+    xla_unit = build_unit(cls, XLADevice(), x, n_out, **kwargs)
+    # same parameters on both
+    xla_unit.weights.reset(np_unit.weights.mem.copy())
+    if xla_unit.include_bias:
+        xla_unit.bias.reset(np_unit.bias.mem.copy())
+        xla_unit.bias.initialize(xla_unit.device)
+    xla_unit.weights.initialize(xla_unit.device)
+    np_unit.run()
+    xla_unit.run()
+    np_unit.output.map_read()
+    xla_unit.output.map_read()
+    return np_unit, xla_unit
+
+
+X = np.random.default_rng(3).normal(size=(16, 12)).astype(np.float32)
+
+
+@pytest.mark.parametrize("cls", [
+    all2all.All2All, all2all.All2AllTanh, all2all.All2AllRELU,
+    all2all.All2AllStrictRELU, all2all.All2AllSigmoid])
+def test_numpy_xla_agreement(cls):
+    np_unit, xla_unit = run_both(cls, X)
+    np.testing.assert_allclose(np_unit.output.mem, xla_unit.output.mem,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_linear_golden():
+    """Hand-checkable case: identity-ish weights."""
+    wf = DummyWorkflow()
+    x = np.array([[1.0, 2.0], [3.0, 4.0]], dtype=np.float32)
+    source = DummyUnit(wf, output=Vector(x, name="x"))
+    unit = all2all.All2All(wf, 2)
+    unit.link_attrs(source, ("input", "output"))
+    unit.initialize(device=NumpyDevice())
+    unit.weights.reset(np.eye(2, dtype=np.float32))
+    unit.bias.reset(np.array([10.0, 20.0], dtype=np.float32))
+    unit.run()
+    unit.output.map_read()
+    np.testing.assert_allclose(unit.output.mem,
+                               [[11.0, 22.0], [13.0, 24.0]])
+
+
+def test_multidim_input_flattened():
+    x = np.random.default_rng(0).normal(size=(4, 3, 2, 2)).astype(np.float32)
+    np_unit, xla_unit = run_both(all2all.All2AllTanh, x, n_out=7)
+    assert np_unit.output.shape == (4, 7)
+    np.testing.assert_allclose(np_unit.output.mem, xla_unit.output.mem,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_outputs_and_argmax():
+    np_unit, xla_unit = run_both(all2all.All2AllSoftmax, X, n_out=5)
+    np.testing.assert_allclose(np_unit.output.mem, xla_unit.output.mem,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np_unit.output.mem.sum(axis=1), 1.0,
+                               rtol=1e-5)
+    xla_unit.max_idx.map_read()
+    np.testing.assert_array_equal(np_unit.max_idx.mem, xla_unit.max_idx.mem)
+    np.testing.assert_array_equal(np_unit.max_idx.mem,
+                                  np.argmax(np_unit.output.mem, axis=1))
+
+
+def test_output_sample_shape_tuple():
+    np_unit = build_unit(all2all.All2All, NumpyDevice(), X, (3, 4))
+    np_unit.run()
+    assert np_unit.output.shape == (16, 3, 4)
+    assert np_unit.weights.shape == (12, 12)

@@ -1,0 +1,157 @@
+"""Backward-unit correctness: numpy↔XLA agreement plus numeric
+gradient checks against the forward oracle (reference pattern:
+``znicz/tests/unit/test_gd.py``)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops import all2all, gd
+
+PAIRS = [
+    (all2all.All2All, gd.GradientDescent),
+    (all2all.All2AllTanh, gd.GDTanh),
+    (all2all.All2AllRELU, gd.GDRELU),
+    (all2all.All2AllStrictRELU, gd.GDStrictRELU),
+    (all2all.All2AllSigmoid, gd.GDSigmoid),
+]
+
+RNG = np.random.default_rng(11)
+X = RNG.normal(size=(8, 6)).astype(np.float32)
+ERR = RNG.normal(size=(8, 4)).astype(np.float32)
+LR = 0.05
+
+
+def build_pair(fwd_cls, gd_cls, device, gd_kwargs=None):
+    wf = DummyWorkflow()
+    source = DummyUnit(wf, output=Vector(X.copy(), name="x"))
+    fwd = fwd_cls(wf, 4)
+    fwd.link_attrs(source, ("input", "output"))
+    fwd.initialize(device=device)
+    err_source = DummyUnit(wf, err=Vector(ERR.copy(), name="err"))
+    bwd = gd_cls(wf, learning_rate=LR, **(gd_kwargs or {}))
+    bwd.link_attrs(fwd, "input", "output", "weights", "bias")
+    bwd.link_attrs(err_source, ("err_output", "err"))
+    bwd.initialize(device=device)
+    return fwd, bwd
+
+
+@pytest.mark.parametrize("fwd_cls,gd_cls", PAIRS)
+def test_numpy_xla_agreement(fwd_cls, gd_cls):
+    results = {}
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        fwd, bwd = build_pair(fwd_cls, gd_cls, device)
+        if name == "xla":
+            fwd.weights.reset(results["np_w0"])
+            fwd.weights.initialize(device)
+            fwd.bias.reset(results["np_b0"])
+            fwd.bias.initialize(device)
+        else:
+            results["np_w0"] = fwd.weights.mem.copy()
+            results["np_b0"] = fwd.bias.mem.copy()
+        fwd.run()
+        bwd.run()
+        for vec in (bwd.err_input, bwd.weights, bwd.bias):
+            vec.map_read()
+        results[f"{name}_err_input"] = bwd.err_input.mem.copy()
+        results[f"{name}_w"] = bwd.weights.mem.copy()
+        results[f"{name}_b"] = bwd.bias.mem.copy()
+    for key in ("err_input", "w", "b"):
+        np.testing.assert_allclose(
+            results[f"np_{key}"], results[f"xla_{key}"],
+            rtol=1e-4, atol=1e-5, err_msg=key)
+
+
+@pytest.mark.parametrize("fwd_cls,gd_cls", PAIRS)
+def test_numeric_gradient(fwd_cls, gd_cls):
+    """L = Σ err ⊙ act(xW+b): the gd unit's implicit dL/dW (recovered
+    from the update) must match central finite differences."""
+    device = NumpyDevice()
+    fwd, bwd = build_pair(fwd_cls, gd_cls, device)
+    w0 = fwd.weights.mem.copy()
+    b0 = fwd.bias.mem.copy()
+    fwd.run()
+    bwd.run()
+    grad_w = (w0 - bwd.weights.mem) / LR
+    grad_b = (b0 - bwd.bias.mem) / LR
+    err_input = bwd.err_input.mem.copy()
+
+    def loss(w, b, x):
+        wf = DummyWorkflow()
+        src = DummyUnit(wf, output=Vector(x, name="x"))
+        f = fwd_cls(wf, 4)
+        f.link_attrs(src, ("input", "output"))
+        f.initialize(device=device)
+        f.weights.reset(w.copy())
+        f.bias.reset(b.copy())
+        f.run()
+        return float(np.sum(ERR * f.output.mem))
+
+    eps = 1e-3
+    rng = np.random.default_rng(5)
+    for _ in range(4):  # spot-check weight gradient entries
+        i, j = rng.integers(w0.shape[0]), rng.integers(w0.shape[1])
+        wp, wm = w0.copy(), w0.copy()
+        wp[i, j] += eps
+        wm[i, j] -= eps
+        numeric = (loss(wp, b0, X) - loss(wm, b0, X)) / (2 * eps)
+        np.testing.assert_allclose(grad_w[i, j], numeric,
+                                   rtol=2e-2, atol=1e-3)
+    for _ in range(2):  # bias gradient
+        j = rng.integers(b0.shape[0])
+        bp, bm = b0.copy(), b0.copy()
+        bp[j] += eps
+        bm[j] -= eps
+        numeric = (loss(w0, bp, X) - loss(w0, bm, X)) / (2 * eps)
+        np.testing.assert_allclose(grad_b[j], numeric, rtol=2e-2, atol=1e-3)
+    for _ in range(4):  # err_input = dL/dx
+        i, j = rng.integers(X.shape[0]), rng.integers(X.shape[1])
+        xp_, xm_ = X.copy(), X.copy()
+        xp_[i, j] += eps
+        xm_[i, j] -= eps
+        numeric = (loss(w0, b0, xp_) - loss(w0, b0, xm_)) / (2 * eps)
+        np.testing.assert_allclose(err_input[i, j], numeric,
+                                   rtol=2e-2, atol=1e-3)
+
+
+def test_momentum_and_decay_update():
+    """Momentum + L2 decay follow the documented update rule."""
+    device = NumpyDevice()
+    fwd, bwd = build_pair(all2all.All2All, gd.GradientDescent, device,
+                          gd_kwargs=dict(gradient_moment=0.9,
+                                         weights_decay=0.01))
+    w0 = fwd.weights.mem.copy()
+    fwd.run()
+    x2d = X.reshape(8, -1)
+    grad = x2d.T @ ERR + 0.01 * w0
+    bwd.run()
+    expected_acc = -LR * grad
+    np.testing.assert_allclose(bwd.weights.mem, w0 + expected_acc,
+                               rtol=1e-5, atol=1e-6)
+    # second step accumulates momentum
+    fwd.run()
+    w1 = bwd.weights.mem.copy()
+    grad1 = x2d.T @ ERR + 0.01 * w1
+    bwd.run()
+    np.testing.assert_allclose(
+        bwd.weights.mem, w1 + (0.9 * expected_acc - LR * grad1),
+        rtol=1e-5, atol=1e-6)
+
+
+def test_need_err_input_false_skips_allocation():
+    device = NumpyDevice()
+    wf = DummyWorkflow()
+    source = DummyUnit(wf, output=Vector(X.copy(), name="x"))
+    fwd = all2all.All2All(wf, 4)
+    fwd.link_attrs(source, ("input", "output"))
+    fwd.initialize(device=device)
+    err_source = DummyUnit(wf, err=Vector(ERR.copy(), name="err"))
+    bwd = gd.GradientDescent(wf, learning_rate=LR, need_err_input=False)
+    bwd.link_attrs(fwd, "input", "output", "weights", "bias")
+    bwd.link_attrs(err_source, ("err_output", "err"))
+    bwd.initialize(device=device)
+    fwd.run()
+    bwd.run()
+    assert not bwd.err_input

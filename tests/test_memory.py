@@ -1,0 +1,88 @@
+"""Vector map/unmap state machine (reference test analogue:
+``veles/tests/test_memory.py``)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.memory import Vector
+
+
+def test_empty_vector_falsy():
+    v = Vector(name="v")
+    assert not v
+    with pytest.raises(ValueError):
+        v.map_read()
+    with pytest.raises(ValueError):
+        v.unmap()
+
+
+def test_host_roundtrip_numpy_device():
+    v = Vector(np.arange(6, dtype=np.float32).reshape(2, 3), name="v")
+    v.initialize(NumpyDevice())
+    v.map_read()
+    assert v.mem.sum() == 15
+    v.unmap()  # no-op on host-only backend
+    assert v.mem.sum() == 15
+
+
+def test_xla_upload_download():
+    dev = XLADevice()
+    v = Vector(np.arange(4, dtype=np.float32), name="v")
+    v.initialize(dev)
+    v.unmap()
+    assert v.state_name == "DEVICE"
+    # device access fine, host access must be guarded
+    assert v.devmem.shape == (4,)
+    with pytest.raises(ValueError):
+        _ = v.mem
+    v.map_read()
+    np.testing.assert_array_equal(v.mem, [0, 1, 2, 3])
+
+
+def test_host_write_uploads_on_unmap():
+    dev = XLADevice()
+    v = Vector(np.zeros(3, dtype=np.float32), name="v")
+    v.initialize(dev)
+    v.unmap()
+    v.map_write()
+    v.mem[...] = 7
+    v.unmap()
+    np.testing.assert_array_equal(np.asarray(v.devmem), [7, 7, 7])
+
+
+def test_map_invalidate_skips_fetch():
+    dev = XLADevice()
+    v = Vector(np.zeros(3, dtype=np.float32), name="v")
+    v.initialize(dev)
+    v.unmap()
+    v.map_invalidate()
+    v.mem[...] = 5
+    v.unmap()
+    np.testing.assert_array_equal(np.asarray(v.devmem), [5, 5, 5])
+
+
+def test_device_access_while_host_dirty_raises():
+    dev = XLADevice()
+    v = Vector(np.zeros(3, dtype=np.float32), name="v")
+    v.initialize(dev)
+    v.unmap()
+    v.map_write()
+    with pytest.raises(ValueError, match="unmap"):
+        _ = v.devmem
+
+
+def test_tracing_guards():
+    v = Vector(np.zeros(3, dtype=np.float32), name="v")
+    v._tracing = True
+    with pytest.raises(RuntimeError, match="jit region"):
+        v.map_read()
+    with pytest.raises(RuntimeError, match="jit region"):
+        v.unmap()
+
+
+def test_sample_size_and_len():
+    v = Vector(np.zeros((8, 3, 2), dtype=np.float32), name="v")
+    assert len(v) == 8
+    assert v.sample_size == 6
+    assert v.size == 48

@@ -1,0 +1,62 @@
+import pytest
+
+from znicz_tpu.mutable import Bool, LinkableAttribute
+
+
+def test_bool_basic():
+    b = Bool(False)
+    assert not b
+    b << True
+    assert b
+    b.value = False
+    assert not b
+
+
+def test_bool_derived_views_are_live():
+    a = Bool(False)
+    b = Bool(True)
+    inv = ~a
+    conj = a & b
+    disj = a | b
+    assert inv and not conj and disj
+    a << True
+    assert not inv and conj and disj
+
+
+def test_bool_derived_is_readonly():
+    a = Bool(False)
+    inv = ~a
+    with pytest.raises(ValueError):
+        inv.value = True
+
+
+def test_bool_on_true_callbacks():
+    a = Bool(False)
+    fired = []
+    a.on_true.append(lambda: fired.append(1))
+    a << True
+    a << True  # no re-fire while already True
+    a << False
+    a << True
+    assert fired == [1, 1]
+
+
+def test_linkable_attribute_two_way():
+    class Obj:
+        pass
+    src = Obj()
+    src.output = 41
+    link = LinkableAttribute(src, "output")
+    assert link.get() == 41
+    link.set(42)
+    assert src.output == 42
+
+
+def test_linkable_attribute_one_way():
+    class Obj:
+        pass
+    src = Obj()
+    src.output = 1
+    link = LinkableAttribute(src, "output", two_way=False)
+    with pytest.raises(AttributeError):
+        link.set(2)

@@ -1,0 +1,159 @@
+"""Link/gate semantics of the dataflow core (reference test analogue:
+``veles/tests/test_units.py`` / ``test_workflow.py``)."""
+
+import pytest
+
+from znicz_tpu.mutable import Bool
+from znicz_tpu.units import Repeater, Unit
+from znicz_tpu.workflow import Workflow
+
+
+class Tracer(Unit):
+    """Records firing order into its workflow's `trace` list."""
+
+    def run(self):
+        self.workflow.trace.append(self.name)
+
+
+def make_wf():
+    wf = Workflow(name="test")
+    wf.trace = []
+    return wf
+
+
+def test_linear_chain_order():
+    wf = make_wf()
+    a, b, c = (Tracer(wf, name=n) for n in "abc")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(b)
+    wf.end_point.link_from(c)
+    wf.initialize()
+    wf.run()
+    assert wf.trace == ["a", "b", "c"]
+
+
+def test_diamond_join_waits_for_all():
+    wf = make_wf()
+    a, b, c, d = (Tracer(wf, name=n) for n in "abcd")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(a)
+    d.link_from(b, c)  # must wait for BOTH
+    wf.end_point.link_from(d)
+    wf.initialize()
+    wf.run()
+    assert wf.trace.index("d") == 3
+    assert set(wf.trace[1:3]) == {"b", "c"}
+
+
+def test_gate_skip_propagates_without_running():
+    wf = make_wf()
+    a, b, c = (Tracer(wf, name=n) for n in "abc")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(b)
+    wf.end_point.link_from(c)
+    b.gate_skip << True
+    wf.initialize()
+    wf.run()
+    assert wf.trace == ["a", "c"]
+
+
+def test_gate_block_stops_flow():
+    wf = make_wf()
+    a, b, c = (Tracer(wf, name=n) for n in "abc")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(b)
+    wf.end_point.link_from(c)
+    b.gate_block << True
+    wf.initialize()
+    wf.run()  # flow dies at b; end never fires, queue drains
+    assert wf.trace == ["a"]
+
+
+def test_repeater_loop_with_derived_gate():
+    """The canonical training loop: repeater → body → decision-ish
+    counter that completes after N iterations."""
+    wf = make_wf()
+    rep = Repeater(wf, name="rep")
+    complete = Bool(False)
+
+    class Body(Tracer):
+        def run(self):
+            super().run()
+            if len(self.workflow.trace) >= 5:
+                complete << True
+
+    body = Body(wf, name="body")
+    rep.link_from(wf.start_point)
+    body.link_from(rep)
+    rep.link_from(body)
+    rep.gate_block = complete
+    wf.end_point.link_from(body)
+    wf.end_point.gate_block = ~complete
+    wf.initialize()
+    wf._max_fires = 100
+    wf.run()
+    assert wf.trace == ["body"] * 5
+
+
+def test_link_attrs_aliasing():
+    wf = make_wf()
+    a = Tracer(wf, name="a")
+    b = Tracer(wf, name="b")
+    a.output = 10
+    b.link_attrs(a, ("input", "output"))
+    assert b.input == 10
+    a.output = 20
+    assert b.input == 20
+    b.input = 30  # two-way: writes through
+    assert a.output == 30
+
+
+def test_initialize_defers_on_attribute_error():
+    wf = make_wf()
+
+    class Producer(Unit):
+        def initialize(self, **kwargs):
+            self.payload = 99
+
+    class Consumer(Unit):
+        def initialize(self, **kwargs):
+            _ = self.source.payload  # AttributeError until producer init
+            self.got = self.source.payload
+
+    consumer = Consumer(wf, name="consumer")  # added FIRST
+    producer = Producer(wf, name="producer")
+    consumer.source = producer
+    wf.initialize()
+    assert consumer.got == 99
+
+
+def test_initialize_deadlock_detection():
+    wf = make_wf()
+
+    class Stuck(Unit):
+        def initialize(self, **kwargs):
+            raise AttributeError("never ready")
+
+    Stuck(wf, name="stuck")
+    with pytest.raises(RuntimeError, match="deadlock"):
+        wf.initialize()
+
+
+def test_unique_unit_names():
+    wf = make_wf()
+    a1 = Tracer(wf, name="x")
+    a2 = Tracer(wf, name="x")
+    assert a1.name != a2.name
+
+
+def test_generate_graph_dot():
+    wf = make_wf()
+    a = Tracer(wf, name="a")
+    a.link_from(wf.start_point)
+    wf.end_point.link_from(a)
+    dot = wf.generate_graph()
+    assert dot.startswith("digraph") and "->" in dot

@@ -1,0 +1,45 @@
+"""znicz_tpu — a TPU-native dataflow deep-learning framework.
+
+A ground-up rebuild of the capabilities of Samsung VELES / Znicz
+(reference: ``Samsung/veles.znicz``; structural blueprint in
+``SURVEY.md`` at the repo root) designed for TPUs from the start:
+
+- a model is a **Workflow**: a directed graph of **Unit** objects joined
+  by control links (run ordering + Bool gates) and attribute links
+  (data aliasing) — the Veles dataflow model
+  (reference: ``veles/units.py``, ``veles/workflow.py``);
+- compute units derive from **AcceleratedUnit** and provide a
+  ``numpy_run`` oracle plus an ``xla_run`` path of pure jax/jnp ops
+  (replacing the reference's ``ocl_run``/``cuda_run`` OpenCL/CUDA
+  kernels, reference: ``veles/accelerated_units.py``);
+- the per-minibatch hot chain is **not** Python-dispatched per unit:
+  the engine partitions the unit graph into *jit regions* that compile
+  to single donated-buffer XLA programs (see
+  :mod:`znicz_tpu.accelerated_units`);
+- buffers are **Vector** objects: a ``jax.Array`` in HBM with an
+  optional host mirror preserving the reference's
+  ``map_read``/``map_write``/``unmap`` discipline
+  (reference: ``veles/memory.py``);
+- distribution is synchronous SPMD data parallelism over a
+  ``jax.sharding.Mesh`` with XLA collectives over ICI, replacing the
+  reference's asynchronous ZeroMQ master–slave parameter server
+  (reference: ``veles/server.py``/``veles/client.py`` →
+  :mod:`znicz_tpu.parallel`).
+
+Note: the reference mount was empty at build time; all reference
+citations are upstream-repo-relative paths per SURVEY.md's provenance
+notice, not verified file:line.
+"""
+
+__version__ = "0.1.0"
+
+from znicz_tpu.utils.config import root  # noqa: F401
+from znicz_tpu.mutable import Bool  # noqa: F401
+from znicz_tpu.units import Unit, Container  # noqa: F401
+from znicz_tpu.workflow import Workflow  # noqa: F401
+from znicz_tpu.memory import Vector  # noqa: F401
+from znicz_tpu.backends import Device, NumpyDevice, XLADevice, TPUDevice  # noqa: F401
+from znicz_tpu.accelerated_units import (  # noqa: F401
+    AcceleratedUnit,
+    AcceleratedWorkflow,
+)

@@ -1,0 +1,257 @@
+"""Accelerated units and the jit-region engine.
+
+Rebuilds the reference's ``AcceleratedUnit`` (reference:
+``veles/accelerated_units.py`` — base class whose ``run`` dispatches to
+``ocl_run``/``cuda_run``/``numpy_run`` and which builds/caches device
+kernels), redesigned for XLA's compilation model:
+
+- every compute unit provides ``numpy_run`` (host oracle — the spec)
+  and ``xla_run`` (pure jax ops over its Vectors' ``devmem``);
+- there is **no kernel-build machinery** — instead, the hot
+  per-minibatch chain of units is compiled into a **jit region**: one
+  ``jax.jit``'ed, donated-buffer XLA program produced by tracing each
+  member unit's ``xla_run`` in control order.  This replaces the
+  reference's per-unit Python dispatch around kernel launches
+  (SURVEY.md §3.1 "the whole minibatch step must be ONE jitted
+  function").
+
+Unit contract for region membership:
+
+- ``xla_run`` must be *pure device compute*: read ``vector.devmem``,
+  write ``vector.devmem``, no ``map_*`` calls, no host branches on
+  data values (host branches on *static* flags are fine if the flag is
+  part of :meth:`AcceleratedUnit.region_key` — the region recompiles
+  per key, e.g. dropout train vs test);
+- per-step host bookkeeping goes in ``host_run`` (runs outside the
+  region, before it fires);
+- random state lives in a Vector of PRNG key data so it is a region
+  leaf (see :meth:`AcceleratedUnit.init_rng`).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import jax
+
+from znicz_tpu.backends import Device, NumpyDevice
+from znicz_tpu.memory import Vector
+from znicz_tpu.units import Unit
+from znicz_tpu.utils import prng
+from znicz_tpu.utils.logger import Logger
+from znicz_tpu.workflow import Workflow
+
+
+class AcceleratedUnit(Unit):
+    """Base class for compute units with oracle + XLA paths."""
+
+    def __init__(self, workflow, name: str | None = None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.device: Device | None = None
+        self._in_region = False
+        self.rng_state = Vector(name="rng_state")
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def initialize(self, device: Device | None = None, **kwargs) -> None:
+        if device is None and isinstance(self.workflow, AcceleratedWorkflow):
+            device = self.workflow.device
+        if device is None:
+            raise ValueError(f"{self}: no device supplied")
+        self.device = device
+        super().initialize(**kwargs)
+
+    @property
+    def compute_dtype(self) -> np.dtype:
+        assert self.device is not None
+        return self.device.compute_dtype
+
+    def init_vectors(self, *vectors: Vector) -> None:
+        """Attach vectors to the device (reference:
+        ``AcceleratedUnit.init_vectors``)."""
+        assert self.device is not None
+        for vec in vectors:
+            if vec:
+                vec.initialize(self.device)
+
+    def unmap_vectors(self, *vectors: Vector) -> None:
+        for vec in vectors:
+            if vec:
+                vec.unmap()
+
+    def init_rng(self, gen: "prng.RandomGenerator | None" = None) -> None:
+        """Give this unit a device-resident PRNG key chain (a region
+        leaf, so stochastic units stay inside jit regions)."""
+        gen = gen or prng.get()
+        key = gen.key()
+        self.rng_state.reset(np.asarray(jax.random.key_data(key)))
+        self.init_vectors(self.rng_state)
+
+    def take_key(self):
+        """Inside ``xla_run``: split a fresh subkey, advancing the
+        device-side chain functionally."""
+        key = jax.random.wrap_key_data(self.rng_state.devmem)
+        key, sub = jax.random.split(key)
+        self.rng_state.devmem = jax.random.key_data(key)
+        return sub
+
+    # ------------------------------------------------------------------
+    # execution
+    # ------------------------------------------------------------------
+    def host_run(self) -> None:
+        """Per-step host bookkeeping (runs even when the device compute
+        is owned by a jit region)."""
+
+    def run(self) -> None:
+        self.host_run()
+        if self._in_region:
+            return  # device compute happens inside the region program
+        if self.device is None or self.device.is_host_only:
+            self.numpy_run()
+        else:
+            self.xla_run()
+
+    def numpy_run(self) -> None:
+        raise NotImplementedError(f"{type(self).__name__}.numpy_run")
+
+    def xla_run(self) -> None:
+        raise NotImplementedError(f"{type(self).__name__}.xla_run")
+
+    # ------------------------------------------------------------------
+    # region protocol
+    # ------------------------------------------------------------------
+    def region_vectors(self) -> list[Vector]:
+        """Vectors this unit touches in ``xla_run`` — region leaves.
+
+        Default: every Vector in ``__dict__`` (own state) plus every
+        linked attribute resolving to a Vector (inputs from other
+        units).  Deterministic order by attribute name.
+        """
+        found: dict[int, Vector] = {}
+        for name in sorted(self.__dict__):
+            val = self.__dict__[name]
+            if isinstance(val, Vector) and val:
+                found.setdefault(id(val), val)
+        for name in sorted(self._linked_attrs):
+            val = self._linked_attrs[name].get()
+            if isinstance(val, Vector) and val:
+                found.setdefault(id(val), val)
+        return list(found.values())
+
+    def region_key(self) -> tuple:
+        """Hashable static flags; region recompiles when they change."""
+        return ()
+
+
+class JitRegion(Logger):
+    """Compiles an ordered chain of AcceleratedUnits into one donated
+    XLA program per static-key combination."""
+
+    def __init__(self, name: str, units: Sequence[AcceleratedUnit],
+                 device: Device) -> None:
+        super().__init__()
+        self.name = name
+        self.units = list(units)
+        self.device = device
+        for unit in self.units:
+            unit._in_region = True
+        self._vectors: list[Vector] | None = None
+        self._cache: dict[tuple, object] = {}
+
+    def _collect_vectors(self) -> list[Vector]:
+        seen: dict[int, Vector] = {}
+        for unit in self.units:
+            for vec in unit.region_vectors():
+                seen.setdefault(id(vec), vec)
+        return list(seen.values())
+
+    def run(self) -> None:
+        if self._vectors is None:
+            self._vectors = self._collect_vectors()
+        vectors = self._vectors
+        for vec in vectors:
+            vec.unmap()
+        skips = tuple(bool(unit.gate_skip) for unit in self.units)
+        key = tuple(unit.region_key() for unit in self.units) + (skips,)
+        fn = self._cache.get(key)
+        if fn is None:
+            self.debug("region '%s': compiling for key %s "
+                       "(%d units, %d leaves)", self.name, key,
+                       len(self.units), len(vectors))
+            fn = self._cache[key] = self._build(skips)
+        leaves = [vec._devmem for vec in vectors]
+        out = fn(*leaves)
+        for vec, leaf in zip(vectors, out):
+            vec.devmem = leaf
+
+    def _build(self, skips: tuple[bool, ...]):
+        vectors = self._vectors
+        assert vectors is not None
+        units = self.units
+        precision = getattr(self.device, "matmul_precision", "default")
+
+        def fn(*leaves):
+            for vec, leaf in zip(vectors, leaves):
+                vec._tracing = True
+                vec._devmem = leaf
+            try:
+                with jax.default_matmul_precision(precision):
+                    for unit, skip in zip(units, skips):
+                        if not skip:
+                            unit.xla_run()
+                return tuple(vec._devmem for vec in vectors)
+            finally:
+                for vec in vectors:
+                    vec._tracing = False
+
+        return jax.jit(fn, donate_argnums=tuple(range(len(vectors))))
+
+
+class RegionUnit(AcceleratedUnit):
+    """Workflow node that fires a :class:`JitRegion` as one step.
+
+    Wiring pattern (see ``StandardWorkflow``): member units keep their
+    ``host_run`` in the control graph *before* this unit; their device
+    compute runs here, fused.
+    """
+
+    def __init__(self, workflow, units: Sequence[AcceleratedUnit],
+                 name: str | None = None, **kwargs) -> None:
+        super().__init__(workflow, name=name or "jit_region", **kwargs)
+        self._member_units = list(units)
+        self.region: JitRegion | None = None
+
+    def initialize(self, device: Device | None = None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if isinstance(self.device, NumpyDevice):
+            # Oracle backend: no compilation; members run themselves.
+            for unit in self._member_units:
+                unit._in_region = False
+            self.gate_skip.value = True
+            return
+        for unit in self._member_units:
+            if not unit.is_initialized:
+                raise AttributeError(f"region member {unit} not initialized")
+        assert self.device is not None
+        self.region = JitRegion(self.name, self._member_units, self.device)
+
+    def run(self) -> None:
+        assert self.region is not None
+        self.region.run()
+
+
+class AcceleratedWorkflow(Workflow):
+    """Workflow owning a device (reference:
+    ``veles/accelerated_units.py`` ``AcceleratedWorkflow``)."""
+
+    def __init__(self, workflow=None, name: str | None = None,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.device: Device | None = None
+
+    def initialize(self, device: Device | None = None, **kwargs) -> None:
+        self.device = device if device is not None else Device.create()
+        super().initialize(device=self.device, **kwargs)

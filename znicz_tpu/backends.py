@@ -1,0 +1,132 @@
+"""Device backends.
+
+Rebuilds the reference's backend abstraction (reference:
+``veles/backends.py`` — ``Device``/``OpenCLDevice``/``CUDADevice``/
+``NumpyDevice`` selected by ``root.common.engine.backend``) for TPU:
+
+- :class:`XLADevice` is the accelerator backend: jax/XLA over PJRT.
+  It works on any jax platform (``tpu`` in production, ``cpu`` in unit
+  tests with a virtual multi-device mesh) because the compute path is
+  pure jax — this mirrors how the reference's units ran unchanged on
+  OpenCL *or* CUDA.
+- :class:`TPUDevice` is the TPU-pinned convenience subclass.
+- :class:`NumpyDevice` is the host oracle backend: every unit's
+  ``numpy_run`` is the spec that ``xla_run`` is tested against
+  (reference test strategy, SURVEY.md §4).
+
+There is no kernel build/autotune machinery here on purpose: XLA owns
+tiling and fusion; the reference's per-device BLOCK_SIZE autotuning
+(``veles/backends.py``) has no TPU analogue.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.utils.config import root
+from znicz_tpu.utils.logger import Logger
+
+
+_PRECISION_BY_LEVEL = {0: "default", 1: "float32", 2: "highest"}
+
+
+class Device(Logger):
+    """Backend base class."""
+
+    backend = "abstract"
+    #: True when there is no separate device memory (numpy oracle).
+    is_host_only = False
+
+    def __init__(self, **kwargs) -> None:
+        super().__init__(**kwargs)
+        self.compute_dtype = np.dtype(
+            root.common.get("precision_type", "float32"))
+
+    @staticmethod
+    def create(backend: str | None = None) -> "Device":
+        """Factory honoring ``root.common.engine.backend``."""
+        backend = backend or root.common.engine.backend
+        if backend == "numpy":
+            return NumpyDevice()
+        if backend == "tpu":
+            return TPUDevice()
+        if backend == "xla":
+            return XLADevice()
+        raise ValueError(f"unknown backend '{backend}' "
+                         f"(expected xla | tpu | numpy)")
+
+    # transfer API used by Vector -------------------------------------
+    def put(self, arr: np.ndarray):
+        raise NotImplementedError
+
+    def get(self, devarr) -> np.ndarray:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        """Block until queued device work completes."""
+
+
+class NumpyDevice(Device):
+    """Host-only oracle backend (reference: ``NumpyDevice``)."""
+
+    backend = "numpy"
+    is_host_only = True
+
+    def put(self, arr: np.ndarray) -> np.ndarray:
+        return arr
+
+    def get(self, devarr) -> np.ndarray:
+        return np.asarray(devarr)
+
+
+class XLADevice(Device):
+    """jax/XLA backend over PJRT — the ``xla_run`` target.
+
+    ``precision_type``/``precision_level`` from the config tree map to
+    the matmul input dtype and ``jax.lax.Precision``:
+
+    - level 0 (fast): inputs in ``precision_type`` (bf16 recommended on
+      TPU — native MXU dtype), default XLA precision;
+    - level 1: f32 matmul precision (deterministic accumulation);
+    - level 2: ``highest`` (f32 data passes through MXU in multiple
+      passes).
+    """
+
+    backend = "xla"
+    platform: str | None = None  # subclass pin; None = jax default
+
+    def __init__(self, device: "jax.Device | None" = None, **kwargs) -> None:
+        super().__init__(**kwargs)
+        if device is None:
+            devices = (jax.devices(self.platform) if self.platform
+                       else jax.devices())
+            device = devices[0]
+        self.jax_device = device
+        self.compute_dtype = np.dtype(
+            root.common.get("precision_type", "float32"))
+        level = int(root.common.get("precision_level", 0))
+        self.matmul_precision = _PRECISION_BY_LEVEL.get(level, "default")
+        self.debug("XLA device %s (platform=%s, dtype=%s, precision=%s)",
+                   device, device.platform, self.compute_dtype,
+                   self.matmul_precision)
+
+    def put(self, arr: np.ndarray):
+        return jax.device_put(arr, self.jax_device)
+
+    def get(self, devarr) -> np.ndarray:
+        return np.asarray(jax.device_get(devarr))
+
+    def sync(self) -> None:
+        # Block on a trivial computation queued after outstanding work.
+        jnp.zeros((), device=self.jax_device).block_until_ready()
+
+
+class TPUDevice(XLADevice):
+    """XLA backend pinned to the TPU platform (reference analogue:
+    ``CUDADevice`` — the production accelerator backend)."""
+
+    backend = "tpu"
+    platform = "tpu"

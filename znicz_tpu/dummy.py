@@ -1,0 +1,38 @@
+"""Test fixtures (reference: ``veles/dummy.py`` — ``DummyWorkflow``/
+``DummyUnit`` let any unit initialize and run without a CLI, launcher,
+or full training loop)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.accelerated_units import AcceleratedWorkflow
+from znicz_tpu.backends import Device
+from znicz_tpu.memory import Vector
+from znicz_tpu.units import Unit
+
+
+class DummyWorkflow(AcceleratedWorkflow):
+    """A bare workflow container for unit tests."""
+
+    def __init__(self, device: Device | None = None, **kwargs) -> None:
+        super().__init__(None, name="dummy", **kwargs)
+        if device is not None:
+            self.device = device
+
+
+class DummyUnit(Unit):
+    """A unit that exposes arbitrary attributes passed to __init__ —
+    handy as a link_attrs source."""
+
+    def __init__(self, workflow=None, **attrs) -> None:
+        super().__init__(workflow)
+        for name, value in attrs.items():
+            setattr(self, name, value)
+
+
+def vector_of(arr, device: Device, name: str = "fixture") -> Vector:
+    """A device-initialized Vector from a numpy array."""
+    vec = Vector(np.asarray(arr), name=name)
+    vec.initialize(device)
+    return vec

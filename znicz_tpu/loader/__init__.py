@@ -1,0 +1,8 @@
+"""Dataset loaders (reference: ``veles/loader/``): the minibatch
+engine with TRAIN/VALID/TEST class splits, per-epoch shuffling, and
+device-resident full-batch variants whose minibatch assembly is a
+gather that runs *inside* the jit region.
+"""
+
+from znicz_tpu.loader.base import Loader, TEST, VALID, TRAIN, CLASS_NAME  # noqa: F401
+from znicz_tpu.loader.fullbatch import FullBatchLoader, ArrayLoader  # noqa: F401

@@ -1,0 +1,180 @@
+"""Loader base: the minibatch engine.
+
+Rebuilds the reference's ``veles/loader/base.py``:
+
+- three sample classes ``TEST=0 / VALID=1 / TRAIN=2`` with
+  ``class_lengths``; one *epoch* walks every non-empty class in order
+  (test, validation, train), the reference's schedule that lets the
+  Decision unit account errors per class;
+- train indices reshuffled every epoch from the seeded PRNG;
+- the last minibatch of a class is **padded** to the static minibatch
+  size (static shapes for XLA) and ``minibatch_valid`` carries the
+  true count as a device scalar so evaluators mask the tail —
+  replacing the reference's dynamic short minibatches, which would
+  force recompilation on TPU;
+- flags consumed by Decision: ``minibatch_class``, ``last_minibatch``,
+  ``epoch_ended``, ``epoch_number``.
+
+The index-picking bookkeeping is ``host_run`` (control plane); the
+data gather is the device path (see ``fullbatch.py``) so it fuses into
+the jit region.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.accelerated_units import AcceleratedUnit
+from znicz_tpu.memory import Vector
+from znicz_tpu.mutable import Bool
+from znicz_tpu.utils import prng
+
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAME = {TEST: "test", VALID: "validation", TRAIN: "train"}
+
+
+class Loader(AcceleratedUnit):
+    """Abstract minibatch provider.
+
+    Subclasses implement :meth:`load_data` (set ``class_lengths`` and
+    storage), :meth:`create_minibatch_data` (allocate the minibatch
+    Vectors) and the gather (``numpy_run``/``xla_run``).
+    """
+
+    SNAPSHOT_ATTRS = ("epoch_number", "_cursor", "_shuffled",
+                      "minibatch_class", "minibatch_size",
+                      "minibatch_offset")
+    # transient per-step buffers; resume regenerates them next step
+    SNAPSHOT_EXCLUDE = ("minibatch_data", "minibatch_labels",
+                        "minibatch_indices", "minibatch_valid")
+
+    def __init__(self, workflow, name: str | None = None,
+                 minibatch_size: int = 100,
+                 shuffle_limit: int = np.iinfo(np.int64).max,
+                 prng_name: str = "default",
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.max_minibatch_size = int(minibatch_size)
+        self.shuffle_limit = shuffle_limit  # epochs to keep shuffling
+        self._prng_name = prng_name
+        # outputs
+        self.minibatch_data = Vector(name=f"{self.name}.minibatch_data")
+        self.minibatch_labels = Vector(name=f"{self.name}.minibatch_labels")
+        self.minibatch_indices = Vector(name=f"{self.name}.minibatch_indices")
+        self.minibatch_valid = Vector(name=f"{self.name}.minibatch_valid")
+        # schedule state
+        self.class_lengths = [0, 0, 0]
+        self.epoch_number = 0
+        self.minibatch_class = TRAIN
+        self.minibatch_size = 0          # true sample count this step
+        self.minibatch_offset = 0
+        self.last_minibatch = Bool(False)
+        self.epoch_ended = Bool(False)
+        self.train_ended = Bool(False)
+        self._schedule: list[tuple[int, int, int]] = []  # (class, lo, hi)
+        self._cursor = 0
+        self._shuffled: np.ndarray | None = None
+
+    # ------------------------------------------------------------------
+    @property
+    def total_samples(self) -> int:
+        return int(sum(self.class_lengths))
+
+    @property
+    def class_offsets(self) -> list[int]:
+        """Global index where each class's samples start."""
+        off, out = 0, []
+        for length in self.class_lengths:
+            out.append(off)
+            off += length
+        return out
+
+    def class_index_range(self, cls: int) -> tuple[int, int]:
+        lo = self.class_offsets[cls]
+        return lo, lo + self.class_lengths[cls]
+
+    # ------------------------------------------------------------------
+    # subclass API
+    # ------------------------------------------------------------------
+    def load_data(self) -> None:
+        raise NotImplementedError
+
+    def create_minibatch_data(self) -> None:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        self.rnd = prng.get(self._prng_name)
+        self.load_data()
+        if self.total_samples == 0:
+            raise ValueError(f"{self}: load_data produced no samples")
+        self.max_minibatch_size = min(self.max_minibatch_size,
+                                      max(self.class_lengths))
+        self.minibatch_indices.reset(
+            np.zeros(self.max_minibatch_size, dtype=np.int32))
+        self.minibatch_valid.reset(np.zeros((), dtype=np.int32))
+        self.create_minibatch_data()
+        self.init_vectors(self.minibatch_data, self.minibatch_labels,
+                          self.minibatch_indices, self.minibatch_valid)
+        self._build_schedule()
+        if (self._shuffled is None
+                or len(self._shuffled) != self.total_samples):
+            # fresh start; on snapshot resume the restored permutation
+            # and cursor are kept so the trajectory continues exactly
+            self._shuffled = np.arange(self.total_samples, dtype=np.int32)
+            self._cursor = 0
+            self._shuffle_train()
+
+    def _build_schedule(self) -> None:
+        self._schedule = []
+        for cls in (TEST, VALID, TRAIN):
+            lo, hi = self.class_index_range(cls)
+            for start in range(lo, hi, self.max_minibatch_size):
+                self._schedule.append(
+                    (cls, start, min(start + self.max_minibatch_size, hi)))
+
+    def _shuffle_train(self) -> None:
+        if self.epoch_number >= self.shuffle_limit:
+            return
+        lo, hi = self.class_index_range(TRAIN)
+        if hi > lo:
+            seg = self._shuffled[lo:hi]
+            self.rnd.shuffle(seg)
+
+    # ------------------------------------------------------------------
+    # per-step control plane
+    # ------------------------------------------------------------------
+    def host_run(self) -> None:
+        if self._cursor >= len(self._schedule):
+            # previous step ended the epoch; begin the next one
+            self._cursor = 0
+            self.epoch_number += 1
+            self._shuffle_train()
+        cls, lo, hi = self._schedule[self._cursor]
+        self._cursor += 1
+        count = hi - lo
+        idx = np.empty(self.max_minibatch_size, dtype=np.int32)
+        idx[:count] = self._shuffled[lo:hi]
+        if count < self.max_minibatch_size:  # pad by repeating the first
+            idx[count:] = idx[0]
+        self.minibatch_class = cls
+        self.minibatch_size = count
+        self.minibatch_offset = lo
+        self.minibatch_indices.map_invalidate()
+        self.minibatch_indices.mem[...] = idx
+        self.minibatch_valid.map_invalidate()
+        self.minibatch_valid.mem[...] = count
+        at_end = self._cursor >= len(self._schedule)
+        self.last_minibatch.value = (
+            at_end or self._schedule[self._cursor][0] != cls)
+        self.epoch_ended.value = at_end
+        self.train_ended.value = at_end and cls == TRAIN
+        # device path (gather) needs indices on device
+        if self.device is not None and not self.device.is_host_only:
+            self.minibatch_indices.unmap()
+            self.minibatch_valid.unmap()
+
+    # stats ------------------------------------------------------------
+    def class_minibatch_count(self, cls: int) -> int:
+        return sum(1 for c, _, _ in self._schedule if c == cls)

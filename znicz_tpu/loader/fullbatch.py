@@ -1,0 +1,131 @@
+"""Full-batch loaders: whole dataset resident in HBM, minibatch
+assembly is an on-device gather (reference:
+``veles/loader/fullbatch.py`` — ``FullBatchLoader`` with its
+gather-by-index kernel; here the kernel is ``jnp.take`` fused into the
+jit region so minibatch assembly costs no host↔device traffic).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.loader.base import Loader, TEST, TRAIN, VALID
+from znicz_tpu.memory import Vector
+
+
+class FullBatchLoader(Loader):
+    """Loader whose subclass provides the entire dataset as arrays.
+
+    Subclasses implement :meth:`load_data` and fill
+    ``original_data`` / ``original_labels`` plus ``class_lengths``.
+    Samples must be ordered test, validation, train along axis 0.
+    """
+
+    # the dataset itself: large, immutable, rebuilt by load_data on
+    # resume — never serialized into snapshots
+    SNAPSHOT_EXCLUDE = Loader.SNAPSHOT_EXCLUDE + (
+        "original_data", "original_labels")
+
+    def __init__(self, workflow, name: str | None = None,
+                 normalization_scale: float | None = None,
+                 normalization_bias: float = 0.0,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.original_data = Vector(name=f"{self.name}.original_data")
+        self.original_labels = Vector(name=f"{self.name}.original_labels")
+        #: optional affine normalization x*scale + bias applied on load
+        self.normalization_scale = normalization_scale
+        self.normalization_bias = normalization_bias
+
+    @property
+    def has_labels(self) -> bool:
+        return bool(self.original_labels)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.normalization_scale is not None:
+            data = self.original_data.mem.astype(np.float32)
+            data *= self.normalization_scale
+            data += self.normalization_bias
+            self.original_data.reset(data)
+        self.init_vectors(self.original_data, self.original_labels)
+
+    def create_minibatch_data(self) -> None:
+        sample_shape = self.original_data.shape[1:]
+        self.minibatch_data.reset(np.zeros(
+            (self.max_minibatch_size,) + tuple(sample_shape),
+            dtype=np.float32))
+        if self.has_labels:
+            self.minibatch_labels.reset(np.zeros(
+                self.max_minibatch_size, dtype=np.int32))
+
+    # -- the gather -----------------------------------------------------
+    def numpy_run(self) -> None:
+        self.original_data.map_read()
+        self.minibatch_indices.map_read()
+        idx = self.minibatch_indices.mem
+        self.minibatch_data.map_invalidate()
+        self.minibatch_data.mem[...] = \
+            self.original_data.mem[idx].astype(np.float32)
+        if self.has_labels:
+            self.original_labels.map_read()
+            self.minibatch_labels.map_invalidate()
+            self.minibatch_labels.mem[...] = self.original_labels.mem[idx]
+
+    def xla_run(self) -> None:
+        idx = self.minibatch_indices.devmem
+        self.minibatch_data.devmem = jnp.take(
+            self.original_data.devmem, idx, axis=0).astype(jnp.float32)
+        if self.has_labels:
+            self.minibatch_labels.devmem = jnp.take(
+                self.original_labels.devmem, idx, axis=0)
+
+
+class ArrayLoader(FullBatchLoader):
+    """FullBatchLoader fed directly with numpy arrays per class — the
+    workhorse for samples and tests (reference analogue: the ad-hoc
+    per-sample loader subclasses in ``znicz/samples/*``)."""
+
+    def __init__(self, workflow,
+                 train_data: np.ndarray,
+                 train_labels: np.ndarray | None = None,
+                 valid_data: np.ndarray | None = None,
+                 valid_labels: np.ndarray | None = None,
+                 test_data: np.ndarray | None = None,
+                 test_labels: np.ndarray | None = None,
+                 **kwargs) -> None:
+        # before super().__init__: bypass the linked-attr machinery
+        object.__setattr__(self, "_arrays",
+                           (test_data, test_labels, valid_data, valid_labels,
+                            train_data, train_labels))
+        super().__init__(workflow, **kwargs)
+
+    def load_data(self) -> None:
+        (test_d, test_l, valid_d, valid_l, train_d, train_l) = self._arrays
+        datas, labels = [], []
+        lengths = [0, 0, 0]
+        for cls, (d, l) in zip((TEST, VALID, TRAIN),
+                               ((test_d, test_l), (valid_d, valid_l),
+                                (train_d, train_l))):
+            if d is None:
+                if l is not None:
+                    raise ValueError(f"{self}: labels without data for "
+                                     f"class {cls}")
+                continue
+            lengths[cls] = len(d)
+            datas.append(np.asarray(d))
+            labels.append(None if l is None
+                          else np.asarray(l, dtype=np.int32))
+        if any(l is not None for l in labels):
+            # labels index by GLOBAL sample position — partial labels
+            # would silently misalign the gather
+            missing = [i for i, l in enumerate(labels) if l is None]
+            if missing:
+                raise ValueError(
+                    f"{self}: labels given for some classes but not "
+                    f"others — provide labels for every supplied split")
+            self.original_labels.reset(np.concatenate(labels, axis=0))
+        self.class_lengths = lengths
+        self.original_data.reset(np.concatenate(datas, axis=0))

@@ -1,0 +1,242 @@
+"""Vector: the framework's buffer type.
+
+Rebuilds the reference's host↔device buffer pair (reference:
+``veles/memory.py`` — ``Vector`` with ``mem``/``devmem`` and the
+``map_read`` / ``map_write`` / ``map_invalidate`` / ``unmap`` lazy-sync
+protocol), re-based on ``jax.Array``:
+
+- ``devmem`` is a ``jax.Array`` living in HBM (or a tracer while a jit
+  region is being traced);
+- ``mem`` is a lazily-materialized host ``numpy`` mirror;
+- the map/unmap state machine is preserved because it is the
+  reference's central correctness invariant (SURVEY.md §3.2) and it
+  keeps host↔HBM traffic explicit: ``map_read`` = device→host fetch,
+  ``unmap`` = host→device upload, ``map_invalidate`` = "host will
+  overwrite everything, skip the fetch".
+
+Invalid transitions raise — the reference enforced the same assertions
+as its substitute for a race detector (SURVEY.md §5.2).
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+if TYPE_CHECKING:  # pragma: no cover
+    from znicz_tpu.backends import Device
+
+
+class _State(enum.Enum):
+    EMPTY = 0     #: no storage yet
+    HOST = 1      #: host copy authoritative; device copy stale/absent
+    DEVICE = 2    #: device copy authoritative; host copy stale
+    SYNCED = 3    #: both copies valid; host reads need no transfer
+
+
+class Vector:
+    """Host-mirrored device buffer with explicit sync points."""
+
+    __slots__ = ("_mem", "_devmem", "_state", "_device", "_tracing", "name")
+
+    def __init__(self, mem: np.ndarray | None = None,
+                 name: str = "") -> None:
+        self._mem: np.ndarray | None = None
+        self._devmem = None
+        self._state = _State.EMPTY
+        self._device: "Device | None" = None
+        self._tracing = False
+        self.name = name
+        if mem is not None:
+            self.reset(mem)
+
+    # ------------------------------------------------------------------
+    # allocation
+    # ------------------------------------------------------------------
+    def reset(self, mem: np.ndarray | None) -> None:
+        """(Re)bind host contents; device copy becomes stale."""
+        self._check_not_tracing("reset")
+        if mem is None:
+            self._mem = None
+            self._devmem = None
+            self._state = _State.EMPTY
+            return
+        arr = np.asarray(mem)
+        if arr.ndim and not arr.flags.c_contiguous:
+            arr = np.ascontiguousarray(arr)  # NB: would promote 0-d to 1-d
+        self._mem = arr
+        self._state = _State.HOST
+
+    def initialize(self, device: "Device") -> None:
+        """Attach to a device; upload if the host copy is authoritative.
+
+        Reference: ``Vector.initialize`` in ``veles/memory.py`` — called
+        from ``AcceleratedUnit.init_vectors``.
+        """
+        self._check_not_tracing("initialize")
+        self._device = device
+        if device.is_host_only:
+            return
+        if self._state == _State.HOST:
+            self._devmem = device.put(self._mem)
+            self._state = _State.SYNCED
+
+    # ------------------------------------------------------------------
+    # the map/unmap protocol
+    # ------------------------------------------------------------------
+    def map_read(self) -> None:
+        """Make the host copy current for reading."""
+        self._check_not_tracing("map_read")
+        if self._state == _State.EMPTY:
+            raise ValueError(f"Vector '{self.name}': map_read on empty buffer")
+        if self._state == _State.DEVICE:
+            assert self._device is not None
+            self._mem = self._device.get(self._devmem)
+            self._state = _State.SYNCED
+
+    def map_write(self) -> None:
+        """Make the host copy current and mark it authoritative."""
+        self.map_read()
+        if self._mem is not None and not self._mem.flags.writeable:
+            # device.get may hand back a zero-copy read-only view
+            self._mem = np.array(self._mem, copy=True)
+        self._state = _State.HOST
+
+    def map_invalidate(self) -> None:
+        """Host will fully overwrite; skip the device→host fetch."""
+        self._check_not_tracing("map_invalidate")
+        if self._state == _State.EMPTY:
+            raise ValueError(
+                f"Vector '{self.name}': map_invalidate on empty buffer")
+        if self._mem is None:
+            assert self._devmem is not None
+            self._mem = np.empty(self._devmem.shape,
+                                 dtype=np.dtype(self._devmem.dtype))
+        elif not self._mem.flags.writeable:
+            self._mem = np.empty_like(self._mem)
+        self._state = _State.HOST
+
+    def unmap(self) -> None:
+        """Make the device copy current (upload if host was written)."""
+        self._check_not_tracing("unmap")
+        if self._state == _State.EMPTY:
+            raise ValueError(f"Vector '{self.name}': unmap on empty buffer")
+        if self._device is None or self._device.is_host_only:
+            return
+        if self._state == _State.HOST:
+            self._devmem = self._device.put(self._mem)
+        self._state = _State.DEVICE
+
+    # ------------------------------------------------------------------
+    # storage access
+    # ------------------------------------------------------------------
+    @property
+    def mem(self) -> np.ndarray:
+        """The host ndarray.  Caller must hold a map_read/map_write."""
+        if self._state == _State.DEVICE:
+            raise ValueError(
+                f"Vector '{self.name}': host access while device copy is "
+                f"authoritative — call map_read()/map_write() first")
+        if self._mem is None:
+            raise ValueError(f"Vector '{self.name}': no storage")
+        return self._mem
+
+    @mem.setter
+    def mem(self, value: np.ndarray) -> None:
+        self.reset(value)
+
+    @property
+    def devmem(self):
+        """The device ``jax.Array`` (or tracer inside a jit region)."""
+        if self._tracing:
+            return self._devmem
+        if self._device is None or self._device.is_host_only:
+            # Host-only backend: the ndarray *is* the device buffer.
+            return self.mem
+        if self._state == _State.HOST:
+            raise ValueError(
+                f"Vector '{self.name}': device access while host copy is "
+                f"authoritative — call unmap() first")
+        if self._devmem is None:
+            raise ValueError(f"Vector '{self.name}': not initialized "
+                             f"on a device")
+        return self._devmem
+
+    @devmem.setter
+    def devmem(self, value) -> None:
+        """Functional update from device compute (eager xla_run or the
+        region builder writing traced results back)."""
+        self._devmem = value
+        if not self._tracing:
+            self._state = _State.DEVICE
+
+    @property
+    def state_name(self) -> str:
+        return self._state.name
+
+    # ------------------------------------------------------------------
+    # conveniences
+    # ------------------------------------------------------------------
+    @property
+    def shape(self) -> tuple[int, ...]:
+        if self._mem is not None:
+            return tuple(self._mem.shape)
+        if self._devmem is not None:
+            return tuple(self._devmem.shape)
+        raise ValueError(f"Vector '{self.name}': no storage")
+
+    @property
+    def dtype(self) -> np.dtype:
+        if self._mem is not None and self._state != _State.DEVICE:
+            return self._mem.dtype
+        if self._devmem is not None:
+            return np.dtype(self._devmem.dtype)
+        if self._mem is not None:
+            return self._mem.dtype
+        raise ValueError(f"Vector '{self.name}': no storage")
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self.shape)) if self else 0
+
+    @property
+    def sample_size(self) -> int:
+        """Elements per sample (all dims but the first — the reference's
+        frequent ``size // shape[0]`` idiom)."""
+        shape = self.shape
+        return int(np.prod(shape[1:])) if len(shape) > 1 else 1
+
+    def __bool__(self) -> bool:
+        return self._state != _State.EMPTY
+
+    def __len__(self) -> int:
+        return self.shape[0] if self else 0
+
+    def __array__(self, dtype=None, copy=None):
+        self.map_read()
+        arr = self.mem
+        return arr.astype(dtype) if dtype is not None else arr
+
+    def __getitem__(self, idx):
+        return self.mem[idx]
+
+    def __setitem__(self, idx, value) -> None:
+        self.mem[idx] = value
+
+    def __repr__(self) -> str:
+        if not self:
+            return f"Vector('{self.name}', empty)"
+        return (f"Vector('{self.name}', {self.shape}, {self.dtype}, "
+                f"{self._state.name})")
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+    def _check_not_tracing(self, op: str) -> None:
+        if self._tracing:
+            raise RuntimeError(
+                f"Vector '{self.name}': {op}() inside a jit region — "
+                f"host sync is not allowed in traced code; move this "
+                f"unit out of the region or use device-side state")

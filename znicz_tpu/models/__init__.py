@@ -1,0 +1,7 @@
+"""Model assembly and the sample zoo (reference:
+``znicz/standard_workflow.py`` + ``znicz/samples/``)."""
+
+from znicz_tpu.models.standard_workflow import (  # noqa: F401
+    StandardWorkflow,
+    register_layer_type,
+)

@@ -1,0 +1,231 @@
+"""StandardWorkflow: declarative model assembly + training-loop wiring.
+
+Rebuilds the reference's ``znicz/standard_workflow.py``: a complete
+training loop from a declarative ``layers`` list.  Layer dicts use the
+reference's convention — ``{"type": <name>, "->": {forward kwargs},
+"<-": {gradient kwargs}}``.
+
+Topology (both backends):
+
+.. code-block:: text
+
+    start → repeater → loader(host pick) → [hot chain] → decision ─→ repeater
+                                                            └─(complete)→ end
+    side chain on decision.improved: snapshotter
+
+The hot chain is backend-dependent — the TPU-first core of the design:
+
+- ``xla``: ONE :class:`~znicz_tpu.accelerated_units.RegionUnit`
+  compiling loader-gather → forwards → evaluator → backwards into a
+  single donated-buffer XLA program (two variants: train minibatches
+  run the backward units, validation/test minibatches skip them via
+  the region's static key);
+- ``numpy``: the oracle path — each unit fires eagerly through the
+  scheduler exactly like the reference's NumPy backend.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+from znicz_tpu.accelerated_units import AcceleratedWorkflow, RegionUnit
+from znicz_tpu.backends import NumpyDevice
+from znicz_tpu.loader.base import TRAIN, Loader
+from znicz_tpu.mutable import Bool
+from znicz_tpu.ops import all2all  # noqa: F401  (registers layer types)
+from znicz_tpu.ops import gd  # noqa: F401  (registers gradient pairs)
+from znicz_tpu.ops.decision import DecisionGD, DecisionMSE
+from znicz_tpu.ops.evaluator import EvaluatorMSE, EvaluatorSoftmax
+from znicz_tpu.ops.nn_units import Forward, gd_for
+from znicz_tpu.units import Repeater
+from znicz_tpu.utils.snapshotter import Snapshotter
+
+
+#: layer-type registry: name → forward class (backward via gd_for)
+_LAYER_TYPES: dict[str, type] = {}
+
+
+def register_layer_type(name: str, forward_cls: type) -> None:
+    _LAYER_TYPES[name] = forward_cls
+
+
+def layer_type(name: str) -> type:
+    try:
+        return _LAYER_TYPES[name]
+    except KeyError:
+        raise ValueError(f"unknown layer type '{name}' "
+                         f"(have {sorted(_LAYER_TYPES)})") from None
+
+
+for _name, _cls in {
+    "all2all": all2all.All2All,
+    "all2all_tanh": all2all.All2AllTanh,
+    "all2all_relu": all2all.All2AllRELU,
+    "all2all_str": all2all.All2AllStrictRELU,
+    "all2all_sigmoid": all2all.All2AllSigmoid,
+    "softmax": all2all.All2AllSoftmax,
+}.items():
+    register_layer_type(_name, _cls)
+
+
+class StandardWorkflow(AcceleratedWorkflow):
+    """Declarative training workflow.
+
+    Parameters
+    ----------
+    loader_factory:
+        ``callable(workflow) -> Loader`` building the dataset unit.
+    layers:
+        list of layer dicts (``{"type", "->", "<-"}``).
+    loss:
+        ``"softmax"`` (classification) or ``"mse"``.
+    decision_config / snapshotter_config:
+        kwargs for the Decision / Snapshotter units
+        (``snapshotter_config=None`` disables snapshots).
+    """
+
+    def __init__(self, workflow=None, name: str | None = None,
+                 loader_factory: Callable[["StandardWorkflow"], Loader]
+                 | None = None,
+                 layers: Sequence[dict] = (),
+                 loss: str = "softmax",
+                 decision_config: dict[str, Any] | None = None,
+                 snapshotter_config: dict[str, Any] | None = None,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        if loader_factory is None:
+            raise ValueError("loader_factory is required")
+        self.layers_config = list(layers)
+        self.loss = loss
+
+        self.repeater = Repeater(self, name="repeater")
+        self.loader = loader_factory(self)
+        assert isinstance(self.loader, Loader)
+        self.forwards: list[Forward] = []
+        self.gds: list = []
+        self.link_forwards()
+        self.link_evaluator()
+        self.link_decision(**(decision_config or {}))
+        self.link_gds()
+        self.link_loop()
+        self.snapshotter = None
+        if snapshotter_config is not None:
+            self.link_snapshotter(**snapshotter_config)
+        self._region_unit: RegionUnit | None = None
+
+    # ------------------------------------------------------------------
+    # builders (reference API surface: link_forwards / link_gds / ...)
+    # ------------------------------------------------------------------
+    def link_forwards(self) -> None:
+        prev = None
+        for spec in self.layers_config:
+            cls = layer_type(spec["type"])
+            unit = cls(self, **spec.get("->", {}))
+            if prev is None:
+                unit.link_attrs(self.loader, ("input", "minibatch_data"))
+            else:
+                unit.link_attrs(prev, ("input", "output"))
+            self.forwards.append(unit)
+            prev = unit
+
+    def link_evaluator(self) -> None:
+        last = self.forwards[-1]
+        if self.loss == "softmax":
+            ev = EvaluatorSoftmax(self, name="evaluator")
+            ev.link_attrs(last, "output", "max_idx")
+            ev.link_attrs(self.loader, ("labels", "minibatch_labels"),
+                          "minibatch_valid", "minibatch_class")
+        elif self.loss == "mse":
+            ev = EvaluatorMSE(self, name="evaluator")
+            ev.link_attrs(last, "output")
+            ev.link_attrs(self.loader, ("target", "minibatch_data"),
+                          "minibatch_valid", "minibatch_class")
+        else:
+            raise ValueError(f"unknown loss '{self.loss}'")
+        self.evaluator = ev
+
+    def link_decision(self, **config) -> None:
+        cls = DecisionGD if self.loss == "softmax" else DecisionMSE
+        self.decision = cls(self, name="decision", **config)
+        self.decision.loader = self.loader
+        self.decision.evaluator = self.evaluator
+
+    def link_gds(self) -> None:
+        """Build the backward chain via the fwd↔bwd pairing registry
+        (reference: MatchingObject-driven ``link_gds``)."""
+        self.gds = []
+        next_gd = None
+        for i, fwd in enumerate(reversed(self.forwards)):
+            spec = self.layers_config[len(self.forwards) - 1 - i]
+            cls = gd_for(type(fwd))
+            unit = cls(self, need_err_input=(i != len(self.forwards) - 1),
+                       **spec.get("<-", {}))
+            unit.link_attrs(fwd, "input", "output", "weights", "bias")
+            if next_gd is None:
+                unit.link_attrs(self.evaluator, "err_output")
+            else:
+                unit.link_attrs(next_gd, ("err_output", "err_input"))
+            # train minibatches only (reference: decision.gd_skip)
+            unit.gate_skip = Bool._derived(
+                lambda: self.loader.minibatch_class != TRAIN)
+            self.gds.append(unit)
+            next_gd = unit
+        self.gds.reverse()
+
+    def link_loop(self) -> None:
+        """Wire the training loop's control flow."""
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        self.decision.link_from(self._link_hot_chain(self.loader))
+        self.repeater.link_from(self.decision)
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+
+    def _link_hot_chain(self, after):
+        """Backend-independent wiring is impossible to decide before
+        ``initialize`` (device unknown), so both paths are wired and
+        gated: the RegionUnit disables itself on the numpy backend and
+        the eager chain is skipped on the XLA backend."""
+        # eager oracle chain
+        prev = after
+        for fwd in self.forwards:
+            fwd.link_from(prev)
+            prev = fwd
+        self.evaluator.link_from(prev)
+        prev = self.evaluator
+        for gd_unit in reversed(self.gds):
+            gd_unit.link_from(prev)
+            prev = gd_unit
+        return prev
+
+    def link_snapshotter(self, **config) -> None:
+        self.snapshotter = Snapshotter(self, name="snapshotter", **config)
+        self.snapshotter.decision = self.decision
+        self.snapshotter.link_from(self.decision)
+        self.snapshotter.gate_skip = ~self.decision.improved
+        # snapshotter rides the loop edge; repeater waits for no one
+        # extra (Repeater = any-gate), so no deadlock.
+
+    # ------------------------------------------------------------------
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if not isinstance(self.device, NumpyDevice) \
+                and self._region_unit is None:
+            self._compile_region()
+
+    def _compile_region(self) -> None:
+        """Swap the eager hot chain for one jit region (xla backend)."""
+        members = [self.loader, *self.forwards, self.evaluator,
+                   *reversed(self.gds)]
+        region = RegionUnit(self, members, name="train_region")
+        region.initialize(device=self.device)
+        region._initialized = True
+        # rewire: loader → region → decision (drop the eager chain)
+        self.decision.unlink_from(self.gds[0] if self.gds
+                                  else self.evaluator)
+        first_fwd = self.forwards[0]
+        first_fwd.unlink_from(self.loader)
+        region.link_from(self.loader)
+        self.decision.link_from(region)
+        self._region_unit = region

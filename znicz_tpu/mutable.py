@@ -1,0 +1,106 @@
+"""Shared mutable flags and linkable attributes.
+
+Rebuilds the reference's gating primitives (reference:
+``veles/mutable.py``): units gate on :class:`Bool` objects that other
+units mutate, and lazily-derived booleans (``~a``, ``a & b``, ``a | b``)
+let a gate follow another flag without copying it.
+
+These are **host-side control-plane** objects: they decide which units
+run between device steps.  Per-minibatch conditions that must live
+*inside* a jit region are handled separately (static region keys or
+``lax.cond`` — see :mod:`znicz_tpu.accelerated_units`).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class Bool:
+    """A shared mutable boolean.
+
+    Units hold *references* to the same ``Bool`` so one unit flipping it
+    (``flag << True``) is observed by every gate that watches it.
+    Deriving (``~a``, ``a & b``, ``a | b``) produces a live view that
+    re-evaluates on every read.
+    """
+
+    __slots__ = ("_value", "_expr", "on_true")
+
+    def __init__(self, value: bool = False) -> None:
+        self._value = bool(value)
+        self._expr: Callable[[], bool] | None = None
+        #: optional callbacks fired when the flag transitions to True
+        self.on_true: list[Callable[[], None]] = []
+
+    @classmethod
+    def _derived(cls, expr: Callable[[], bool]) -> "Bool":
+        b = cls()
+        b._expr = expr
+        return b
+
+    @property
+    def value(self) -> bool:
+        if self._expr is not None:
+            return self._expr()
+        return self._value
+
+    @value.setter
+    def value(self, v: bool) -> None:
+        if self._expr is not None:
+            raise ValueError("cannot assign to a derived Bool")
+        was = self._value
+        self._value = bool(v)
+        if self._value and not was:
+            for cb in self.on_true:
+                cb()
+
+    def __lshift__(self, v: bool) -> "Bool":
+        """``flag << True`` — in-place assignment that reads naturally
+        at call sites (the reference used ``<<=``)."""
+        self.value = v
+        return self
+
+    def __bool__(self) -> bool:
+        return self.value
+
+    def __invert__(self) -> "Bool":
+        return Bool._derived(lambda: not self.value)
+
+    def __and__(self, other: "Bool") -> "Bool":
+        return Bool._derived(lambda: self.value and bool(other))
+
+    def __or__(self, other: "Bool") -> "Bool":
+        return Bool._derived(lambda: self.value or bool(other))
+
+    def __repr__(self) -> str:
+        kind = "derived" if self._expr is not None else "plain"
+        return f"Bool({self.value}, {kind})"
+
+
+class LinkableAttribute:
+    """Descriptor record for an attribute aliased from another object.
+
+    ``b.link_attrs(a, ("input", "output"))`` makes ``b.input`` a live
+    alias of ``a.output``: reads and writes on ``b.input`` go to ``a``.
+    Stored in the owner's ``_linked_attrs`` table; resolution happens in
+    :meth:`znicz_tpu.units.Unit.__getattr__` / ``__setattr__``.
+    """
+
+    __slots__ = ("source", "source_name", "two_way")
+
+    def __init__(self, source: object, source_name: str,
+                 two_way: bool = True) -> None:
+        self.source = source
+        self.source_name = source_name
+        self.two_way = two_way
+
+    def get(self):
+        return getattr(self.source, self.source_name)
+
+    def set(self, value) -> None:
+        if not self.two_way:
+            raise AttributeError(
+                f"attribute is linked one-way from "
+                f"{type(self.source).__name__}.{self.source_name}")
+        setattr(self.source, self.source_name, value)

@@ -1,0 +1,9 @@
+"""NN op library: forward units, paired gradient units, evaluators,
+decision/schedule units (reference: the ``znicz/*.py`` unit corpus,
+SURVEY.md §2.2).
+
+Every forward unit has a ``numpy_run`` oracle and an ``xla_run`` jax
+path; backward units are explicit (not autodiff) so per-unit
+cross-backend tests mirror the reference's test strategy, and the whole
+fwd+bwd chain still compiles into one XLA program via jit regions.
+"""

@@ -1,0 +1,151 @@
+"""Fully-connected forward units (reference: ``znicz/all2all.py``).
+
+``y = act(x @ W + b)`` — the GEMM rides the MXU via
+``jnp.dot``/``lax.dot_general`` (the reference hand-tiled this in
+OpenCL/CUDA; on TPU XLA owns the tiling, SURVEY.md §2.3).  Activation
+flavors are fused into the same jit region, so the elementwise tail
+costs no extra HBM round-trip.
+
+``All2AllSoftmax`` also produces ``max_idx`` (argmax per sample) like
+the reference — used by the evaluator and image-saver units.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops import activations_math
+from znicz_tpu.ops.nn_units import Forward
+
+
+class All2All(Forward):
+    """Linear fully-connected layer.
+
+    ``output_sample_shape`` is the per-sample output shape (an int or
+    tuple), mirroring the reference's constructor.
+    """
+
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, output_sample_shape, name=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        if isinstance(output_sample_shape, (int, np.integer)):
+            output_sample_shape = (int(output_sample_shape),)
+        self.output_sample_shape = tuple(output_sample_shape)
+        self.activation = activations_math.get(self.ACTIVATION)
+
+    @property
+    def neurons(self) -> int:
+        return int(np.prod(self.output_sample_shape))
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked/allocated yet")
+        n_in = self.input.sample_size
+        n_out = self.neurons
+        if not self.weights:
+            self.weights.reset(self.fill_array(
+                (n_in, n_out), self.weights_filling, self.weights_stddev,
+                fan_in=n_in))
+        if self.include_bias and not self.bias:
+            self.bias.reset(self.fill_array(
+                (n_out,), self.bias_filling, self.bias_stddev, fan_in=n_in))
+        batch = self.input.shape[0]
+        self.output.reset(np.zeros((batch,) + self.output_sample_shape,
+                                   dtype=np.float32))
+        self.init_vectors(self.input, self.output, self.weights, self.bias)
+
+    # -- math (shared shape logic; xp-generic) --------------------------
+    def _forward(self, xp, x, w, b):
+        batch = x.shape[0]
+        y = xp.dot(x.reshape(batch, -1), w)
+        if b is not None:
+            y = y + b
+        y = self.activation.fwd(xp, y)
+        return y.reshape((batch,) + self.output_sample_shape)
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.weights.map_read()
+        x = self.input.mem.astype(np.float32)
+        b = None
+        if self.include_bias:
+            self.bias.map_read()
+            b = self.bias.mem
+        self.output.map_invalidate()
+        self.output.mem[...] = self._forward(np, x, self.weights.mem, b)
+
+    def xla_run(self) -> None:
+        x = self.input.devmem
+        w = self.weights.devmem
+        b = self.bias.devmem if self.include_bias else None
+        self.output.devmem = self._forward(jnp, x, w, b)
+
+
+class All2AllTanh(All2All):
+    """Fused scaled-tanh flavor (reference: ``All2AllTanh``)."""
+    ACTIVATION = "tanh"
+
+
+class All2AllRELU(All2All):
+    """Fused smooth-RELU (softplus) flavor (reference: ``All2AllRELU``)."""
+    ACTIVATION = "relu"
+
+
+class All2AllStrictRELU(All2All):
+    """Fused max(x,0) flavor (reference: ``All2AllStrictRELU``)."""
+    ACTIVATION = "strict_relu"
+
+
+class All2AllSigmoid(All2All):
+    """Fused sigmoid flavor (reference: ``All2AllSigmoid``)."""
+    ACTIVATION = "sigmoid"
+
+
+class All2AllSoftmax(All2All):
+    """Softmax output layer; also computes per-sample argmax
+    (reference: ``All2AllSoftmax`` with its ``max_idx`` kernel)."""
+
+    ACTIVATION = "linear"  # softmax applied over the linear output
+
+    def __init__(self, workflow, output_sample_shape, name=None, **kwargs):
+        super().__init__(workflow, output_sample_shape, name=name, **kwargs)
+        self.max_idx = Vector(name=f"{self.name}.max_idx")
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        self.max_idx.reset(np.zeros(self.output.shape[0], dtype=np.int32))
+        self.init_vectors(self.max_idx)
+
+    def _softmax(self, xp, logits):
+        m = logits.max(axis=1, keepdims=True)
+        e = xp.exp(logits - m)
+        return e / e.sum(axis=1, keepdims=True)
+
+    def _logits(self, xp, x, w, b):
+        y = xp.dot(x.reshape(x.shape[0], -1), w)
+        return y if b is None else y + b
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.weights.map_read()
+        b = None
+        if self.include_bias:
+            self.bias.map_read()
+            b = self.bias.mem
+        x = self.input.mem.astype(np.float32)
+        logits = self._logits(np, x, self.weights.mem, b)
+        self.output.map_invalidate()
+        self.max_idx.map_invalidate()
+        self.output.mem[...] = self._softmax(np, logits)
+        self.max_idx.mem[...] = np.argmax(logits, axis=1).astype(np.int32)
+
+    def xla_run(self) -> None:
+        b = self.bias.devmem if self.include_bias else None
+        logits = self._logits(jnp, self.input.devmem, self.weights.devmem, b)
+        self.output.devmem = self._softmax(jnp, logits)
+        self.max_idx.devmem = jnp.argmax(logits, axis=1).astype(jnp.int32)

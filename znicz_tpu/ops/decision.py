@@ -1,0 +1,165 @@
+"""Decision units: end-of-minibatch bookkeeping and stop logic
+(reference: ``znicz/decision.py``).
+
+A Decision unit runs on the host every minibatch, after the evaluator:
+
+- accumulates per-class error statistics for the epoch;
+- at epoch end compares validation error against the best seen,
+  raising ``improved`` (the Snapshotter's trigger) and resetting the
+  patience counter;
+- raises ``complete`` when ``max_epochs`` is reached or validation has
+  not improved for ``fail_iterations`` epochs — ``complete`` gates the
+  workflow's end point.
+
+This is control plane by design: the only device→host traffic is the
+evaluator's scalar metric (``n_err`` / ``metrics``) per step.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.loader.base import CLASS_NAME, TRAIN, VALID
+from znicz_tpu.memory import Vector
+from znicz_tpu.mutable import Bool
+from znicz_tpu.units import Unit
+
+
+class DecisionBase(Unit):
+    def __init__(self, workflow, name: str | None = None,
+                 max_epochs: int | None = None,
+                 fail_iterations: int = 100,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.max_epochs = max_epochs
+        self.fail_iterations = fail_iterations
+        self.complete = Bool(False)
+        self.improved = Bool(False)
+        self.epoch_ended = Bool(False)  # mirrored for side-chain gating
+        # linked from loader by the workflow builder:
+        self.loader = None
+        self._epochs_without_improvement = 0
+
+    def on_epoch_ended(self) -> None:
+        """Subclass hook: finalize epoch stats, update improved flag."""
+
+    def run(self) -> None:
+        loader = self.loader
+        self.improved.value = False
+        self.epoch_ended.value = False
+        self.accumulate_minibatch()
+        if loader.epoch_ended:
+            self.on_epoch_ended()
+            self.epoch_ended.value = True
+            if self.improved:
+                self._epochs_without_improvement = 0
+            else:
+                self._epochs_without_improvement += 1
+            epochs_done = loader.epoch_number + 1
+            if self.max_epochs is not None and epochs_done >= self.max_epochs:
+                self.complete.value = True
+            if self._epochs_without_improvement >= self.fail_iterations:
+                self.info("no improvement for %d epochs — stopping",
+                          self._epochs_without_improvement)
+                self.complete.value = True
+
+    def accumulate_minibatch(self) -> None:
+        raise NotImplementedError
+
+
+class DecisionGD(DecisionBase):
+    """Classification decision driven by ``EvaluatorSoftmax.n_err``
+    (reference: ``DecisionGD``)."""
+
+    SNAPSHOT_ATTRS = ("epoch_n_err", "epoch_n_err_pt",
+                      "min_validation_n_err", "min_validation_n_err_pt",
+                      "min_train_n_err", "_epochs_without_improvement")
+
+    def __init__(self, workflow, name: str | None = None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.evaluator = None  # linked: needs .n_err
+        self.epoch_n_err = [0, 0, 0]          # running, current epoch
+        self.epoch_n_err_pt = [100.0, 100.0, 100.0]
+        self.min_validation_n_err = None
+        self.min_validation_n_err_pt = 100.0
+        self.min_train_n_err = None
+
+    def accumulate_minibatch(self) -> None:
+        # per-class accumulation happens ON DEVICE in the evaluator
+        # (one host sync per epoch, not per step — see evaluator.py)
+        pass
+
+    def on_epoch_ended(self) -> None:
+        loader = self.loader
+        acc: Vector = self.evaluator.epoch_n_err
+        acc.map_read()
+        self.epoch_n_err = [int(x) for x in acc.mem]
+        acc.map_invalidate()
+        acc.mem[...] = 0  # uploaded on the next region fire
+        for cls in range(3):
+            length = loader.class_lengths[cls]
+            if length:
+                self.epoch_n_err_pt[cls] = \
+                    100.0 * self.epoch_n_err[cls] / length
+        has_valid = loader.class_lengths[VALID] > 0
+        n_err = self.epoch_n_err[VALID if has_valid else TRAIN]
+        best = (self.min_validation_n_err if has_valid
+                else self.min_train_n_err)
+        if best is None or n_err < best:
+            if has_valid:
+                self.min_validation_n_err = n_err
+                self.min_validation_n_err_pt = self.epoch_n_err_pt[VALID]
+            else:
+                self.min_train_n_err = n_err
+            self.improved.value = True
+        self.info(
+            "epoch %d: %s", loader.epoch_number,
+            "  ".join(f"{CLASS_NAME[c]} err {self.epoch_n_err[c]} "
+                      f"({self.epoch_n_err_pt[c]:.2f}%)"
+                      for c in range(3) if loader.class_lengths[c]))
+        self.epoch_n_err = [0, 0, 0]
+
+
+class DecisionMSE(DecisionBase):
+    """Regression/autoencoder decision driven by
+    ``EvaluatorMSE.metrics`` (reference: ``DecisionMSE``)."""
+
+    SNAPSHOT_ATTRS = ("epoch_sse", "epoch_mse", "min_validation_mse",
+                      "min_train_mse", "_epochs_without_improvement")
+
+    def __init__(self, workflow, name: str | None = None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.evaluator = None
+        self.epoch_sse = [0.0, 0.0, 0.0]
+        self.epoch_mse = [np.inf, np.inf, np.inf]
+        self.min_validation_mse = None
+        self.min_train_mse = None
+
+    def accumulate_minibatch(self) -> None:
+        pass  # accumulated on device (evaluator.epoch_sse)
+
+    def on_epoch_ended(self) -> None:
+        loader = self.loader
+        acc: Vector = self.evaluator.epoch_sse
+        acc.map_read()
+        self.epoch_sse = [float(x) for x in acc.mem]
+        acc.map_invalidate()
+        acc.mem[...] = 0
+        for cls in range(3):
+            length = loader.class_lengths[cls]
+            if length:
+                self.epoch_mse[cls] = self.epoch_sse[cls] / length
+        has_valid = loader.class_lengths[VALID] > 0
+        mse = self.epoch_mse[VALID if has_valid else TRAIN]
+        best = self.min_validation_mse if has_valid else self.min_train_mse
+        if best is None or mse < best:
+            if has_valid:
+                self.min_validation_mse = mse
+            else:
+                self.min_train_mse = mse
+            self.improved.value = True
+        self.info(
+            "epoch %d: %s", loader.epoch_number,
+            "  ".join(f"{CLASS_NAME[c]} mse {self.epoch_mse[c]:.6f}"
+                      for c in range(3) if loader.class_lengths[c]))
+        self.epoch_sse = [0.0, 0.0, 0.0]

@@ -1,0 +1,172 @@
+"""Evaluators: turn network output + ground truth into the backward
+chain's seed error and host-readable quality metrics
+(reference: ``znicz/evaluator.py``).
+
+``EvaluatorSoftmax`` consumes the softmax output and emits
+
+- ``err_output = (p − onehot(t)) / n_valid`` — the combined
+  softmax+cross-entropy derivative w.r.t. the logits, masked over
+  padded tail samples (static-shape minibatches, see loader);
+- ``n_err`` — mispredictions among valid samples (device scalar the
+  Decision unit reads per step);
+- ``confusion_matrix`` — optional (n_classes², accumulated per epoch
+  host-side by Decision).
+
+``EvaluatorMSE`` serves regression / autoencoder targets:
+``err_output = (y − target)·2/n_valid`` and per-step summed squared
+error.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.accelerated_units import AcceleratedUnit
+from znicz_tpu.loader.base import TRAIN
+from znicz_tpu.memory import Vector
+
+
+class EvaluatorBase(AcceleratedUnit):
+    def __init__(self, workflow, name: str | None = None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.output: Vector | None = None        # link from last forward
+        self.minibatch_valid: Vector | None = None  # link from loader
+        self.err_output = Vector(name=f"{self.name}.err_output")
+
+    def _valid_mask(self, xp, n_rows):
+        valid = self.minibatch_valid.devmem if xp is jnp \
+            else self.minibatch_valid.mem
+        return (xp.arange(n_rows) < valid), valid
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Softmax cross-entropy evaluator (reference:
+    ``EvaluatorSoftmax``)."""
+
+    def __init__(self, workflow, name: str | None = None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.labels: Vector | None = None      # link from loader
+        self.max_idx: Vector | None = None     # link from All2AllSoftmax
+        self.minibatch_class = TRAIN           # usually linked from loader
+        self.n_err = Vector(name=f"{self.name}.n_err")
+        # per-class error counts for the WHOLE epoch, accumulated on
+        # device so Decision syncs host-side once per epoch instead of
+        # once per step (a TPU-first change: the per-step device→host
+        # scalar fetch dominated step time through the PJRT tunnel)
+        self.epoch_n_err = Vector(name=f"{self.name}.epoch_n_err")
+
+    def region_key(self) -> tuple:
+        # minibatch_class indexes the on-device accumulator statically
+        return (int(self.minibatch_class),)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.output is None or not self.output:
+            raise AttributeError(f"{self}: output not linked yet")
+        self.err_output.reset(np.zeros(self.output.shape, dtype=np.float32))
+        self.n_err.reset(np.zeros((), dtype=np.int32))
+        if not self.epoch_n_err:
+            self.epoch_n_err.reset(np.zeros(3, dtype=np.int32))
+        self.init_vectors(self.err_output, self.n_err, self.epoch_n_err,
+                          self.output, self.labels, self.max_idx,
+                          self.minibatch_valid)
+
+    @property
+    def n_classes(self) -> int:
+        return self.output.shape[1]
+
+    def numpy_run(self) -> None:
+        for vec in (self.output, self.labels, self.max_idx,
+                    self.minibatch_valid):
+            vec.map_read()
+        p = self.output.mem
+        t = self.labels.mem
+        mask, valid = self._valid_mask(np, p.shape[0])
+        onehot = np.zeros_like(p)
+        onehot[np.arange(p.shape[0]), t] = 1.0
+        self.err_output.map_invalidate()
+        self.err_output.mem[...] = (
+            mask[:, None] * (p - onehot) / max(int(valid), 1))
+        self.n_err.map_invalidate()
+        n_err = int(np.sum((self.max_idx.mem != t) & mask))
+        self.n_err.mem[...] = n_err
+        self.epoch_n_err.map_write()
+        self.epoch_n_err.mem[int(self.minibatch_class)] += n_err
+
+    def xla_run(self) -> None:
+        p = self.output.devmem
+        t = self.labels.devmem
+        mask, valid = self._valid_mask(jnp, p.shape[0])
+        onehot = jax_onehot(t, p.shape[1], p.dtype)
+        denom = jnp.maximum(valid, 1).astype(p.dtype)
+        self.err_output.devmem = mask[:, None] * (p - onehot) / denom
+        n_err = jnp.sum((self.max_idx.devmem != t) & mask).astype(jnp.int32)
+        self.n_err.devmem = n_err
+        self.epoch_n_err.devmem = self.epoch_n_err.devmem.at[
+            int(self.minibatch_class)].add(n_err)
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """Mean-squared-error evaluator for regression / autoencoders
+    (reference: ``EvaluatorMSE``)."""
+
+    def __init__(self, workflow, name: str | None = None,
+                 root_metric: bool = True, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.target: Vector | None = None  # link from loader
+        self.minibatch_class = TRAIN       # usually linked from loader
+        self.metrics = Vector(name=f"{self.name}.metrics")  # summed sq err
+        self.epoch_sse = Vector(name=f"{self.name}.epoch_sse")
+
+    def region_key(self) -> tuple:
+        return (int(self.minibatch_class),)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.output is None or not self.output:
+            raise AttributeError(f"{self}: output not linked yet")
+        self.err_output.reset(np.zeros(self.output.shape, dtype=np.float32))
+        self.metrics.reset(np.zeros((), dtype=np.float32))
+        if not self.epoch_sse:
+            self.epoch_sse.reset(np.zeros(3, dtype=np.float32))
+        self.init_vectors(self.err_output, self.metrics, self.epoch_sse,
+                          self.output, self.target, self.minibatch_valid)
+
+    def numpy_run(self) -> None:
+        for vec in (self.output, self.target, self.minibatch_valid):
+            vec.map_read()
+        y = self.output.mem
+        batch = y.shape[0]
+        t = self.target.mem.reshape(batch, -1).astype(np.float32)
+        y2 = y.reshape(batch, -1)
+        mask, valid = self._valid_mask(np, batch)
+        diff = mask[:, None] * (y2 - t)
+        self.err_output.map_invalidate()
+        self.err_output.mem[...] = (
+            diff * (2.0 / max(int(valid), 1))).reshape(y.shape)
+        self.metrics.map_invalidate()
+        sse = np.sum(diff * diff)
+        self.metrics.mem[...] = sse
+        self.epoch_sse.map_write()
+        self.epoch_sse.mem[int(self.minibatch_class)] += sse
+
+    def xla_run(self) -> None:
+        y = self.output.devmem
+        batch = y.shape[0]
+        t = self.target.devmem.reshape(batch, -1).astype(y.dtype)
+        y2 = y.reshape(batch, -1)
+        mask, valid = self._valid_mask(jnp, batch)
+        diff = mask[:, None] * (y2 - t)
+        denom = jnp.maximum(valid, 1).astype(y.dtype)
+        self.err_output.devmem = (diff * (2.0 / denom)).reshape(y.shape)
+        sse = jnp.sum(diff * diff)
+        self.metrics.devmem = sse
+        self.epoch_sse.devmem = self.epoch_sse.devmem.at[
+            int(self.minibatch_class)].add(sse)
+
+
+def jax_onehot(labels, n_classes: int, dtype):
+    return (labels[:, None] ==
+            jnp.arange(n_classes)[None, :]).astype(dtype)

@@ -1,0 +1,248 @@
+"""NN base classes: Forward, GradientDescentBase, fwd↔bwd pairing.
+
+Rebuilds the reference's ``znicz/nn_units.py``:
+
+- :class:`Forward` — base of all forward units: ``input`` (linked),
+  ``output``, ``weights``, ``bias`` Vectors; weight-init fill schemes;
+- :class:`GradientDescentBase` — base of all backward units:
+  ``err_output`` (from the next unit / evaluator), ``err_input`` (to
+  the previous one), shared ``weights``/``bias``, learning rate,
+  momentum (``gradient_moment``), L1/L2 decay (``weights_decay``,
+  ``l1_vs_l2``), and momentum accumulators;
+- the ``MatchingObject`` pairing: backward classes declare
+  ``MATCHES = (ForwardClass, …)`` and a registry lets
+  ``StandardWorkflow`` auto-build the backward chain
+  (reference: the ``MatchingObject`` metaclass).
+
+TPU-first deltas:
+
+- weights are stored ``(in_features, out_features)`` so the forward
+  GEMM is ``x @ W`` with no transpose (the reference stored
+  ``(out, in)`` for its OpenCL tiles; XLA prefers plain layouts and
+  fuses the rest);
+- the parameter update runs on device inside the jit region, and the
+  gradient is folded across the data-parallel mesh axis with
+  ``lax.pmean`` exactly where the reference called
+  ``generate_data_for_master``/``apply_data_from_slave``
+  (see :mod:`znicz_tpu.parallel`).
+"""
+
+from __future__ import annotations
+
+from typing import Type
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.accelerated_units import AcceleratedUnit
+from znicz_tpu.memory import Vector
+from znicz_tpu.parallel.axis import maybe_pmean
+from znicz_tpu.utils import prng
+
+
+# ----------------------------------------------------------------------
+# fwd ↔ bwd pairing registry (reference: MatchingObject metaclass)
+# ----------------------------------------------------------------------
+_GD_FOR_FORWARD: dict[type, type] = {}
+
+
+class MatchingObject(type):
+    """Metaclass registering backward units against their forwards via
+    a ``MATCHES`` tuple on the backward class."""
+
+    def __init__(cls, name, bases, namespace) -> None:
+        super().__init__(name, bases, namespace)
+        for fwd_cls in namespace.get("MATCHES", ()):
+            _GD_FOR_FORWARD[fwd_cls] = cls
+
+
+def gd_for(forward_cls: type) -> Type["GradientDescentBase"]:
+    """The backward class paired with ``forward_cls`` (walks the MRO so
+    subclasses inherit their parent's pairing unless they override)."""
+    for klass in forward_cls.__mro__:
+        gd = _GD_FOR_FORWARD.get(klass)
+        if gd is not None:
+            return gd
+    raise KeyError(f"no gradient unit registered for {forward_cls.__name__}")
+
+
+# ----------------------------------------------------------------------
+# Forward base
+# ----------------------------------------------------------------------
+class Forward(AcceleratedUnit):
+    """Base forward unit (reference: ``znicz/nn_units.py`` Forward).
+
+    Subclasses set ``self.output`` from ``self.input`` in their run
+    methods; parameters live in ``weights``/``bias`` Vectors shared
+    with the paired backward unit.
+    """
+
+    def __init__(self, workflow, name: str | None = None,
+                 weights_filling: str = "uniform",
+                 weights_stddev: float | None = None,
+                 bias_filling: str = "uniform",
+                 bias_stddev: float | None = None,
+                 include_bias: bool = True,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.input: Vector | None = None  # usually replaced by link_attrs
+        self.output = Vector(name=f"{self.name}.output")
+        self.weights = Vector(name=f"{self.name}.weights")
+        self.bias = Vector(name=f"{self.name}.bias")
+        self.weights_filling = weights_filling
+        self.weights_stddev = weights_stddev
+        self.bias_filling = bias_filling
+        self.bias_stddev = bias_stddev
+        self.include_bias = include_bias
+
+    # -- weight init ----------------------------------------------------
+    def fill_array(self, arr_shape, filling: str, stddev: float | None,
+                   fan_in: int) -> np.ndarray:
+        gen = prng.get()
+        if stddev is None:
+            stddev = 1.0 / max(1.0, np.sqrt(fan_in))
+        if filling == "uniform":
+            return gen.fill_uniform(arr_shape, -stddev, stddev,
+                                    dtype=np.float32)
+        if filling == "gaussian":
+            return gen.fill_normal(arr_shape, 0.0, stddev, dtype=np.float32)
+        if filling == "constant":
+            return np.full(arr_shape, stddev, dtype=np.float32)
+        raise ValueError(f"unknown filling '{filling}'")
+
+    @property
+    def current_batch(self) -> int:
+        return self.input.shape[0]
+
+
+# ----------------------------------------------------------------------
+# GradientDescent base
+# ----------------------------------------------------------------------
+class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
+    """Base backward unit (reference: ``znicz/nn_units.py``
+    GradientDescentBase).
+
+    Update rule (matching the reference's momentum + L1/L2 decay):
+
+    .. code-block:: text
+
+        g   = dL/dW + weights_decay·((1−l1_vs_l2)·W + ½·l1_vs_l2·sign(W))
+        acc = gradient_moment·acc − learning_rate·g
+        W  += acc
+
+    In data-parallel runs ``dL/dW`` is ``pmean``-folded over the
+    ``data`` mesh axis before the update — the synchronous SPMD
+    replacement for the reference's master-side gradient fold.
+    """
+
+    MATCHES: tuple = ()
+
+    def __init__(self, workflow, name: str | None = None,
+                 learning_rate: float = 0.01,
+                 learning_rate_bias: float | None = None,
+                 weights_decay: float = 0.0,
+                 weights_decay_bias: float = 0.0,
+                 l1_vs_l2: float = 0.0,
+                 gradient_moment: float = 0.0,
+                 gradient_moment_bias: float | None = None,
+                 need_err_input: bool = True,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.learning_rate = learning_rate
+        self.learning_rate_bias = (learning_rate if learning_rate_bias is None
+                                   else learning_rate_bias)
+        self.weights_decay = weights_decay
+        self.weights_decay_bias = weights_decay_bias
+        self.l1_vs_l2 = l1_vs_l2
+        self.gradient_moment = gradient_moment
+        self.gradient_moment_bias = (gradient_moment
+                                     if gradient_moment_bias is None
+                                     else gradient_moment_bias)
+        self.need_err_input = need_err_input
+        # linked from the paired forward unit by StandardWorkflow:
+        self.input: Vector | None = None
+        self.output: Vector | None = None
+        self.weights: Vector | None = None
+        self.bias: Vector | None = None
+        # linked from the next backward unit / evaluator:
+        self.err_output: Vector | None = None
+        self.err_input = Vector(name=f"{self.name}.err_input")
+        # momentum slots
+        self.accumulated_gradient_weights = Vector(
+            name=f"{self.name}.acc_grad_w")
+        self.accumulated_gradient_bias = Vector(
+            name=f"{self.name}.acc_grad_b")
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.gradient_moment or self.gradient_moment_bias:
+            if self.weights is not None and self.weights:
+                self.accumulated_gradient_weights.reset(
+                    np.zeros(self.weights.shape, dtype=np.float32))
+            if (self.bias is not None and self.bias
+                    and self.gradient_moment_bias):
+                self.accumulated_gradient_bias.reset(
+                    np.zeros(self.bias.shape, dtype=np.float32))
+            self.init_vectors(self.accumulated_gradient_weights,
+                              self.accumulated_gradient_bias)
+
+    # -- shared update math (xp = np or jnp) ----------------------------
+    def _regularized(self, xp, grad, weights, decay: float):
+        if not decay:
+            return grad
+        l1 = self.l1_vs_l2
+        reg = (1.0 - l1) * weights
+        if l1:
+            reg = reg + 0.5 * l1 * xp.sign(weights)
+        return grad + decay * reg
+
+    def _apply_weights_np(self, grad_w: np.ndarray) -> None:
+        w = self.weights.mem
+        g = self._regularized(np, grad_w, w, self.weights_decay)
+        if self.gradient_moment:
+            acc = self.accumulated_gradient_weights.mem
+            acc *= self.gradient_moment
+            acc -= self.learning_rate * g
+            w += acc
+        else:
+            w -= self.learning_rate * g
+
+    def _apply_bias_np(self, grad_b: np.ndarray) -> None:
+        if self.bias is None or not self.bias:
+            return
+        b = self.bias.mem
+        g = self._regularized(np, grad_b, b, self.weights_decay_bias)
+        if self.gradient_moment_bias:
+            acc = self.accumulated_gradient_bias.mem
+            acc *= self.gradient_moment_bias
+            acc -= self.learning_rate_bias * g
+            b += acc
+        else:
+            b -= self.learning_rate_bias * g
+
+    def _apply_weights_xla(self, grad_w) -> None:
+        grad_w = maybe_pmean(grad_w)
+        w = self.weights.devmem
+        g = self._regularized(jnp, grad_w, w, self.weights_decay)
+        if self.gradient_moment:
+            acc = self.accumulated_gradient_weights.devmem
+            acc = self.gradient_moment * acc - self.learning_rate * g
+            self.accumulated_gradient_weights.devmem = acc
+            self.weights.devmem = w + acc
+        else:
+            self.weights.devmem = w - self.learning_rate * g
+
+    def _apply_bias_xla(self, grad_b) -> None:
+        if self.bias is None or not self.bias:
+            return
+        grad_b = maybe_pmean(grad_b)
+        b = self.bias.devmem
+        g = self._regularized(jnp, grad_b, b, self.weights_decay_bias)
+        if self.gradient_moment_bias:
+            acc = self.accumulated_gradient_bias.devmem
+            acc = self.gradient_moment_bias * acc - self.learning_rate_bias * g
+            self.accumulated_gradient_bias.devmem = acc
+            self.bias.devmem = b + acc
+        else:
+            self.bias.devmem = b - self.learning_rate_bias * g

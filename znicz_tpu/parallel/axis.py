@@ -1,0 +1,54 @@
+"""Mesh-axis context: lets unit math be written once and run either
+single-device or under ``shard_map``/``pjit`` over a named mesh axis.
+
+The backward units call :func:`maybe_pmean` on their weight gradients —
+outside a mapped context it is the identity, inside it becomes an ICI
+all-reduce.  This is the exact seam where the reference's master–slave
+gradient fold lived (reference: ``GradientDescentBase.
+generate_data_for_master`` / master ``apply_data_from_slave``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from contextvars import ContextVar
+
+import jax
+
+#: canonical axis names; keep stable so TP/PP can be added without
+#: breaking DP configs (SURVEY.md §2.5: name axes now, build DP only).
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+_active_data_axis: ContextVar[str | None] = ContextVar(
+    "znicz_tpu_data_axis", default=None)
+
+
+def current_data_axis() -> str | None:
+    return _active_data_axis.get()
+
+
+@contextlib.contextmanager
+def data_axis(name: str | None = DATA_AXIS):
+    """Declare that enclosed traces run under a mapped ``data`` axis."""
+    token = _active_data_axis.set(name)
+    try:
+        yield
+    finally:
+        _active_data_axis.reset(token)
+
+
+def maybe_pmean(x):
+    """All-reduce-mean over the data axis when inside one; else identity."""
+    axis = _active_data_axis.get()
+    if axis is None:
+        return x
+    return jax.lax.pmean(x, axis_name=axis)
+
+
+def maybe_psum(x):
+    """All-reduce-sum over the data axis when inside one; else identity."""
+    axis = _active_data_axis.get()
+    if axis is None:
+        return x
+    return jax.lax.psum(x, axis_name=axis)

@@ -1,0 +1,42 @@
+"""Mesh construction and sharding helpers.
+
+The recipe (scaling-book style): pick a mesh, annotate shardings on the
+batch and (replicated) parameters, let XLA insert the collectives, and
+keep collectives on ICI by making the ``data`` axis span the pod slice.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from znicz_tpu.parallel.axis import DATA_AXIS, MODEL_AXIS
+
+
+def make_mesh(n_data: int | None = None, n_model: int = 1,
+              devices=None) -> Mesh:
+    """Build a (data, model) mesh over the available devices.
+
+    ``n_data=None`` uses all devices on the data axis — the DP layout
+    matching the reference's capability (its only scale-out strategy
+    was data parallelism, SURVEY.md §2.5).
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_data is None:
+        n_data = len(devices) // n_model
+    use = n_data * n_model
+    grid = np.asarray(devices[:use]).reshape(n_data, n_model)
+    return Mesh(grid, axis_names=(DATA_AXIS, MODEL_AXIS))
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Shard the leading (batch) dim over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated (parameters, scalars)."""
+    return NamedSharding(mesh, P())

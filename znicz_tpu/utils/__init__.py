@@ -1,0 +1,5 @@
+"""Services layer: config tree, logging, seeded PRNG, snapshots, timing.
+
+Rebuilds the reference's L6 services (reference: ``veles/config.py``,
+``veles/logger.py``, ``veles/prng/``, ``veles/snapshotter.py``).
+"""
