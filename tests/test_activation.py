@@ -1,0 +1,89 @@
+"""Standalone activation units fwd+bwd across backends (reference
+pattern: unit tests over ``znicz/activation.py``)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops import activation
+
+PAIRS = [
+    (activation.ForwardTanh, activation.BackwardTanh),
+    (activation.ForwardRELU, activation.BackwardRELU),
+    (activation.ForwardStrictRELU, activation.BackwardStrictRELU),
+    (activation.ForwardSigmoid, activation.BackwardSigmoid),
+    (activation.ForwardLog, activation.BackwardLog),
+]
+
+RNG = np.random.default_rng(51)
+X = RNG.normal(size=(6, 9)).astype(np.float32)
+ERR = RNG.normal(size=(6, 9)).astype(np.float32)
+
+
+def build_pair(fwd_cls, gd_cls, device, **fkw):
+    wf = DummyWorkflow()
+    src = DummyUnit(wf, output=Vector(X.copy(), name="x"))
+    fwd = fwd_cls(wf, **fkw)
+    fwd.link_attrs(src, ("input", "output"))
+    fwd.initialize(device=device)
+    err_src = DummyUnit(wf, err=Vector(ERR.copy(), name="err"))
+    bwd = gd_cls(wf)
+    bwd.forward_unit = fwd
+    bwd.link_attrs(fwd, "input", "output")
+    bwd.link_attrs(err_src, ("err_output", "err"))
+    bwd.initialize(device=device)
+    return fwd, bwd
+
+
+@pytest.mark.parametrize("fwd_cls,gd_cls", PAIRS)
+def test_backend_agreement(fwd_cls, gd_cls):
+    outs = {}
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        fwd, bwd = build_pair(fwd_cls, gd_cls, device)
+        fwd.run()
+        bwd.run()
+        fwd.output.map_read()
+        bwd.err_input.map_read()
+        outs[f"{name}_y"] = fwd.output.mem.copy()
+        outs[f"{name}_e"] = bwd.err_input.mem.copy()
+    np.testing.assert_allclose(outs["np_y"], outs["xla_y"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs["np_e"], outs["xla_e"],
+                               rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.parametrize("fwd_cls,gd_cls", PAIRS)
+def test_numeric_derivative(fwd_cls, gd_cls):
+    device = NumpyDevice()
+    fwd, bwd = build_pair(fwd_cls, gd_cls, device)
+    fwd.run()
+    bwd.run()
+    eps = 1e-3
+
+    def y_of(x):
+        wf = DummyWorkflow()
+        src = DummyUnit(wf, output=Vector(x, name="x"))
+        f = fwd_cls(wf)
+        f.link_attrs(src, ("input", "output"))
+        f.initialize(device=device)
+        f.run()
+        return f.output.mem.copy()
+
+    numeric = (y_of(X + eps) - y_of(X - eps)) / (2 * eps)
+    np.testing.assert_allclose(bwd.err_input.mem, ERR * numeric,
+                               rtol=5e-3, atol=1e-4)
+
+
+def test_forward_mul():
+    for device in (NumpyDevice(), XLADevice()):
+        fwd, bwd = build_pair(activation.ForwardMul,
+                              activation.BackwardMul, device, factor=2.5)
+        fwd.run()
+        bwd.run()
+        fwd.output.map_read()
+        bwd.err_input.map_read()
+        np.testing.assert_allclose(fwd.output.mem, X * 2.5, rtol=1e-6)
+        np.testing.assert_allclose(bwd.err_input.mem, ERR * 2.5,
+                                   rtol=1e-6)

@@ -1,0 +1,71 @@
+"""Dropout fwd+bwd semantics per backend (reference pattern:
+``znicz/tests/unit/test_dropout.py``).  RNG streams differ across
+backends by design; invariants are statistical + structural."""
+
+import numpy as np
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops import dropout
+
+RNG = np.random.default_rng(71)
+X = RNG.normal(size=(64, 32)).astype(np.float32) + 3.0
+ERR = RNG.normal(size=(64, 32)).astype(np.float32)
+
+
+def build_pair(device, ratio=0.5):
+    wf = DummyWorkflow()
+    src = DummyUnit(wf, output=Vector(X.copy(), name="x"))
+    fwd = dropout.DropoutForward(wf, dropout_ratio=ratio)
+    fwd.link_attrs(src, ("input", "output"))
+    fwd.initialize(device=device)
+    err_src = DummyUnit(wf, err=Vector(ERR.copy(), name="err"))
+    bwd = dropout.DropoutBackward(wf)
+    bwd.forward_unit = fwd
+    bwd.link_attrs(fwd, "input", "output")
+    bwd.link_attrs(err_src, ("err_output", "err"))
+    bwd.initialize(device=device)
+    return fwd, bwd
+
+
+def test_train_mode_masks_and_scales():
+    for device in (NumpyDevice(), XLADevice()):
+        fwd, bwd = build_pair(device, ratio=0.4)
+        fwd.run()
+        bwd.run()
+        fwd.output.map_read()
+        fwd.mask.map_read()
+        bwd.err_input.map_read()
+        y, m = fwd.output.mem, fwd.mask.mem
+        # mask values are 0 or 1/keep; output = x*mask; bwd masks err
+        keep = 0.6
+        uniq = np.unique(m)
+        assert all(np.isclose(v, 0.0) or np.isclose(v, 1 / keep)
+                   for v in uniq)
+        np.testing.assert_allclose(y, X * m, rtol=1e-6)
+        np.testing.assert_allclose(bwd.err_input.mem, ERR * m, rtol=1e-6)
+        # statistical: drop fraction near the ratio
+        drop_frac = float((m == 0).mean())
+        assert abs(drop_frac - 0.4) < 0.05
+        # inverted dropout keeps the expectation
+        assert abs(y.mean() - X.mean()) < 0.15
+
+
+def test_eval_mode_is_identity():
+    for device in (NumpyDevice(), XLADevice()):
+        fwd, bwd = build_pair(device)
+        fwd.forward_mode = "eval"
+        fwd.run()
+        bwd.run()
+        fwd.output.map_read()
+        bwd.err_input.map_read()
+        np.testing.assert_allclose(fwd.output.mem, X, rtol=1e-6)
+        np.testing.assert_allclose(bwd.err_input.mem, ERR, rtol=1e-6)
+
+
+def test_bad_ratio_rejected():
+    import pytest
+    wf = DummyWorkflow()
+    with pytest.raises(ValueError):
+        dropout.DropoutForward(wf, dropout_ratio=1.0)

@@ -1,0 +1,148 @@
+"""Pooling fwd+bwd: numpy offset-recording oracle vs XLA
+reduce_window/scatter paths (reference pattern:
+``znicz/tests/unit/test_pooling.py`` + ``test_gd_pooling.py``)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops import gd_pooling, pooling
+
+RNG = np.random.default_rng(41)
+X = RNG.normal(size=(3, 7, 7, 4)).astype(np.float32)
+
+FWD_BWD = [
+    (pooling.MaxPooling, gd_pooling.GDMaxPooling),
+    (pooling.MaxAbsPooling, gd_pooling.GDMaxAbsPooling),
+    (pooling.AvgPooling, gd_pooling.GDAvgPooling),
+]
+GEOMS = [dict(kx=2, ky=2), dict(kx=3, ky=3, sliding=(2, 2)),
+         dict(kx=2, ky=3, sliding=(1, 2))]
+
+
+def build_pair(fwd_cls, gd_cls, device, err=None, **geom):
+    wf = DummyWorkflow()
+    src = DummyUnit(wf, output=Vector(X.copy(), name="x"))
+    fwd = fwd_cls(wf, **geom)
+    fwd.link_attrs(src, ("input", "output"))
+    fwd.initialize(device=device)
+    bwd = None
+    if gd_cls is not None:
+        err_src = DummyUnit(wf, err=Vector(err.copy(), name="err"))
+        bwd = gd_cls(wf)
+        bwd.forward_unit = fwd
+        bwd.link_attrs(fwd, "input", "output")
+        bwd.link_attrs(err_src, ("err_output", "err"))
+        bwd.initialize(device=device)
+    return fwd, bwd
+
+
+@pytest.mark.parametrize("fwd_cls,gd_cls", FWD_BWD)
+@pytest.mark.parametrize("geom", GEOMS)
+def test_fwd_bwd_numpy_xla_agreement(fwd_cls, gd_cls, geom):
+    probe, _ = build_pair(fwd_cls, None, NumpyDevice(), **geom)
+    err = np.random.default_rng(8).normal(
+        size=probe.output.shape).astype(np.float32)
+    outs = {}
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        fwd, bwd = build_pair(fwd_cls, gd_cls, device, err, **geom)
+        fwd.run()
+        bwd.run()
+        fwd.output.map_read()
+        bwd.err_input.map_read()
+        outs[f"{name}_out"] = fwd.output.mem.copy()
+        outs[f"{name}_err"] = bwd.err_input.mem.copy()
+    np.testing.assert_allclose(outs["np_out"], outs["xla_out"],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs["np_err"], outs["xla_err"],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_max_pooling_golden():
+    wf = DummyWorkflow()
+    x = np.array([[1, 2, 5, 6], [3, 4, 7, 8],
+                  [-9, 1, 0, 1], [2, -3, 1, 0]],
+                 dtype=np.float32).reshape(1, 4, 4, 1)
+    src = DummyUnit(wf, output=Vector(x, name="x"))
+    unit = pooling.MaxPooling(wf, kx=2, ky=2)
+    unit.link_attrs(src, ("input", "output"))
+    unit.initialize(device=NumpyDevice())
+    unit.run()
+    np.testing.assert_array_equal(
+        unit.output.mem.reshape(2, 2), [[4, 8], [2, 1]])
+
+
+def test_maxabs_keeps_sign():
+    wf = DummyWorkflow()
+    x = np.array([[1, -5], [2, 3]], dtype=np.float32).reshape(1, 2, 2, 1)
+    src = DummyUnit(wf, output=Vector(x, name="x"))
+    unit = pooling.MaxAbsPooling(wf, kx=2, ky=2)
+    unit.link_attrs(src, ("input", "output"))
+    unit.initialize(device=NumpyDevice())
+    unit.run()
+    assert unit.output.mem.reshape(()) == -5.0  # signed extremum
+
+
+def test_avg_pooling_truncated_window_counts():
+    """7→4 windows with stride 2, k=2: the tail window has 1 column —
+    mean must divide by the true count, both backends."""
+    outs = {}
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        fwd, _ = build_pair(pooling.AvgPooling, None, device,
+                            kx=2, ky=2, sliding=(2, 2))
+        fwd.run()
+        fwd.output.map_read()
+        outs[name] = fwd.output.mem.copy()
+    np.testing.assert_allclose(outs["np"], outs["xla"],
+                               rtol=1e-5, atol=1e-6)
+    # golden: bottom-right output = mean of the single corner element
+    np.testing.assert_allclose(outs["np"][:, -1, -1, :], X[:, 6, 6, :],
+                               rtol=1e-6)
+
+
+def test_stochastic_pooling_train_distribution_and_bwd():
+    """Stochastic RNG streams differ across backends by design
+    (SURVEY.md §2.3): assert per-backend self-consistency — sampled
+    values come from the window, bwd scatters to the sampled slot."""
+    err = None
+    for device in (NumpyDevice(), XLADevice()):
+        fwd, _ = build_pair(pooling.StochasticPooling, None, device,
+                            kx=2, ky=2)
+        if err is None:
+            err = np.random.default_rng(8).normal(
+                size=fwd.output.shape).astype(np.float32)
+        fwd, bwd = build_pair(pooling.StochasticPooling,
+                              gd_pooling.GDStochasticPooling,
+                              device, err, kx=2, ky=2)
+        fwd.run()
+        bwd.run()
+        fwd.output.map_read()
+        fwd.last_choice.map_read()
+        bwd.err_input.map_read()
+        out = fwd.output.mem
+        for oy, ox, y0, y1, x0, x1 in fwd._windows(7, 7):
+            win = fwd.full_window(X, y0, y1, x0, x1)
+            win0 = np.where(np.isfinite(win), win, 0.0)
+            chosen = np.take_along_axis(
+                win0, fwd.last_choice.mem[:, oy, ox, None, :],
+                axis=1)[:, 0]
+            np.testing.assert_allclose(out[:, oy, ox, :], chosen,
+                                       rtol=1e-6)
+        # bwd: total scattered error equals total incoming error
+        np.testing.assert_allclose(bwd.err_input.mem.sum(), err.sum(),
+                                   rtol=1e-4)
+
+
+def test_stochastic_pooling_eval_deterministic_agreement():
+    outs = {}
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        fwd, _ = build_pair(pooling.StochasticPooling, None, device,
+                            kx=2, ky=2)
+        fwd.forward_mode = "eval"
+        fwd.run()
+        fwd.output.map_read()
+        outs[name] = fwd.output.mem.copy()
+    np.testing.assert_allclose(outs["np"], outs["xla"],
+                               rtol=1e-5, atol=1e-6)

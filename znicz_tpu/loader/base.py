@@ -175,6 +175,13 @@ class Loader(AcceleratedUnit):
             self.minibatch_indices.unmap()
             self.minibatch_valid.unmap()
 
+    @property
+    def forward_mode(self) -> str:
+        """"train" on train minibatches, else "eval" — linked (one-way)
+        into stochastic units (dropout, stochastic pooling) so their
+        region variants track the current minibatch class."""
+        return "train" if self.minibatch_class == TRAIN else "eval"
+
     # stats ------------------------------------------------------------
     def class_minibatch_count(self, cls: int) -> int:
         return sum(1 for c, _, _ in self._schedule if c == cls)

@@ -32,8 +32,9 @@ from znicz_tpu.accelerated_units import AcceleratedWorkflow, RegionUnit
 from znicz_tpu.backends import NumpyDevice
 from znicz_tpu.loader.base import TRAIN, Loader
 from znicz_tpu.mutable import Bool
-from znicz_tpu.ops import all2all  # noqa: F401  (registers layer types)
-from znicz_tpu.ops import gd  # noqa: F401  (registers gradient pairs)
+from znicz_tpu.ops import activation, all2all, conv, dropout, pooling
+from znicz_tpu.ops import normalization
+from znicz_tpu.ops import gd, gd_conv, gd_pooling  # noqa: F401 (pairs)
 from znicz_tpu.ops.decision import DecisionGD, DecisionMSE
 from znicz_tpu.ops.evaluator import EvaluatorMSE, EvaluatorSoftmax
 from znicz_tpu.ops.nn_units import Forward, gd_for
@@ -64,6 +65,23 @@ for _name, _cls in {
     "all2all_str": all2all.All2AllStrictRELU,
     "all2all_sigmoid": all2all.All2AllSigmoid,
     "softmax": all2all.All2AllSoftmax,
+    "conv": conv.Conv,
+    "conv_tanh": conv.ConvTanh,
+    "conv_relu": conv.ConvRELU,
+    "conv_str": conv.ConvStrictRELU,
+    "conv_sigmoid": conv.ConvSigmoid,
+    "max_pooling": pooling.MaxPooling,
+    "maxabs_pooling": pooling.MaxAbsPooling,
+    "avg_pooling": pooling.AvgPooling,
+    "stochastic_pooling": pooling.StochasticPooling,
+    "norm": normalization.LRNormalizerForward,
+    "dropout": dropout.DropoutForward,
+    "activation_tanh": activation.ForwardTanh,
+    "activation_relu": activation.ForwardRELU,
+    "activation_str": activation.ForwardStrictRELU,
+    "activation_sigmoid": activation.ForwardSigmoid,
+    "activation_log": activation.ForwardLog,
+    "activation_mul": activation.ForwardMul,
 }.items():
     register_layer_type(_name, _cls)
 
@@ -125,6 +143,9 @@ class StandardWorkflow(AcceleratedWorkflow):
                 unit.link_attrs(self.loader, ("input", "minibatch_data"))
             else:
                 unit.link_attrs(prev, ("input", "output"))
+            if "forward_mode" in unit.__dict__:  # stochastic units track
+                unit.link_attrs(self.loader, "forward_mode",
+                                two_way=False)  # the minibatch class
             self.forwards.append(unit)
             prev = unit
 
@@ -160,6 +181,7 @@ class StandardWorkflow(AcceleratedWorkflow):
             cls = gd_for(type(fwd))
             unit = cls(self, need_err_input=(i != len(self.forwards) - 1),
                        **spec.get("<-", {}))
+            unit.forward_unit = fwd  # geometry/mask/activation source
             unit.link_attrs(fwd, "input", "output", "weights", "bias")
             if next_gd is None:
                 unit.link_attrs(self.evaluator, "err_output")

@@ -1,0 +1,172 @@
+"""Standalone activation units (reference: ``znicz/activation.py`` —
+``ForwardTanh``/``ForwardRELU``/``ForwardStrictRELU``/``ForwardSigmoid``
+/``ForwardLog``/``ForwardMul`` and their ``Backward*`` mirrors), for
+when an activation is not fused into All2All/Conv.
+
+On TPU these are pure elementwise jnp ops the jit region fuses into
+the neighboring GEMM/conv — no HBM round-trip (SURVEY.md §2.3:
+"jnp elementwise, XLA fuses")."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.ops import activations_math
+from znicz_tpu.ops.nn_units import Forward, GradientDescentBase
+
+
+class ActivationForward(Forward):
+    """Weightless elementwise forward ``y = act(x)``."""
+
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, name=None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.activation = activations_math.get(self.ACTIVATION)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        self.output.reset(np.zeros(self.input.shape, dtype=np.float32))
+        self.init_vectors(self.input, self.output)
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = self.activation.fwd(
+            np, self.input.mem.astype(np.float32))
+
+    def xla_run(self) -> None:
+        self.output.devmem = self.activation.fwd(jnp, self.input.devmem)
+
+
+class ActivationBackward(GradientDescentBase):
+    """Weightless backward ``err_input = err_output ⊙ act'``."""
+
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, name=None, **kwargs):
+        kwargs.pop("learning_rate", None)
+        super().__init__(workflow, name=name, **kwargs)
+        self.activation = activations_math.get(self.ACTIVATION)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if not self.err_input:
+            self.err_input.reset(np.zeros(self.input.shape,
+                                          dtype=np.float32))
+        super().initialize(device=device, **kwargs)
+        self.init_vectors(self.err_input, self.err_output, self.input,
+                          self.output)
+
+    def numpy_run(self) -> None:
+        for vec in (self.err_output, self.output):
+            vec.map_read()
+        x = None
+        if self.activation.needs_input:
+            self.input.map_read()
+            x = self.input.mem
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = (
+            self.err_output.mem
+            * self.activation.derivative(np, self.output.mem, x))
+
+    def xla_run(self) -> None:
+        x = self.input.devmem if self.activation.needs_input else None
+        self.err_input.devmem = (
+            self.err_output.devmem
+            * self.activation.derivative(jnp, self.output.devmem, x))
+
+
+class ForwardTanh(ActivationForward):
+    ACTIVATION = "tanh"
+
+
+class BackwardTanh(ActivationBackward):
+    ACTIVATION = "tanh"
+    MATCHES = (ForwardTanh,)
+
+
+class ForwardRELU(ActivationForward):
+    ACTIVATION = "relu"
+
+
+class BackwardRELU(ActivationBackward):
+    ACTIVATION = "relu"
+    MATCHES = (ForwardRELU,)
+
+
+class ForwardStrictRELU(ActivationForward):
+    ACTIVATION = "strict_relu"
+
+
+class BackwardStrictRELU(ActivationBackward):
+    ACTIVATION = "strict_relu"
+    MATCHES = (ForwardStrictRELU,)
+
+
+class ForwardSigmoid(ActivationForward):
+    ACTIVATION = "sigmoid"
+
+
+class BackwardSigmoid(ActivationBackward):
+    ACTIVATION = "sigmoid"
+    MATCHES = (ForwardSigmoid,)
+
+
+class ForwardLog(ActivationForward):
+    ACTIVATION = "log"
+
+
+class BackwardLog(ActivationBackward):
+    ACTIVATION = "log"
+    MATCHES = (ForwardLog,)
+
+
+class ForwardMul(ActivationForward):
+    """Scale by a constant factor (reference: ``ForwardMul``)."""
+
+    def __init__(self, workflow, factor: float = 1.0, name=None,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.factor = float(factor)
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = self.input.mem * self.factor
+
+    def xla_run(self) -> None:
+        self.output.devmem = self.input.devmem * self.factor
+
+
+class BackwardMul(GradientDescentBase):
+    MATCHES = (ForwardMul,)
+
+    def __init__(self, workflow, name=None, **kwargs):
+        kwargs.pop("learning_rate", None)
+        super().__init__(workflow, name=name, **kwargs)
+        self.forward_unit: ForwardMul | None = None
+
+    def initialize(self, device=None, **kwargs) -> None:
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if not self.err_input:
+            self.err_input.reset(np.zeros(self.input.shape,
+                                          dtype=np.float32))
+        super().initialize(device=device, **kwargs)
+        self.init_vectors(self.err_input, self.err_output)
+
+    def numpy_run(self) -> None:
+        self.err_output.map_read()
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = (self.err_output.mem
+                                   * self.forward_unit.factor)
+
+    def xla_run(self) -> None:
+        self.err_input.devmem = (self.err_output.devmem
+                                 * self.forward_unit.factor)

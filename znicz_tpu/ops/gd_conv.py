@@ -1,0 +1,104 @@
+"""Convolution backward units (reference: ``znicz/gd_conv.py``).
+
+The reference hand-wrote col2im scatter + GEMM kernels.  TPU-first,
+the XLA path applies ``jax.vjp`` to the forward unit's pure function —
+exactly XLA's conv transpose rules (SURVEY.md §2.3: "lax.conv
+transpose rules / autodiff"), fused into the jit region.  The numpy
+oracle is the explicit im2col/col2im math, independently implemented,
+so the vjp path is *tested against* the reference-style computation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from znicz_tpu.ops.conv import (
+    Conv,
+    ConvRELU,
+    ConvSigmoid,
+    ConvStrictRELU,
+    ConvTanh,
+    col2im,
+    im2col,
+)
+from znicz_tpu.ops.nn_units import GradientDescentBase
+
+
+class GradientDescentConv(GradientDescentBase):
+    MATCHES = (Conv,)
+
+    def __init__(self, workflow, name=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.forward_unit: Conv | None = None  # set by link_gds
+
+    def initialize(self, device=None, **kwargs) -> None:
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if self.need_err_input and not self.err_input:
+            self.err_input.reset(np.zeros(self.input.shape,
+                                          dtype=np.float32))
+        super().initialize(device=device, **kwargs)
+        self.init_vectors(self.err_input, self.err_output, self.input,
+                          self.output, self.weights, self.bias)
+
+    # -- numpy oracle: explicit col2im/GEMM -----------------------------
+    def numpy_run(self) -> None:
+        fwd = self.forward_unit
+        for vec in (self.err_output, self.input, self.output):
+            vec.map_read()
+        self.weights.map_write()
+        x = self.input.mem.astype(np.float32)
+        w = self.weights.mem
+        n = x.shape[0]
+        y = self.output.mem
+        delta = self.err_output.mem * fwd.activation.derivative(
+            np, y, None)  # conv activations are output-expressed
+        oh, ow, k = delta.shape[1:]
+        delta2d = delta.reshape(-1, k)
+        cols = im2col(x, fwd.ky, fwd.kx, *fwd.sliding, fwd.padding)
+        cols2d = cols.reshape(-1, cols.shape[-1])
+        grad_w = (cols2d.T @ delta2d).reshape(w.shape)
+        if self.need_err_input:
+            err_cols = (delta2d @ w.reshape(-1, k).T).reshape(cols.shape)
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = col2im(
+                err_cols, x.shape, fwd.ky, fwd.kx, *fwd.sliding,
+                fwd.padding)
+        self._apply_weights_np(grad_w)
+        if self.bias is not None and self.bias:
+            self.bias.map_write()
+            self._apply_bias_np(delta2d.sum(axis=0))
+
+    # -- XLA path: vjp of the forward's pure function -------------------
+    def xla_run(self) -> None:
+        fwd = self.forward_unit
+        x = self.input.devmem
+        w = self.weights.devmem
+        has_bias = self.bias is not None and self.bias
+        b = self.bias.devmem if has_bias else None
+        _, vjp = jax.vjp(lambda xx, ww, bb: fwd.xla_forward(xx, ww, bb),
+                         x, w, b)
+        grad_x, grad_w, grad_b = vjp(self.err_output.devmem)
+        if self.need_err_input:
+            self.err_input.devmem = grad_x
+        self._apply_weights_xla(grad_w)
+        if has_bias:
+            self._apply_bias_xla(grad_b)
+
+
+class GDTanhConv(GradientDescentConv):
+    MATCHES = (ConvTanh,)
+
+
+class GDRELUConv(GradientDescentConv):
+    MATCHES = (ConvRELU,)
+
+
+class GDStrictRELUConv(GradientDescentConv):
+    MATCHES = (ConvStrictRELU,)
+
+
+class GDSigmoidConv(GradientDescentConv):
+    MATCHES = (ConvSigmoid,)

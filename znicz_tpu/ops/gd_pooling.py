@@ -1,0 +1,197 @@
+"""Pooling backward units (reference: ``znicz/gd_pooling.py``).
+
+The reference scattered errors to recorded winner offsets with custom
+kernels.  Here the numpy oracle recomputes winners with ``argmax`` and
+scatters explicitly; the XLA path builds the same scatter from a
+static ``ky×kx`` unroll of strided ``.at[].add`` updates (XLA fuses
+these into one scatter program inside the jit region) — equivalent to
+``lax.select_and_scatter_add`` but shared across all four pooling
+flavors, including the |x| and stochastic selections that
+``reduce_window``'s autodiff cannot express.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.ops.nn_units import GradientDescentBase
+from znicz_tpu.ops.pooling import (
+    AvgPooling,
+    MaxAbsPooling,
+    MaxPooling,
+    Pooling,
+    StochasticPooling,
+)
+
+
+class GDPoolingBase(GradientDescentBase):
+    """Weightless backward: transforms err_output → err_input."""
+
+    def __init__(self, workflow, name=None, **kwargs):
+        kwargs.pop("learning_rate", None)  # weightless; tolerate configs
+        super().__init__(workflow, name=name, **kwargs)
+        self.forward_unit: Pooling | None = None
+
+    def initialize(self, device=None, **kwargs) -> None:
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if not self.err_input:
+            self.err_input.reset(np.zeros(self.input.shape,
+                                          dtype=np.float32))
+        super().initialize(device=device, **kwargs)
+        self.init_vectors(self.err_input, self.err_output, self.input,
+                          self.output)
+
+    # -- shared geometry helpers ---------------------------------------
+    def _stack_windows(self, x):
+        """jnp: (n, oh, ow, ky*kx, c) with -inf marking out-of-range."""
+        fwd = self.forward_unit
+        n, h, w, c = x.shape
+        oh, ow = fwd.output_spatial(h, w)
+        sy, sx = fwd.sliding
+        ph, pw = fwd._pad_hw(h, w)
+        xp = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)),
+                     constant_values=-jnp.inf)
+        return jnp.stack([
+            xp[:, i:i + (oh - 1) * sy + 1:sy,
+               j:j + (ow - 1) * sx + 1:sx, :]
+            for i in range(fwd.ky) for j in range(fwd.kx)], axis=3)
+
+    def _scatter_windows(self, err_wins, x_shape):
+        """jnp inverse of _stack_windows: (n,oh,ow,ky*kx,c) → NHWC."""
+        fwd = self.forward_unit
+        n, h, w, c = x_shape
+        oh, ow = fwd.output_spatial(h, w)
+        sy, sx = fwd.sliding
+        ph, pw = fwd._pad_hw(h, w)
+        out = jnp.zeros((n, h + ph, w + pw, c), err_wins.dtype)
+        e = 0
+        for i in range(fwd.ky):
+            for j in range(fwd.kx):
+                out = out.at[:, i:i + (oh - 1) * sy + 1:sy,
+                             j:j + (ow - 1) * sx + 1:sx, :].add(
+                    err_wins[:, :, :, e, :])
+                e += 1
+        return out[:, :h, :w, :]
+
+    def _numpy_scatter(self, pick_idx) -> None:
+        """Oracle: scatter err to the winner offsets (reference's
+        recorded-offset semantics)."""
+        fwd = self.forward_unit
+        x = self.input.mem
+        n, h, w, c = x.shape
+        err = self.err_output.mem
+        self.err_input.map_invalidate()
+        out = self.err_input.mem
+        out[...] = 0.0
+        for oy, ox, y0, y1, x0, x1 in fwd._windows(h, w):
+            win = x[:, y0:y1, x0:x1, :].reshape(n, -1, c)
+            idx = pick_idx(win, oy, ox)
+            wh, ww = y1 - y0, x1 - x0
+            iy = y0 + idx // ww
+            ix = x0 + idx % ww
+            bi = np.arange(n)[:, None]
+            ci = np.arange(c)[None, :]
+            np.add.at(out, (bi, iy, ix, ci), err[:, oy, ox, :])
+
+
+class GDMaxPooling(GDPoolingBase):
+    MATCHES = (MaxPooling,)
+    _use_abs = False
+
+    def numpy_run(self) -> None:
+        for vec in (self.err_output, self.input):
+            vec.map_read()
+
+        def pick(win, oy, ox):
+            key = np.abs(win) if self._use_abs else win
+            return key.argmax(axis=1)
+
+        self._numpy_scatter(pick)
+
+    def xla_run(self) -> None:
+        x = self.input.devmem
+        wins = self._stack_windows(x)
+        key = jnp.abs(wins) if self._use_abs else wins
+        # out-of-range cells are -inf; under abs they must still lose
+        key = jnp.where(jnp.isfinite(wins), key, -jnp.inf)
+        idx = key.argmax(axis=3)
+        onehot = (jnp.arange(wins.shape[3])[None, None, None, :, None]
+                  == idx[:, :, :, None, :])
+        err_wins = onehot * self.err_output.devmem[:, :, :, None, :]
+        self.err_input.devmem = self._scatter_windows(
+            err_wins.astype(x.dtype), x.shape)
+
+
+class GDMaxAbsPooling(GDMaxPooling):
+    MATCHES = (MaxAbsPooling,)
+    _use_abs = True
+
+
+class GDAvgPooling(GDPoolingBase):
+    MATCHES = (AvgPooling,)
+
+    def numpy_run(self) -> None:
+        fwd = self.forward_unit
+        for vec in (self.err_output, self.input):
+            vec.map_read()
+        x = self.input.mem
+        n, h, w, c = x.shape
+        err = self.err_output.mem
+        self.err_input.map_invalidate()
+        out = self.err_input.mem
+        out[...] = 0.0
+        for oy, ox, y0, y1, x0, x1 in fwd._windows(h, w):
+            count = (y1 - y0) * (x1 - x0)
+            out[:, y0:y1, x0:x1, :] += \
+                err[:, oy, ox, None, None, :].reshape(n, 1, 1, c) / count
+
+    def xla_run(self) -> None:
+        x = self.input.devmem
+        wins = self._stack_windows(x)
+        valid = jnp.isfinite(wins)
+        counts = valid.sum(axis=3, keepdims=True).astype(x.dtype)
+        err_wins = (valid * self.err_output.devmem[:, :, :, None, :]
+                    / jnp.maximum(counts, 1.0))
+        self.err_input.devmem = self._scatter_windows(
+            err_wins.astype(x.dtype), x.shape)
+
+
+class GDStochasticPooling(GDPoolingBase):
+    """Scatter to the element sampled at forward time (recorded in
+    ``last_choice`` by both backends)."""
+
+    MATCHES = (StochasticPooling,)
+
+    def numpy_run(self) -> None:
+        fwd = self.forward_unit
+        for vec in (self.err_output, self.input):
+            vec.map_read()
+        fwd.last_choice.map_read()
+        choice = fwd.last_choice.mem  # FULL-window coordinates
+        x = self.input.mem
+        n, h, w, c = x.shape
+        err = self.err_output.mem
+        self.err_input.map_invalidate()
+        out = self.err_input.mem
+        out[...] = 0.0
+        bi = np.arange(n)[:, None]
+        ci = np.arange(c)[None, :]
+        for oy, ox, y0, y1, x0, x1 in fwd._windows(h, w):
+            idx = choice[:, oy, ox, :]
+            iy = y0 + idx // fwd.kx
+            ix = x0 + idx % fwd.kx
+            np.add.at(out, (bi, iy, ix, ci), err[:, oy, ox, :])
+
+    def xla_run(self) -> None:
+        fwd = self.forward_unit
+        x = self.input.devmem
+        k = fwd.ky * fwd.kx
+        idx = fwd.last_choice.devmem
+        onehot = (jnp.arange(k)[None, None, None, :, None]
+                  == idx[:, :, :, None, :])
+        err_wins = onehot * self.err_output.devmem[:, :, :, None, :]
+        self.err_input.devmem = self._scatter_windows(
+            err_wins.astype(x.dtype), x.shape)

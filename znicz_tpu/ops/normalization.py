@@ -1,0 +1,116 @@
+"""Local response normalization, AlexNet-style across-channel
+(reference: ``znicz/normalization.py`` — ``LRNormalizerForward`` /
+``LRNormalizerBackward``).
+
+.. code-block:: text
+
+    d_i = (k + α·Σ_{j∈window(i)} x_j²)        (window = n channels)
+    y_i = x_i · d_i^{−β}
+
+Defaults match the reference/AlexNet: α=1e-4, β=0.75, k=2, n=5.
+
+The backward unit uses the exact analytic gradient (implemented for
+the numpy oracle) and ``jax.vjp`` of the forward for the XLA path —
+XLA fuses the whole thing into the jit region, which benchmarking in
+the reference survey flags as the right first choice before reaching
+for a Pallas kernel (SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.ops.nn_units import Forward, GradientDescentBase
+
+
+def _window_sum(xp, arr, n: int):
+    """Sliding sum of size ``n`` (centered, truncated) over the LAST
+    (channel) axis."""
+    c = arr.shape[-1]
+    half = n // 2
+    padded = xp.concatenate(
+        [xp.zeros(arr.shape[:-1] + (half,), arr.dtype), arr,
+         xp.zeros(arr.shape[:-1] + (half,), arr.dtype)], axis=-1)
+    out = xp.zeros_like(arr)
+    for off in range(n):
+        out = out + padded[..., off:off + c]
+    return out
+
+
+class LRNormalizerForward(Forward):
+    """Across-channel LRN (weightless forward)."""
+
+    def __init__(self, workflow, alpha: float = 1e-4, beta: float = 0.75,
+                 k: float = 2.0, n: int = 5, name=None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.k = float(k)
+        self.n = int(n)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        self.output.reset(np.zeros(self.input.shape, dtype=np.float32))
+        self.init_vectors(self.input, self.output)
+
+    def _forward(self, xp, x):
+        d = self.k + self.alpha * _window_sum(xp, x * x, self.n)
+        return x * d ** (-self.beta)
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = self._forward(np, self.input.mem)
+
+    def xla_run(self) -> None:
+        self.output.devmem = self._forward(jnp, self.input.devmem)
+
+
+class LRNormalizerBackward(GradientDescentBase):
+    MATCHES = (LRNormalizerForward,)
+
+    def __init__(self, workflow, name=None, **kwargs):
+        kwargs.pop("learning_rate", None)  # weightless
+        super().__init__(workflow, name=name, **kwargs)
+        self.forward_unit: LRNormalizerForward | None = None
+
+    def initialize(self, device=None, **kwargs) -> None:
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if not self.err_input:
+            self.err_input.reset(np.zeros(self.input.shape,
+                                          dtype=np.float32))
+        super().initialize(device=device, **kwargs)
+        self.init_vectors(self.err_input, self.err_output, self.input,
+                          self.output)
+
+    def numpy_run(self) -> None:
+        """Analytic gradient (the oracle/spec):
+
+        dy_i/dx_j = δ_ij·d_i^{−β} − 2αβ·x_i·x_j·d_i^{−β−1}·[j∈win(i)]
+        """
+        fwd = self.forward_unit
+        for vec in (self.err_output, self.input):
+            vec.map_read()
+        x = self.input.mem.astype(np.float32)
+        err = self.err_output.mem
+        d = fwd.k + fwd.alpha * _window_sum(np, x * x, fwd.n)
+        dmb = d ** (-fwd.beta)
+        # t_i = err_i · x_i · d_i^{−β−1}; err_input_j gets
+        # −2αβ·x_j·Σ_{i: j∈win(i)} t_i  (window symmetric → same sum op)
+        t = err * x * d ** (-fwd.beta - 1.0)
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = (
+            err * dmb - 2.0 * fwd.alpha * fwd.beta * x
+            * _window_sum(np, t, fwd.n))
+
+    def xla_run(self) -> None:
+        fwd = self.forward_unit
+        _, vjp = jax.vjp(lambda xx: fwd._forward(jnp, xx),
+                         self.input.devmem)
+        (self.err_input.devmem,) = vjp(self.err_output.devmem)

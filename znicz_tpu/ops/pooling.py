@@ -1,0 +1,269 @@
+"""Pooling forward units (reference: ``znicz/pooling.py``).
+
+Reference semantics preserved:
+
+- :class:`MaxAbsPooling` — the reference's ``MaxPooling`` picked the
+  element with the largest **absolute** value, keeping its sign, and
+  recorded the winner offset for the backward scatter;
+  :class:`MaxPooling` here is the plain max variant.
+- :class:`AvgPooling` — window mean.
+- :class:`StochasticPooling` — samples a window element with
+  probability proportional to its (positive) value at train time
+  (reference: stochastic pooling with on-device PRNG).
+
+TPU-first: the XLA path is ``lax.reduce_window`` (and a
+``jax.random``-driven gather for stochastic pooling); backward units
+(``gd_pooling.py``) use the vjp transpose —
+``select_and_scatter``-style — instead of recorded offsets
+(SURVEY.md §2.3: "max-offsets ... or recompute-in-bwd").  The numpy
+oracle records winner offsets exactly like the reference, so the test
+suite proves the two formulations agree.
+
+Window geometry: ``kx``/``ky`` + ``sliding``; inputs NHWC.  Edge
+windows are truncated (the reference padded the tail window; we use
+-inf/0 padding through ``reduce_window`` which matches truncation for
+max/avg given the count normalization below).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.accelerated_units import AcceleratedUnit
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops.nn_units import Forward
+
+
+class Pooling(Forward):
+    """Base pooling unit (weightless Forward)."""
+
+    def __init__(self, workflow, kx: int, ky: int, sliding=None,
+                 name=None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.kx, self.ky = int(kx), int(ky)
+        if sliding is None:
+            sliding = (self.ky, self.kx)  # reference default: no overlap
+        self.sliding = (int(sliding[0]), int(sliding[1]))
+
+    def output_spatial(self, h: int, w: int) -> tuple[int, int]:
+        sy, sx = self.sliding
+        # ceil-div: tail windows are truncated (reference behavior)
+        return (-(-(h - self.ky) // sy) + 1 if h > self.ky else 1,
+                -(-(w - self.kx) // sx) + 1 if w > self.kx else 1)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        n, h, w, c = self.input.shape
+        oh, ow = self.output_spatial(h, w)
+        self.output.reset(np.zeros((n, oh, ow, c), dtype=np.float32))
+        self.init_vectors(self.input, self.output)
+        self._setup()
+
+    def _setup(self) -> None:
+        pass
+
+    # shared window iteration for the numpy oracle
+    def _windows(self, h: int, w: int):
+        sy, sx = self.sliding
+        oh, ow = self.output_spatial(h, w)
+        for oy in range(oh):
+            y0 = oy * sy
+            for ox in range(ow):
+                x0 = ox * sx
+                yield (oy, ox, y0, min(y0 + self.ky, h),
+                       x0, min(x0 + self.kx, w))
+
+    def _pad_hw(self, h: int, w: int) -> tuple[int, int]:
+        """reduce_window low/high padding so XLA covers the same
+        (truncated-at-the-tail) windows as the oracle."""
+        sy, sx = self.sliding
+        oh, ow = self.output_spatial(h, w)
+        need_h = (oh - 1) * sy + self.ky
+        need_w = (ow - 1) * sx + self.kx
+        return need_h - h, need_w - w
+
+
+class MaxPooling(Pooling):
+    """Plain max pooling."""
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        x = self.input.mem
+        n, h, w, c = x.shape
+        self.output.map_invalidate()
+        out = self.output.mem
+        for oy, ox, y0, y1, x0, x1 in self._windows(h, w):
+            out[:, oy, ox, :] = x[:, y0:y1, x0:x1, :].max(axis=(1, 2))
+
+    def xla_forward(self, x):
+        ph, pw = self._pad_hw(x.shape[1], x.shape[2])
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, self.ky, self.kx, 1),
+            window_strides=(1, *self.sliding, 1),
+            padding=((0, 0), (0, ph), (0, pw), (0, 0)))
+
+    def xla_run(self) -> None:
+        self.output.devmem = self.xla_forward(self.input.devmem)
+
+
+class MaxAbsPooling(Pooling):
+    """Largest-|x| element, sign preserved (the reference's
+    ``MaxPooling`` semantics — AlexNet-era CNNs with tanh need the
+    signed extremum)."""
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        x = self.input.mem
+        n, h, w, c = x.shape
+        self.output.map_invalidate()
+        out = self.output.mem
+        for oy, ox, y0, y1, x0, x1 in self._windows(h, w):
+            win = x[:, y0:y1, x0:x1, :].reshape(n, -1, c)
+            idx = np.abs(win).argmax(axis=1)
+            out[:, oy, ox, :] = np.take_along_axis(
+                win, idx[:, None, :], axis=1)[:, 0, :]
+
+    def xla_forward(self, x):
+        ph, pw = self._pad_hw(x.shape[1], x.shape[2])
+
+        def select(a, b):
+            return jnp.where(jnp.abs(a) >= jnp.abs(b), a, b)
+
+        return jax.lax.reduce_window(
+            x, jnp.zeros((), x.dtype), select,
+            window_dimensions=(1, self.ky, self.kx, 1),
+            window_strides=(1, *self.sliding, 1),
+            padding=((0, 0), (0, ph), (0, pw), (0, 0)))
+
+    def xla_run(self) -> None:
+        self.output.devmem = self.xla_forward(self.input.devmem)
+
+
+class AvgPooling(Pooling):
+    """Window mean (truncated tail windows divide by the true count)."""
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        x = self.input.mem
+        n, h, w, c = x.shape
+        self.output.map_invalidate()
+        out = self.output.mem
+        for oy, ox, y0, y1, x0, x1 in self._windows(h, w):
+            out[:, oy, ox, :] = x[:, y0:y1, x0:x1, :].mean(axis=(1, 2))
+
+    def xla_forward(self, x):
+        ph, pw = self._pad_hw(x.shape[1], x.shape[2])
+        sums = jax.lax.reduce_window(
+            x, jnp.zeros((), x.dtype), jax.lax.add,
+            window_dimensions=(1, self.ky, self.kx, 1),
+            window_strides=(1, *self.sliding, 1),
+            padding=((0, 0), (0, ph), (0, pw), (0, 0)))
+        counts = jax.lax.reduce_window(
+            jnp.ones(x.shape[1:3], x.dtype), jnp.zeros((), x.dtype),
+            jax.lax.add,
+            window_dimensions=(self.ky, self.kx),
+            window_strides=self.sliding,
+            padding=((0, ph), (0, pw)))
+        return sums / counts[None, :, :, None]
+
+    def xla_run(self) -> None:
+        self.output.devmem = self.xla_forward(self.input.devmem)
+
+
+class StochasticPooling(Pooling):
+    """Train: sample ∝ max(x,0) within the window (uniform over the
+    window when all values ≤ 0); eval: probability-weighted average
+    (reference: ``StochasticPooling``).  ``forward_mode`` ("train" /
+    "eval") is a static region key."""
+
+    def __init__(self, workflow, kx, ky, sliding=None, name=None,
+                 **kwargs) -> None:
+        super().__init__(workflow, kx, ky, sliding=sliding, name=name,
+                         **kwargs)
+        self.forward_mode = "train"
+        self.last_choice = Vector(name=f"{self.name}.last_choice")
+
+    def region_key(self) -> tuple:
+        return (self.forward_mode,)
+
+    def _setup(self) -> None:
+        self.init_rng()
+        n, oh, ow, c = self.output.shape
+        self.last_choice.reset(np.zeros((n, oh, ow, c), dtype=np.int32))
+        self.init_vectors(self.last_choice)
+
+    def full_window(self, x: np.ndarray, y0, y1, x0, x1) -> np.ndarray:
+        """(n, ky*kx, c) window padded with -inf at out-of-range cells
+        so indices are in FULL window coordinates on both backends."""
+        n, _, _, c = x.shape
+        win = np.full((n, self.ky, self.kx, c), -np.inf, dtype=x.dtype)
+        win[:, :y1 - y0, :x1 - x0, :] = x[:, y0:y1, x0:x1, :]
+        return win.reshape(n, self.ky * self.kx, c)
+
+    def numpy_run(self) -> None:
+        from znicz_tpu.utils import prng
+        self.input.map_read()
+        x = self.input.mem
+        n, h, w, c = x.shape
+        self.output.map_invalidate()
+        self.last_choice.map_invalidate()
+        out = self.output.mem
+        choice = self.last_choice.mem
+        rnd = prng.get().numpy
+        for oy, ox, y0, y1, x0, x1 in self._windows(h, w):
+            win = self.full_window(x, y0, y1, x0, x1)
+            valid = np.isfinite(win)
+            win0 = np.where(valid, win, 0.0)
+            pos = np.maximum(win0, 0.0) * valid
+            total = pos.sum(axis=1, keepdims=True)
+            kcnt = valid.sum(axis=1, keepdims=True).astype(x.dtype)
+            uniform = valid.astype(x.dtype) / np.maximum(kcnt, 1.0)
+            p = np.where(total > 0,
+                         pos / np.where(total > 0, total, 1.0), uniform)
+            if self.forward_mode == "train":
+                cum = p.cumsum(axis=1)
+                r = rnd.uniform(size=(n, 1, c))
+                idx = (r > cum).sum(axis=1)
+                out[:, oy, ox, :] = np.take_along_axis(
+                    win0, idx[:, None, :], axis=1)[:, 0, :]
+                choice[:, oy, ox, :] = idx
+            else:
+                out[:, oy, ox, :] = (p * win0).sum(axis=1)
+
+    def xla_run(self) -> None:
+        x = self.input.devmem
+        n, h, w, c = x.shape
+        oh, ow = self.output_spatial(h, w)
+        sy, sx = self.sliding
+        ph, pw = self._pad_hw(h, w)
+        xp_pad = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)),
+                         constant_values=-jnp.inf)
+        # gather every window: (n, oh, ow, ky*kx, c)
+        wins = jnp.stack([
+            xp_pad[:, i:i + (oh - 1) * sy + 1:sy,
+                   j:j + (ow - 1) * sx + 1:sx, :]
+            for i in range(self.ky) for j in range(self.kx)], axis=3)
+        valid = jnp.isfinite(wins)
+        wins0 = jnp.where(valid, wins, 0.0)
+        pos = jnp.maximum(wins0, 0.0) * valid
+        total = pos.sum(axis=3, keepdims=True)
+        kcnt = valid.sum(axis=3, keepdims=True).astype(x.dtype)
+        uniform = valid.astype(x.dtype) / jnp.maximum(kcnt, 1.0)
+        probs = jnp.where(total > 0, pos / jnp.where(total > 0, total, 1.0),
+                          uniform)
+        if self.forward_mode == "train":
+            key = self.take_key()
+            r = jax.random.uniform(key, (n, oh, ow, 1, c), dtype=x.dtype)
+            cum = jnp.cumsum(probs, axis=3)
+            idx = (r > cum).sum(axis=3)
+            self.last_choice.devmem = idx.astype(jnp.int32)
+            self.output.devmem = jnp.take_along_axis(
+                wins0, idx[:, :, :, None, :], axis=3)[:, :, :, 0, :]
+        else:
+            self.output.devmem = (probs * wins0).sum(axis=3)
