@@ -1,0 +1,67 @@
+"""Benchmark: AlexNet training throughput (images/sec/chip).
+
+North star (BASELINE.json): stock ImageNet AlexNet StandardWorkflow at
+≥8000 images/sec on a TPU v4-32 ⇒ 250 images/sec/chip.  This bench
+runs the full training step (loader gather → forwards → softmax CE →
+backward chain → SGD update, one fused XLA program) on one chip with
+synthetic ImageNet-geometry data and reports
+
+    {"metric": "alexnet_train_images_per_sec_per_chip",
+     "value": <img/s>, "unit": "images/sec/chip",
+     "vs_baseline": <img/s ÷ 250>}
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BATCH = int(os.environ.get("BENCH_BATCH", "128"))
+WARMUP_STEPS = 6
+TIMED_STEPS = 30
+BASELINE_IMG_PER_SEC_PER_CHIP = 250.0  # 8000 img/s ÷ 32 chips (v4-32)
+
+
+def main() -> None:
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.models.samples import alexnet
+
+    wf = alexnet.build(
+        minibatch_size=BATCH,
+        n_train_samples=8 * BATCH,
+        n_valid_samples=0,  # pure train steps for steady-state timing
+        max_epochs=10 ** 6)
+    wf.initialize(device=XLADevice())
+    assert wf._region_unit is not None
+    region = wf._region_unit
+
+    def step():
+        wf.loader.run()
+        region.run()
+
+    for _ in range(WARMUP_STEPS):
+        step()
+    wf.forwards[-1].weights.devmem.block_until_ready()
+
+    start = time.perf_counter()
+    for _ in range(TIMED_STEPS):
+        step()
+    wf.forwards[-1].weights.devmem.block_until_ready()
+    elapsed = time.perf_counter() - start
+
+    img_per_sec = TIMED_STEPS * BATCH / elapsed
+    print(json.dumps({
+        "metric": "alexnet_train_images_per_sec_per_chip",
+        "value": round(img_per_sec, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC_PER_CHIP,
+                             4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

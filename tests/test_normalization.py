@@ -28,10 +28,14 @@ def build_pair(device, **kw):
     return fwd, bwd
 
 
-def test_backend_agreement():
+import pytest
+
+
+@pytest.mark.parametrize("n", [3, 4, 5])  # even n: asymmetric window,
+def test_backend_agreement(n):           # regression for the adjoint
     outs = {}
     for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
-        fwd, bwd = build_pair(device, alpha=1e-3, beta=0.75, k=2.0, n=5)
+        fwd, bwd = build_pair(device, alpha=1e-3, beta=0.75, k=2.0, n=n)
         fwd.run()
         bwd.run()
         fwd.output.map_read()

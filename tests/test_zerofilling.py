@@ -1,0 +1,26 @@
+"""ZeroFiller side-unit semantics (reference:
+``znicz/weights_zerofilling.py``)."""
+
+import numpy as np
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops.weights_zerofilling import ZeroFiller
+
+
+def test_masks_weights_on_both_backends():
+    for device in (NumpyDevice(), XLADevice()):
+        wf = DummyWorkflow()
+        w = Vector(np.ones((4, 4), dtype=np.float32), name="w")
+        host = DummyUnit(wf, weights=w)
+        zf = ZeroFiller(wf)
+        zf.link_attrs(host, ("target_weights", "weights"))
+        zf.initialize(device=device)
+        mask = np.ones((4, 4), dtype=np.float32)
+        mask[::2, ::2] = 0.0
+        zf.zero_mask.reset(mask)
+        zf.zero_mask.initialize(device)
+        zf.run()
+        w.map_read()
+        np.testing.assert_allclose(w.mem, mask)

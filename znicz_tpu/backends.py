@@ -59,7 +59,7 @@ class Device(Logger):
                          f"(expected xla | tpu | numpy)")
 
     # transfer API used by Vector -------------------------------------
-    def put(self, arr: np.ndarray):
+    def put(self, arr: np.ndarray, vector=None):
         raise NotImplementedError
 
     def get(self, devarr) -> np.ndarray:
@@ -75,7 +75,7 @@ class NumpyDevice(Device):
     backend = "numpy"
     is_host_only = True
 
-    def put(self, arr: np.ndarray) -> np.ndarray:
+    def put(self, arr: np.ndarray, vector=None) -> np.ndarray:
         return arr
 
     def get(self, devarr) -> np.ndarray:
@@ -98,23 +98,57 @@ class XLADevice(Device):
     backend = "xla"
     platform: str | None = None  # subclass pin; None = jax default
 
-    def __init__(self, device: "jax.Device | None" = None, **kwargs) -> None:
+    def __init__(self, device: "jax.Device | None" = None,
+                 mesh: "jax.sharding.Mesh | None" = None, **kwargs) -> None:
         super().__init__(**kwargs)
+        #: when set, this device is SPMD over the mesh: batch-major
+        #: Vectors are sharded over the 'data' axis, everything else is
+        #: replicated, and XLA inserts the ICI collectives — the TPU
+        #: replacement for the reference's master–slave cluster
+        #: (reference: veles/server.py / veles/client.py; SURVEY.md §2.5)
+        self.mesh = mesh
         if device is None:
-            devices = (jax.devices(self.platform) if self.platform
-                       else jax.devices())
-            device = devices[0]
+            if mesh is not None:
+                device = mesh.devices.flat[0]
+            else:
+                devices = (jax.devices(self.platform) if self.platform
+                           else jax.devices())
+                device = devices[0]
         self.jax_device = device
         self.compute_dtype = np.dtype(
             root.common.get("precision_type", "float32"))
         level = int(root.common.get("precision_level", 0))
         self.matmul_precision = _PRECISION_BY_LEVEL.get(level, "default")
-        self.debug("XLA device %s (platform=%s, dtype=%s, precision=%s)",
-                   device, device.platform, self.compute_dtype,
-                   self.matmul_precision)
+        self.debug("XLA device %s (platform=%s, dtype=%s, precision=%s, "
+                   "mesh=%s)", device, device.platform, self.compute_dtype,
+                   self.matmul_precision,
+                   None if mesh is None else dict(mesh.shape))
 
-    def put(self, arr: np.ndarray):
-        return jax.device_put(arr, self.jax_device)
+    @property
+    def n_data_shards(self) -> int:
+        from znicz_tpu.parallel.axis import DATA_AXIS
+        return 1 if self.mesh is None else self.mesh.shape[DATA_AXIS]
+
+    def sharding_for(self, vector) -> "jax.sharding.Sharding | None":
+        if self.mesh is None:
+            return None
+        from znicz_tpu.parallel import batch_sharding, replicated_sharding
+        if vector is not None and vector.batch_major:
+            return batch_sharding(self.mesh)
+        return replicated_sharding(self.mesh)
+
+    def put(self, arr: np.ndarray, vector=None):
+        if self.jax_device.platform == "cpu":
+            # On the CPU backend device_put is ZERO-COPY for aligned
+            # numpy arrays: the "device" buffer aliases the host array,
+            # and a later host write (map_invalidate → mem[...] = …)
+            # would corrupt async in-flight computation.  Detach.
+            # (TPU/GPU transfers always copy; no cost there.)
+            arr = np.array(arr, copy=True)
+        sharding = self.sharding_for(vector)
+        if sharding is None:
+            return jax.device_put(arr, self.jax_device)
+        return jax.device_put(arr, sharding)
 
     def get(self, devarr) -> np.ndarray:
         return np.asarray(jax.device_get(devarr))

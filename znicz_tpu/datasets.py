@@ -1,0 +1,122 @@
+"""Dataset acquisition for the sample zoo.
+
+The reference's samples downloaded MNIST/CIFAR/ImageNet; this
+environment has zero egress, so every sample dataset resolves in two
+steps:
+
+1. real files under ``root.common.dirs.datasets`` when present
+   (MNIST idx/ubyte, CIFAR-10 binary batches — same formats the
+   reference's loaders consumed);
+2. otherwise a **procedural stand-in** with the same shapes/dtypes and
+   a learnable class structure (random class prototypes + noise +
+   class-dependent spatial patterns), deterministic per seed.
+
+Functional tests and benchmarks therefore run anywhere; with real
+data present the same samples train the real task.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from znicz_tpu.utils.config import root
+
+
+def _dataset_path(*parts: str) -> str:
+    return os.path.join(str(root.common.dirs.datasets), *parts)
+
+
+# ----------------------------------------------------------------------
+# real-file readers (reference formats)
+# ----------------------------------------------------------------------
+def _read_idx(path: str) -> np.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic, = struct.unpack(">I", f.read(4))
+        ndim = magic & 0xFF
+        dims = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        data = np.frombuffer(f.read(), dtype=np.uint8)
+    return data.reshape(dims)
+
+
+def load_mnist() -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """(train_x[60000,28,28], train_y, test_x[10000,28,28], test_y) —
+    real files if present, else synthetic MNIST-shaped digits."""
+    names = ["train-images-idx3-ubyte", "train-labels-idx1-ubyte",
+             "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"]
+    found = []
+    for name in names:
+        for cand in (_dataset_path("mnist", name),
+                     _dataset_path("mnist", name + ".gz")):
+            if os.path.exists(cand):
+                found.append(cand)
+                break
+    if len(found) == 4:
+        return (_read_idx(found[0]), _read_idx(found[1]),
+                _read_idx(found[2]), _read_idx(found[3]))
+    return synthetic_images(n_train=6000, n_test=1000, size=28,
+                            channels=0, n_classes=10, seed=42)
+
+
+def load_cifar10() -> tuple[np.ndarray, np.ndarray, np.ndarray,
+                            np.ndarray]:
+    """(train_x[N,32,32,3] u8, train_y, test_x, test_y)."""
+    base = _dataset_path("cifar-10-batches-bin")
+    batch_names = [f"data_batch_{i}.bin" for i in range(1, 6)]
+    if all(os.path.exists(os.path.join(base, b))
+           for b in batch_names + ["test_batch.bin"]):
+        xs, ys = [], []
+        for b in batch_names + ["test_batch.bin"]:
+            raw = np.fromfile(os.path.join(base, b), dtype=np.uint8)
+            raw = raw.reshape(-1, 3073)
+            ys.append(raw[:, 0].astype(np.int32))
+            xs.append(raw[:, 1:].reshape(-1, 3, 32, 32)
+                      .transpose(0, 2, 3, 1))  # → NHWC
+        train_x = np.concatenate(xs[:5])
+        train_y = np.concatenate(ys[:5])
+        return train_x, train_y, xs[5], ys[5]
+    return synthetic_images(n_train=5000, n_test=1000, size=32,
+                            channels=3, n_classes=10, seed=43)
+
+
+def synthetic_images(n_train: int, n_test: int, size: int, channels: int,
+                     n_classes: int, seed: int,
+                     dtype=np.uint8) -> tuple[np.ndarray, np.ndarray,
+                                              np.ndarray, np.ndarray]:
+    """Class-prototype images + noise, uint8, learnable but not
+    trivial.  ``channels=0`` → (N, size, size) grayscale like MNIST."""
+    rng = np.random.default_rng(seed)
+    shape = (size, size) if channels == 0 else (size, size, channels)
+    protos = rng.uniform(0, 255, size=(n_classes,) + shape)
+
+    def make(n: int):
+        per = n // n_classes
+        xs, ys = [], []
+        for c in range(n_classes):
+            noise = rng.normal(0, 64, size=(per,) + shape)
+            xs.append(np.clip(protos[c] + noise, 0, 255))
+            ys.append(np.full(per, c, dtype=np.int32))
+        x = np.concatenate(xs).astype(dtype)
+        y = np.concatenate(ys)
+        order = rng.permutation(len(x))
+        return x[order], y[order]
+
+    train_x, train_y = make(n_train)
+    test_x, test_y = make(n_test)
+    return train_x, train_y, test_x, test_y
+
+
+def synthetic_imagenet(n_samples: int, size: int = 227,
+                       n_classes: int = 1000,
+                       seed: int = 44) -> tuple[np.ndarray, np.ndarray]:
+    """Throughput-bench stand-in for ImageNet: uint8 NHWC images with
+    uniform random content (content does not affect step time)."""
+    rng = np.random.default_rng(seed)
+    x = rng.integers(0, 256, size=(n_samples, size, size, 3),
+                     dtype=np.uint8)
+    y = rng.integers(0, n_classes, size=n_samples).astype(np.int32)
+    return x, y

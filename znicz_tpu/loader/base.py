@@ -58,9 +58,12 @@ class Loader(AcceleratedUnit):
         self.shuffle_limit = shuffle_limit  # epochs to keep shuffling
         self._prng_name = prng_name
         # outputs
-        self.minibatch_data = Vector(name=f"{self.name}.minibatch_data")
-        self.minibatch_labels = Vector(name=f"{self.name}.minibatch_labels")
-        self.minibatch_indices = Vector(name=f"{self.name}.minibatch_indices")
+        self.minibatch_data = Vector(name=f"{self.name}.minibatch_data",
+                                     batch_major=True)
+        self.minibatch_labels = Vector(
+            name=f"{self.name}.minibatch_labels", batch_major=True)
+        self.minibatch_indices = Vector(
+            name=f"{self.name}.minibatch_indices", batch_major=True)
         self.minibatch_valid = Vector(name=f"{self.name}.minibatch_valid")
         # schedule state
         self.class_lengths = [0, 0, 0]
@@ -111,6 +114,18 @@ class Loader(AcceleratedUnit):
             raise ValueError(f"{self}: load_data produced no samples")
         self.max_minibatch_size = min(self.max_minibatch_size,
                                       max(self.class_lengths))
+        shards = getattr(self.device, "n_data_shards", 1)
+        if self.max_minibatch_size % shards:
+            aligned = (self.max_minibatch_size // shards) * shards
+            if aligned == 0:
+                raise ValueError(
+                    f"{self}: minibatch_size {self.max_minibatch_size} "
+                    f"cannot be sharded over the mesh's {shards} data "
+                    f"shards")
+            self.warning(
+                "minibatch_size %d not divisible by %d data shards — "
+                "clamped to %d", self.max_minibatch_size, shards, aligned)
+            self.max_minibatch_size = aligned
         self.minibatch_indices.reset(
             np.zeros(self.max_minibatch_size, dtype=np.int32))
         self.minibatch_valid.reset(np.zeros((), dtype=np.int32))

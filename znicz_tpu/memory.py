@@ -39,16 +39,20 @@ class _State(enum.Enum):
 class Vector:
     """Host-mirrored device buffer with explicit sync points."""
 
-    __slots__ = ("_mem", "_devmem", "_state", "_device", "_tracing", "name")
+    __slots__ = ("_mem", "_devmem", "_state", "_device", "_tracing", "name",
+                 "batch_major")
 
     def __init__(self, mem: np.ndarray | None = None,
-                 name: str = "") -> None:
+                 name: str = "", batch_major: bool = False) -> None:
         self._mem: np.ndarray | None = None
         self._devmem = None
         self._state = _State.EMPTY
         self._device: "Device | None" = None
         self._tracing = False
         self.name = name
+        #: first dim is the minibatch — shard it over the mesh's data
+        #: axis when the device carries one (SPMD data parallelism)
+        self.batch_major = batch_major
         if mem is not None:
             self.reset(mem)
 
@@ -80,7 +84,7 @@ class Vector:
         if device.is_host_only:
             return
         if self._state == _State.HOST:
-            self._devmem = device.put(self._mem)
+            self._devmem = device.put(self._mem, vector=self)
             self._state = _State.SYNCED
 
     # ------------------------------------------------------------------
@@ -126,7 +130,7 @@ class Vector:
         if self._device is None or self._device.is_host_only:
             return
         if self._state == _State.HOST:
-            self._devmem = self._device.put(self._mem)
+            self._devmem = self._device.put(self._mem, vector=self)
         self._state = _State.DEVICE
 
     # ------------------------------------------------------------------

@@ -1,0 +1,3 @@
+"""Sample/model zoo (reference: ``znicz/samples/`` — each sample is a
+workflow builder plus a config; SURVEY.md §2.4).  Each module exposes
+``build(**overrides) -> StandardWorkflow`` and ``run()``."""

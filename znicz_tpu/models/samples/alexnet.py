@@ -1,0 +1,112 @@
+"""ImageNet AlexNet — the flagship / benchmark workload
+(reference: ``znicz/samples/imagenet/`` AlexNet ``StandardWorkflow``
+layers config; BASELINE.json north star: ≥8k images/sec on v4-32).
+
+Canonical one-tower AlexNet geometry (227×227×3 input):
+
+.. code-block:: text
+
+    conv 96 11×11 /4  + ReLU → LRN → maxpool 3×3 /2        (55→27)
+    conv 256 5×5 p2   + ReLU → LRN → maxpool 3×3 /2        (27→13)
+    conv 384 3×3 p1   + ReLU
+    conv 384 3×3 p1   + ReLU
+    conv 256 3×3 p1   + ReLU → maxpool 3×3 /2              (13→6)
+    fc 4096 + ReLU → dropout 0.5
+    fc 4096 + ReLU → dropout 0.5
+    softmax 1000
+
+ImageNet itself is not downloadable here; the loader feeds uint8
+synthetic frames of the exact geometry (throughput is
+content-independent).  With a real ImageNet pipeline on disk, swap the
+``loader_factory``.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu import datasets
+from znicz_tpu.backends import Device
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils.config import root
+
+root.alexnet.update({
+    "minibatch_size": 128,
+    "learning_rate": 0.01,
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0005,
+    "dropout": 0.5,
+    "n_classes": 1000,
+    "max_epochs": 90,
+    "image_size": 227,
+    "n_train_samples": 1024,   # synthetic-mode dataset size
+    "n_valid_samples": 128,
+})
+
+
+def layers(cfg) -> list[dict]:
+    gd_cfg = {"learning_rate": cfg["learning_rate"],
+              "gradient_moment": cfg["gradient_moment"],
+              "weights_decay": cfg["weights_decay"]}
+    lrn = {"n": 5, "alpha": 1e-4, "beta": 0.75, "k": 2.0}
+    pool = {"kx": 3, "ky": 3, "sliding": (2, 2)}
+    return [
+        {"type": "conv_str",
+         "->": {"n_kernels": 96, "kx": 11, "ky": 11, "sliding": (4, 4),
+                "weights_stddev": 0.01}, "<-": gd_cfg},
+        {"type": "norm", "->": dict(lrn)},
+        {"type": "max_pooling", "->": dict(pool)},
+        {"type": "conv_str",
+         "->": {"n_kernels": 256, "kx": 5, "ky": 5, "padding": 2,
+                "weights_stddev": 0.01}, "<-": gd_cfg},
+        {"type": "norm", "->": dict(lrn)},
+        {"type": "max_pooling", "->": dict(pool)},
+        {"type": "conv_str",
+         "->": {"n_kernels": 384, "kx": 3, "ky": 3, "padding": 1,
+                "weights_stddev": 0.01}, "<-": gd_cfg},
+        {"type": "conv_str",
+         "->": {"n_kernels": 384, "kx": 3, "ky": 3, "padding": 1,
+                "weights_stddev": 0.01}, "<-": gd_cfg},
+        {"type": "conv_str",
+         "->": {"n_kernels": 256, "kx": 3, "ky": 3, "padding": 1,
+                "weights_stddev": 0.01}, "<-": gd_cfg},
+        {"type": "max_pooling", "->": dict(pool)},
+        {"type": "all2all_str",
+         "->": {"output_sample_shape": 4096, "weights_stddev": 0.005},
+         "<-": gd_cfg},
+        {"type": "dropout", "->": {"dropout_ratio": cfg["dropout"]}},
+        {"type": "all2all_str",
+         "->": {"output_sample_shape": 4096, "weights_stddev": 0.005},
+         "<-": gd_cfg},
+        {"type": "dropout", "->": {"dropout_ratio": cfg["dropout"]}},
+        {"type": "softmax",
+         "->": {"output_sample_shape": cfg["n_classes"],
+                "weights_stddev": 0.01}, "<-": gd_cfg},
+    ]
+
+
+def build(**overrides) -> StandardWorkflow:
+    cfg = dict(root.alexnet.as_dict())
+    cfg.update(overrides)
+    size = cfg["image_size"]
+    n_train, n_valid = cfg["n_train_samples"], cfg["n_valid_samples"]
+    x, y = datasets.synthetic_imagenet(
+        n_train + n_valid, size=size, n_classes=cfg["n_classes"])
+    wf = StandardWorkflow(
+        name="alexnet",
+        loader_factory=lambda w: ArrayLoader(
+            w,
+            train_data=x[:n_train], train_labels=y[:n_train],
+            valid_data=x[n_train:], valid_labels=y[n_train:],
+            minibatch_size=cfg["minibatch_size"],
+            normalization_scale=2.0 / 255.0, normalization_bias=-1.0),
+        layers=layers(cfg),
+        decision_config={"max_epochs": cfg["max_epochs"]})
+    wf._max_fires = 10 ** 9
+    return wf
+
+
+def run(device: Device | None = None) -> StandardWorkflow:
+    wf = build()
+    wf.initialize(device=device)
+    wf.run()
+    return wf

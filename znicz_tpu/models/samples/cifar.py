@@ -1,0 +1,78 @@
+"""CIFAR-10 conv workflow — north-star config #2
+(reference: ``znicz/samples/CIFAR10/cifar.py`` + ``cifar_config.py`` —
+Conv + Pooling + LRN + All2All).
+
+Real CIFAR-10 binary batches are used when present; otherwise
+synthetic 32×32×3 class-prototype images.
+"""
+
+from __future__ import annotations
+
+from znicz_tpu import datasets
+from znicz_tpu.backends import Device
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils.config import root
+
+root.cifar.update({
+    "minibatch_size": 100,
+    "learning_rate": 0.02,
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0005,
+    "max_epochs": 30,
+    "validation_fraction": 0.1,
+})
+
+
+def layers(cfg) -> list[dict]:
+    gd_cfg = {"learning_rate": cfg["learning_rate"],
+              "gradient_moment": cfg["gradient_moment"],
+              "weights_decay": cfg["weights_decay"]}
+    return [
+        {"type": "conv_str",
+         "->": {"n_kernels": 32, "kx": 5, "ky": 5, "padding": 2},
+         "<-": gd_cfg},
+        {"type": "maxabs_pooling", "->": {"kx": 3, "ky": 3,
+                                          "sliding": (2, 2)}},
+        {"type": "norm", "->": {"n": 5, "alpha": 5e-5, "beta": 0.75}},
+        {"type": "conv_str",
+         "->": {"n_kernels": 32, "kx": 5, "ky": 5, "padding": 2},
+         "<-": gd_cfg},
+        {"type": "avg_pooling", "->": {"kx": 3, "ky": 3,
+                                       "sliding": (2, 2)}},
+        {"type": "norm", "->": {"n": 5, "alpha": 5e-5, "beta": 0.75}},
+        {"type": "conv_str",
+         "->": {"n_kernels": 64, "kx": 5, "ky": 5, "padding": 2},
+         "<-": gd_cfg},
+        {"type": "avg_pooling", "->": {"kx": 3, "ky": 3,
+                                       "sliding": (2, 2)}},
+        {"type": "softmax", "->": {"output_sample_shape": 10},
+         "<-": gd_cfg},
+    ]
+
+
+def build(**overrides) -> StandardWorkflow:
+    cfg = dict(root.cifar.as_dict())
+    cfg.update(overrides)
+    train_x, train_y, test_x, test_y = datasets.load_cifar10()
+    n_valid = int(len(train_x) * cfg["validation_fraction"])
+    wf = StandardWorkflow(
+        name="cifar",
+        loader_factory=lambda w: ArrayLoader(
+            w,
+            train_data=train_x[n_valid:], train_labels=train_y[n_valid:],
+            valid_data=train_x[:n_valid], valid_labels=train_y[:n_valid],
+            test_data=test_x, test_labels=test_y,
+            minibatch_size=cfg["minibatch_size"],
+            normalization_scale=2.0 / 255.0, normalization_bias=-1.0),
+        layers=layers(cfg),
+        decision_config={"max_epochs": cfg["max_epochs"]})
+    wf._max_fires = 100_000_000
+    return wf
+
+
+def run(device: Device | None = None) -> StandardWorkflow:
+    wf = build()
+    wf.initialize(device=device)
+    wf.run()
+    return wf

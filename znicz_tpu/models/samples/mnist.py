@@ -1,0 +1,65 @@
+"""MnistSimple: the 784–100–10 MLP — north-star config #1
+(reference: ``znicz/samples/MnistSimple/`` — ``All2AllTanh(100)`` +
+``All2AllSoftmax(10)``; BASELINE.json config "MNIST 784-100-10 MLP").
+
+Real MNIST idx files are used when present under
+``root.common.dirs.datasets/mnist``; otherwise a synthetic
+MNIST-shaped dataset (see :mod:`znicz_tpu.datasets`).
+"""
+
+from __future__ import annotations
+
+from znicz_tpu import datasets
+from znicz_tpu.backends import Device
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils.config import root
+
+root.mnist.update({
+    "minibatch_size": 100,
+    "learning_rate": 0.03,
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0005,
+    "hidden": 100,
+    "max_epochs": 30,
+    "validation_fraction": 0.1,
+})
+
+
+def build(**overrides) -> StandardWorkflow:
+    cfg = dict(root.mnist.as_dict())
+    cfg.update(overrides)
+    train_x, train_y, test_x, test_y = datasets.load_mnist()
+    # normalize to [-1, 1] and flatten to 784 like the reference loader
+    n_valid = int(len(train_x) * cfg["validation_fraction"])
+    gd_cfg = {"learning_rate": cfg["learning_rate"],
+              "gradient_moment": cfg["gradient_moment"],
+              "weights_decay": cfg["weights_decay"]}
+    wf = StandardWorkflow(
+        name="mnist",
+        loader_factory=lambda w: ArrayLoader(
+            w,
+            train_data=train_x[n_valid:].reshape(-1, 784),
+            train_labels=train_y[n_valid:],
+            valid_data=train_x[:n_valid].reshape(-1, 784),
+            valid_labels=train_y[:n_valid],
+            test_data=test_x.reshape(-1, 784), test_labels=test_y,
+            minibatch_size=cfg["minibatch_size"],
+            normalization_scale=2.0 / 255.0, normalization_bias=-1.0),
+        layers=[
+            {"type": "all2all_tanh",
+             "->": {"output_sample_shape": cfg["hidden"]},
+             "<-": gd_cfg},
+            {"type": "softmax", "->": {"output_sample_shape": 10},
+             "<-": gd_cfg},
+        ],
+        decision_config={"max_epochs": cfg["max_epochs"]})
+    wf._max_fires = 100_000_000
+    return wf
+
+
+def run(device: Device | None = None) -> StandardWorkflow:
+    wf = build()
+    wf.initialize(device=device)
+    wf.run()
+    return wf

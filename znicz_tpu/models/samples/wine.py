@@ -1,0 +1,64 @@
+"""Wine: the "hello world" MLP — fastest functional smoke
+(reference: ``znicz/samples/Wine/`` — a tiny UCI-wine MLP).
+
+No UCI download here; a 13-feature 3-class synthetic stand-in with the
+same shape.  Config leaves mirror the reference's ``root.wine.*``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.backends import Device
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils.config import root
+
+root.wine.update({
+    "minibatch_size": 10,
+    "learning_rate": 0.3,
+    "layers": [8],
+    "max_epochs": 50,
+})
+
+
+def make_data(seed: int = 17):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 1, (3, 13))
+    data = np.concatenate([
+        c + 0.4 * rng.normal(size=(59, 13)) for c in centers
+    ]).astype(np.float32)
+    labels = np.repeat(np.arange(3), 59).astype(np.int32)
+    order = rng.permutation(len(data))
+    return data[order], labels[order]
+
+
+def build(**overrides) -> StandardWorkflow:
+    cfg = dict(root.wine.as_dict())
+    cfg.update(overrides)
+    data, labels = make_data()
+    n_train = 150
+    layers = [
+        {"type": "all2all_tanh",
+         "->": {"output_sample_shape": n},
+         "<-": {"learning_rate": cfg["learning_rate"]}}
+        for n in cfg["layers"]
+    ] + [{"type": "softmax", "->": {"output_sample_shape": 3},
+          "<-": {"learning_rate": cfg["learning_rate"]}}]
+    wf = StandardWorkflow(
+        name="wine",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:n_train], train_labels=labels[:n_train],
+            valid_data=data[n_train:], valid_labels=labels[n_train:],
+            minibatch_size=cfg["minibatch_size"]),
+        layers=layers,
+        decision_config={"max_epochs": cfg["max_epochs"]})
+    wf._max_fires = 10_000_000
+    return wf
+
+
+def run(device: Device | None = None) -> StandardWorkflow:
+    wf = build()
+    wf.initialize(device=device)
+    wf.run()
+    return wf

@@ -56,7 +56,7 @@ class ActivationBackward(GradientDescentBase):
     def initialize(self, device=None, **kwargs) -> None:
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        if not self.err_input:
+        if self.need_err_input and not self.err_input:
             self.err_input.reset(np.zeros(self.input.shape,
                                           dtype=np.float32))
         super().initialize(device=device, **kwargs)
@@ -155,7 +155,7 @@ class BackwardMul(GradientDescentBase):
     def initialize(self, device=None, **kwargs) -> None:
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        if not self.err_input:
+        if self.need_err_input and not self.err_input:
             self.err_input.reset(np.zeros(self.input.shape,
                                           dtype=np.float32))
         super().initialize(device=device, **kwargs)

@@ -114,7 +114,7 @@ class All2AllSoftmax(All2All):
 
     def __init__(self, workflow, output_sample_shape, name=None, **kwargs):
         super().__init__(workflow, output_sample_shape, name=name, **kwargs)
-        self.max_idx = Vector(name=f"{self.name}.max_idx")
+        self.max_idx = Vector(name=f"{self.name}.max_idx", batch_major=True)
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)

@@ -32,7 +32,7 @@ class DropoutForward(Forward):
             raise ValueError(f"dropout_ratio {dropout_ratio} not in [0,1)")
         self.dropout_ratio = float(dropout_ratio)
         self.forward_mode = "train"
-        self.mask = Vector(name=f"{self.name}.mask")
+        self.mask = Vector(name=f"{self.name}.mask", batch_major=True)
 
     def region_key(self) -> tuple:
         return (self.forward_mode,)
@@ -88,7 +88,7 @@ class DropoutBackward(GradientDescentBase):
     def initialize(self, device=None, **kwargs) -> None:
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        if not self.err_input:
+        if self.need_err_input and not self.err_input:
             self.err_input.reset(np.zeros(self.input.shape,
                                           dtype=np.float32))
         super().initialize(device=device, **kwargs)
@@ -112,31 +112,3 @@ class DropoutBackward(GradientDescentBase):
         else:
             self.err_input.devmem = err
 
-
-class ZeroFiller(Forward):
-    """Forces masked weight entries of a linked unit to zero after each
-    update — sparsity experiments (reference:
-    ``znicz/weights_zerofilling.py`` ``ZeroFiller``)."""
-
-    def __init__(self, workflow, name=None, **kwargs) -> None:
-        super().__init__(workflow, name=name, **kwargs)
-        self.target_weights: Vector | None = None  # link from a fwd unit
-        self.zero_mask = Vector(name=f"{self.name}.zero_mask")
-
-    def initialize(self, device=None, **kwargs) -> None:
-        super().initialize(device=device, **kwargs)
-        if self.target_weights is None or not self.target_weights:
-            raise AttributeError(f"{self}: target_weights not linked")
-        if not self.zero_mask:
-            self.zero_mask.reset(
-                np.ones(self.target_weights.shape, dtype=np.float32))
-        self.init_vectors(self.target_weights, self.zero_mask)
-
-    def numpy_run(self) -> None:
-        self.target_weights.map_write()
-        self.zero_mask.map_read()
-        self.target_weights.mem[...] *= self.zero_mask.mem
-
-    def xla_run(self) -> None:
-        self.target_weights.devmem = (
-            self.target_weights.devmem * self.zero_mask.devmem)

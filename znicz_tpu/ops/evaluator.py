@@ -33,7 +33,8 @@ class EvaluatorBase(AcceleratedUnit):
         super().__init__(workflow, name=name, **kwargs)
         self.output: Vector | None = None        # link from last forward
         self.minibatch_valid: Vector | None = None  # link from loader
-        self.err_output = Vector(name=f"{self.name}.err_output")
+        self.err_output = Vector(name=f"{self.name}.err_output",
+                                 batch_major=True)
 
     def _valid_mask(self, xp, n_rows):
         valid = self.minibatch_valid.devmem if xp is jnp \

@@ -37,7 +37,7 @@ class GDPoolingBase(GradientDescentBase):
     def initialize(self, device=None, **kwargs) -> None:
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        if not self.err_input:
+        if self.need_err_input and not self.err_input:
             self.err_input.reset(np.zeros(self.input.shape,
                                           dtype=np.float32))
         super().initialize(device=device, **kwargs)
@@ -46,18 +46,7 @@ class GDPoolingBase(GradientDescentBase):
 
     # -- shared geometry helpers ---------------------------------------
     def _stack_windows(self, x):
-        """jnp: (n, oh, ow, ky*kx, c) with -inf marking out-of-range."""
-        fwd = self.forward_unit
-        n, h, w, c = x.shape
-        oh, ow = fwd.output_spatial(h, w)
-        sy, sx = fwd.sliding
-        ph, pw = fwd._pad_hw(h, w)
-        xp = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)),
-                     constant_values=-jnp.inf)
-        return jnp.stack([
-            xp[:, i:i + (oh - 1) * sy + 1:sy,
-               j:j + (ow - 1) * sx + 1:sx, :]
-            for i in range(fwd.ky) for j in range(fwd.kx)], axis=3)
+        return self.forward_unit.stack_windows(x)
 
     def _scatter_windows(self, err_wins, x_shape):
         """jnp inverse of _stack_windows: (n,oh,ow,ky*kx,c) → NHWC."""

@@ -87,7 +87,7 @@ class Forward(AcceleratedUnit):
                  **kwargs) -> None:
         super().__init__(workflow, name=name, **kwargs)
         self.input: Vector | None = None  # usually replaced by link_attrs
-        self.output = Vector(name=f"{self.name}.output")
+        self.output = Vector(name=f"{self.name}.output", batch_major=True)
         self.weights = Vector(name=f"{self.name}.weights")
         self.bias = Vector(name=f"{self.name}.bias")
         self.weights_filling = weights_filling
@@ -167,7 +167,8 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         self.bias: Vector | None = None
         # linked from the next backward unit / evaluator:
         self.err_output: Vector | None = None
-        self.err_input = Vector(name=f"{self.name}.err_input")
+        self.err_input = Vector(name=f"{self.name}.err_input",
+                                batch_major=True)
         # momentum slots
         self.accumulated_gradient_weights = Vector(
             name=f"{self.name}.acc_grad_w")
@@ -176,6 +177,13 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
+        if not self.need_err_input and (self.weights is None
+                                        or not self.weights):
+            # weightless AND nothing upstream wants the error: the unit
+            # has no observable effect — skip it entirely (scheduler
+            # and jit region both honor gate_skip)
+            from znicz_tpu.mutable import Bool
+            self.gate_skip = Bool(True)
         if self.gradient_moment or self.gradient_moment_bias:
             if self.weights is not None and self.weights:
                 self.accumulated_gradient_weights.reset(

@@ -26,14 +26,21 @@ import jax.numpy as jnp
 from znicz_tpu.ops.nn_units import Forward, GradientDescentBase
 
 
-def _window_sum(xp, arr, n: int):
-    """Sliding sum of size ``n`` (centered, truncated) over the LAST
-    (channel) axis."""
+def _window_sum(xp, arr, n: int, half_low: int | None = None):
+    """Sliding sum over the LAST (channel) axis:
+    ``out_i = Σ_{k=i−half_low}^{i+(n−1−half_low)} arr_k`` (zero-padded).
+
+    Default ``half_low = n//2`` (the forward's centered window).  The
+    operator's adjoint — needed by the backward for even ``n``, where
+    the window is asymmetric — is the same sum with
+    ``half_low = n−1−n//2``."""
     c = arr.shape[-1]
-    half = n // 2
+    if half_low is None:
+        half_low = n // 2
+    half_high = n - 1 - half_low
     padded = xp.concatenate(
-        [xp.zeros(arr.shape[:-1] + (half,), arr.dtype), arr,
-         xp.zeros(arr.shape[:-1] + (half,), arr.dtype)], axis=-1)
+        [xp.zeros(arr.shape[:-1] + (half_low,), arr.dtype), arr,
+         xp.zeros(arr.shape[:-1] + (half_high,), arr.dtype)], axis=-1)
     out = xp.zeros_like(arr)
     for off in range(n):
         out = out + padded[..., off:off + c]
@@ -82,7 +89,7 @@ class LRNormalizerBackward(GradientDescentBase):
     def initialize(self, device=None, **kwargs) -> None:
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        if not self.err_input:
+        if self.need_err_input and not self.err_input:
             self.err_input.reset(np.zeros(self.input.shape,
                                           dtype=np.float32))
         super().initialize(device=device, **kwargs)
@@ -102,12 +109,13 @@ class LRNormalizerBackward(GradientDescentBase):
         d = fwd.k + fwd.alpha * _window_sum(np, x * x, fwd.n)
         dmb = d ** (-fwd.beta)
         # t_i = err_i · x_i · d_i^{−β−1}; err_input_j gets
-        # −2αβ·x_j·Σ_{i: j∈win(i)} t_i  (window symmetric → same sum op)
+        # −2αβ·x_j·Σ_{i: j∈win(i)} t_i — the window operator's ADJOINT
+        # (identical to the forward sum only for odd n)
         t = err * x * d ** (-fwd.beta - 1.0)
         self.err_input.map_invalidate()
         self.err_input.mem[...] = (
             err * dmb - 2.0 * fwd.alpha * fwd.beta * x
-            * _window_sum(np, t, fwd.n))
+            * _window_sum(np, t, fwd.n, half_low=fwd.n - 1 - fwd.n // 2))
 
     def xla_run(self) -> None:
         fwd = self.forward_unit

@@ -87,6 +87,21 @@ class Pooling(Forward):
         need_w = (ow - 1) * sx + self.kx
         return need_h - h, need_w - w
 
+    def stack_windows(self, x):
+        """jnp: every window as (n, oh, ow, ky*kx, c), out-of-range
+        cells marked −inf.  Shared by the stochastic forward, the
+        deterministic-tie MaxAbs forward, and the backward scatters."""
+        n, h, w, c = x.shape
+        oh, ow = self.output_spatial(h, w)
+        sy, sx = self.sliding
+        ph, pw = self._pad_hw(h, w)
+        xp = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)),
+                     constant_values=-jnp.inf)
+        return jnp.stack([
+            xp[:, i:i + (oh - 1) * sy + 1:sy,
+               j:j + (ow - 1) * sx + 1:sx, :]
+            for i in range(self.ky) for j in range(self.kx)], axis=3)
+
 
 class MaxPooling(Pooling):
     """Plain max pooling."""
@@ -130,16 +145,16 @@ class MaxAbsPooling(Pooling):
                 win, idx[:, None, :], axis=1)[:, 0, :]
 
     def xla_forward(self, x):
-        ph, pw = self._pad_hw(x.shape[1], x.shape[2])
-
-        def select(a, b):
-            return jnp.where(jnp.abs(a) >= jnp.abs(b), a, b)
-
-        return jax.lax.reduce_window(
-            x, jnp.zeros((), x.dtype), select,
-            window_dimensions=(1, self.ky, self.kx, 1),
-            window_strides=(1, *self.sliding, 1),
-            padding=((0, 0), (0, ph), (0, pw), (0, 0)))
+        # argmax over |window| (first occurrence) instead of
+        # reduce_window: a |a|==|b| tie with opposite signs is
+        # non-commutative under reduce_window's unspecified order —
+        # the stacked argmax matches the oracle and the backward
+        # scatter deterministically.
+        wins = self.stack_windows(x)
+        key = jnp.where(jnp.isfinite(wins), jnp.abs(wins), -jnp.inf)
+        idx = key.argmax(axis=3)
+        return jnp.take_along_axis(
+            wins, idx[:, :, :, None, :], axis=3)[:, :, :, 0, :]
 
     def xla_run(self) -> None:
         self.output.devmem = self.xla_forward(self.input.devmem)
@@ -187,7 +202,8 @@ class StochasticPooling(Pooling):
         super().__init__(workflow, kx, ky, sliding=sliding, name=name,
                          **kwargs)
         self.forward_mode = "train"
-        self.last_choice = Vector(name=f"{self.name}.last_choice")
+        self.last_choice = Vector(name=f"{self.name}.last_choice",
+                                  batch_major=True)
 
     def region_key(self) -> tuple:
         return (self.forward_mode,)
@@ -240,15 +256,7 @@ class StochasticPooling(Pooling):
         x = self.input.devmem
         n, h, w, c = x.shape
         oh, ow = self.output_spatial(h, w)
-        sy, sx = self.sliding
-        ph, pw = self._pad_hw(h, w)
-        xp_pad = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)),
-                         constant_values=-jnp.inf)
-        # gather every window: (n, oh, ow, ky*kx, c)
-        wins = jnp.stack([
-            xp_pad[:, i:i + (oh - 1) * sy + 1:sy,
-                   j:j + (ow - 1) * sx + 1:sx, :]
-            for i in range(self.ky) for j in range(self.kx)], axis=3)
+        wins = self.stack_windows(x)  # (n, oh, ow, ky*kx, c)
         valid = jnp.isfinite(wins)
         wins0 = jnp.where(valid, wins, 0.0)
         pos = jnp.maximum(wins0, 0.0) * valid

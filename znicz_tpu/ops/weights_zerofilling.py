@@ -1,0 +1,40 @@
+"""ZeroFiller: force-zero masked weight entries after each update —
+sparsity experiment support (reference:
+``znicz/weights_zerofilling.py`` ``ZeroFiller``).
+
+Not a chain layer: wire it as a side unit after the backward chain
+(``zf.link_from(gd_unit)``) with ``target_weights`` linked to the
+forward unit's ``weights``; the mask persists in snapshots.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.accelerated_units import AcceleratedUnit
+from znicz_tpu.memory import Vector
+
+
+class ZeroFiller(AcceleratedUnit):
+    def __init__(self, workflow, name=None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.target_weights: Vector | None = None  # link from a fwd unit
+        self.zero_mask = Vector(name=f"{self.name}.zero_mask")
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.target_weights is None or not self.target_weights:
+            raise AttributeError(f"{self}: target_weights not linked")
+        if not self.zero_mask:
+            self.zero_mask.reset(
+                np.ones(self.target_weights.shape, dtype=np.float32))
+        self.init_vectors(self.target_weights, self.zero_mask)
+
+    def numpy_run(self) -> None:
+        self.target_weights.map_write()
+        self.zero_mask.map_read()
+        self.target_weights.mem[...] *= self.zero_mask.mem
+
+    def xla_run(self) -> None:
+        self.target_weights.devmem = (
+            self.target_weights.devmem * self.zero_mask.devmem)
