@@ -1,0 +1,114 @@
+"""Cutter/GDCutter, MeanDispNormalizer, InputJoiner/GDInputJoiner:
+oracle vs XLA agreement + golden semantics."""
+
+import numpy as np
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops.cutter import Cutter, GDCutter
+from znicz_tpu.ops.input_joiner import GDInputJoiner, InputJoiner
+from znicz_tpu.ops.mean_disp_normalizer import (
+    GDMeanDispNormalizer,
+    MeanDispNormalizer,
+)
+
+RNG = np.random.default_rng(5)
+X = RNG.normal(size=(2, 7, 9, 3)).astype(np.float32)
+
+
+def test_cutter_fwd_bwd():
+    padding = (2, 1, 3, 2)  # left, top, right, bottom
+    outs = {}
+    err = None
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        wf = DummyWorkflow()
+        src = DummyUnit(wf, output=Vector(X.copy(), name="x"))
+        unit = Cutter(wf, padding=padding)
+        unit.link_attrs(src, ("input", "output"))
+        unit.initialize(device=device)
+        unit.run()
+        unit.output.map_read()
+        assert unit.output.shape == (2, 7 - 3, 9 - 5, 3)
+        if err is None:
+            err = RNG.normal(size=unit.output.shape).astype(np.float32)
+        err_src = DummyUnit(wf, err=Vector(err.copy(), name="err"))
+        bwd = GDCutter(wf)
+        bwd.forward_unit = unit
+        bwd.link_attrs(unit, "input", "output")
+        bwd.link_attrs(err_src, ("err_output", "err"))
+        bwd.initialize(device=device)
+        bwd.run()
+        bwd.err_input.map_read()
+        outs[name] = (unit.output.mem.copy(), bwd.err_input.mem.copy())
+    np.testing.assert_array_equal(outs["np"][0], outs["xla"][0])
+    np.testing.assert_array_equal(outs["np"][1], outs["xla"][1])
+    np.testing.assert_array_equal(outs["np"][0], X[:, 1:5, 2:6, :])
+    assert outs["np"][1].shape == X.shape
+    np.testing.assert_allclose(outs["np"][1].sum(), err.sum(), rtol=1e-5)
+
+
+def test_mean_disp_normalizer():
+    mean = X.mean(axis=0)
+    disp = X.std(axis=0) + 0.1
+    outs = {}
+    err = RNG.normal(size=X.shape).astype(np.float32)
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        wf = DummyWorkflow()
+        src = DummyUnit(wf, output=Vector(X.copy(), name="x"))
+        unit = MeanDispNormalizer(wf)
+        unit.link_attrs(src, ("input", "output"))
+        unit.mean = Vector(mean.copy(), name="mean")
+        unit.rdisp = Vector((1.0 / disp).astype(np.float32), name="rdisp")
+        unit.initialize(device=device)
+        unit.run()
+        unit.output.map_read()
+        err_src = DummyUnit(wf, err=Vector(err.copy(), name="err"))
+        bwd = GDMeanDispNormalizer(wf)
+        bwd.forward_unit = unit
+        bwd.link_attrs(unit, "input", "output")
+        bwd.link_attrs(err_src, ("err_output", "err"))
+        bwd.initialize(device=device)
+        bwd.run()
+        bwd.err_input.map_read()
+        outs[name] = (unit.output.mem.copy(), bwd.err_input.mem.copy())
+    np.testing.assert_allclose(outs["np"][0], outs["xla"][0],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs["np"][1], outs["xla"][1],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs["np"][0],
+                               (X - mean) / disp, rtol=1e-4, atol=1e-5)
+
+
+def test_input_joiner_fwd_bwd():
+    a = RNG.normal(size=(4, 5)).astype(np.float32)
+    b = RNG.normal(size=(4, 2, 3)).astype(np.float32)  # flattened to 6
+    err = RNG.normal(size=(4, 11)).astype(np.float32)
+    outs = {}
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        wf = DummyWorkflow()
+        ua = DummyUnit(wf, output=Vector(a.copy(), name="a"))
+        ub = DummyUnit(wf, output=Vector(b.copy(), name="b"))
+        join = InputJoiner(wf)
+        join.link_inputs(ua, ub)
+        join.initialize(device=device)
+        join.run()
+        join.output.map_read()
+        err_src = DummyUnit(wf, err=Vector(err.copy(), name="err"))
+        bwd = GDInputJoiner(wf)
+        bwd.forward_unit = join
+        bwd.link_attrs(err_src, ("err_output", "err"))
+        bwd.initialize(device=device)
+        bwd.run()
+        for vec in bwd.err_inputs:
+            vec.map_read()
+        outs[name] = (join.output.mem.copy(),
+                      [v.mem.copy() for v in bwd.err_inputs])
+    np.testing.assert_array_equal(outs["np"][0], outs["xla"][0])
+    expected = np.concatenate([a, b.reshape(4, -1)], axis=1)
+    np.testing.assert_array_equal(outs["np"][0], expected)
+    np.testing.assert_array_equal(outs["np"][1][0], err[:, :5])
+    np.testing.assert_array_equal(outs["np"][1][1],
+                                  err[:, 5:].reshape(b.shape))
+    for got, want in zip(outs["xla"][1], outs["np"][1]):
+        np.testing.assert_array_equal(got, want)

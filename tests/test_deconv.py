@@ -1,0 +1,145 @@
+"""Deconv / GDDeconv / Depooling: numpy explicit-math oracle vs XLA
+vjp paths (reference pattern: ``znicz/tests/unit`` deconv tests)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops import conv as conv_mod
+from znicz_tpu.ops import depooling, pooling
+from znicz_tpu.ops.deconv import Deconv, DeconvTanh
+from znicz_tpu.ops.gd_deconv import GDDeconv
+
+RNG = np.random.default_rng(17)
+GEOMS = [dict(n_kernels=5, kx=3, ky=3),
+         dict(n_kernels=4, kx=2, ky=2, sliding=(2, 2)),
+         dict(n_kernels=3, kx=3, ky=3, sliding=(2, 2), padding=1)]
+
+
+def build(geom, device, err=None, deconv_cls=Deconv, weights=None):
+    """conv-shaped source tensor → deconv back to image shape."""
+    wf = DummyWorkflow()
+    img_shape = (2, 8, 8, 3)
+    # conv output spatial defines deconv input spatial
+    probe = conv_mod.Conv(wf, **geom)
+    oh, ow = probe.output_spatial(img_shape[1], img_shape[2])
+    x = np.random.default_rng(99).normal(
+        size=(img_shape[0], oh, ow, geom["n_kernels"])).astype(np.float32)
+    src = DummyUnit(wf, output=Vector(x.copy(), name="x"))
+    shape_src = DummyUnit(wf, output=Vector(
+        np.zeros(img_shape, dtype=np.float32), name="img"))
+    fwd = deconv_cls(wf, **geom)
+    fwd.link_attrs(src, ("input", "output"))
+    fwd.output_shape_source = shape_src.output
+    if weights is not None:
+        fwd.weights.reset(weights.copy())
+    fwd.initialize(device=device)
+    bwd = None
+    if err is not None:
+        err_src = DummyUnit(wf, err=Vector(err.copy(), name="err"))
+        bwd = GDDeconv(wf, learning_rate=0.05, gradient_moment=0.9)
+        bwd.forward_unit = fwd
+        bwd.link_attrs(fwd, "input", "output", "weights", "bias")
+        bwd.link_attrs(err_src, ("err_output", "err"))
+        bwd.initialize(device=device)
+    return fwd, bwd
+
+
+@pytest.mark.parametrize("geom", GEOMS)
+def test_deconv_fwd_bwd_numpy_xla_agreement(geom):
+    w = None
+    fwd0, _ = build(geom, NumpyDevice())
+    w = RNG.normal(0, 0.1, size=fwd0.weights.shape).astype(np.float32)
+    err = RNG.normal(size=fwd0.output.shape).astype(np.float32)
+    outs = {}
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        fwd, bwd = build(geom, device, err=err, weights=w)
+        fwd.run()
+        bwd.run()
+        for vec in (fwd.output, bwd.err_input, bwd.weights):
+            vec.map_read()
+        outs[name] = (fwd.output.mem.copy(), bwd.err_input.mem.copy(),
+                      bwd.weights.mem.copy())
+    for a, b in zip(outs["np"], outs["xla"]):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_deconv_is_conv_transpose():
+    """⟨deconv(x), y⟩ == ⟨x, conv(y)⟩ — the defining adjoint
+    identity, on the numpy oracle."""
+    geom = dict(n_kernels=4, kx=3, ky=3, sliding=(2, 2))
+    fwd, _ = build(geom, NumpyDevice())
+    fwd.run()
+    x = np.array(fwd.input.mem, copy=True)
+    w = np.array(fwd.weights.mem, copy=True)
+    y = RNG.normal(size=fwd.output.shape).astype(np.float32)
+    # conv(y) with the same weights
+    cols = conv_mod.im2col(y, fwd.ky, fwd.kx, *fwd.sliding, fwd.padding)
+    conv_y = cols @ w.reshape(-1, geom["n_kernels"])
+    lhs = float((fwd.output.mem * y).sum())
+    rhs = float((x * conv_y).sum())
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-3)
+
+
+def test_deconv_tanh_activation():
+    geom = dict(n_kernels=3, kx=2, ky=2, sliding=(2, 2))
+    outs = {}
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        fwd, _ = build(geom, device, deconv_cls=DeconvTanh,
+                       weights=outs.get("w"))
+        if "w" not in outs:
+            outs["w"] = np.array(fwd.weights.mem, copy=True)
+            fwd.weights.reset(outs["w"].copy())
+            fwd.weights.initialize(device)
+        fwd.run()
+        fwd.output.map_read()
+        outs[name] = fwd.output.mem.copy()
+    np.testing.assert_allclose(outs["np"], outs["xla"],
+                               rtol=1e-4, atol=1e-5)
+    assert np.abs(outs["np"]).max() <= 1.7159  # scaled tanh range
+
+
+@pytest.mark.parametrize("pool_cls", [pooling.MaxPooling,
+                                      pooling.MaxAbsPooling,
+                                      pooling.AvgPooling])
+def test_depooling_fwd_bwd_agreement(pool_cls):
+    px = RNG.normal(size=(2, 6, 6, 3)).astype(np.float32)
+    outs = {}
+    err = None
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        wf = DummyWorkflow()
+        psrc = DummyUnit(wf, output=Vector(px.copy(), name="px"))
+        pool = pool_cls(wf, kx=2, ky=2)
+        pool.link_attrs(psrc, ("input", "output"))
+        pool.initialize(device=device)
+        pool.run()
+        x = RNG.normal(size=pool.output.shape).astype(np.float32) \
+            if name == "np" else outs["x"]
+        outs.setdefault("x", x)
+        src = DummyUnit(wf, output=Vector(x.copy(), name="x"))
+        unit = depooling.Depooling(wf)
+        unit.link_attrs(src, ("input", "output"))
+        unit.pooling_unit = pool
+        unit.initialize(device=device)
+        unit.run()
+        unit.output.map_read()
+        if err is None:
+            err = RNG.normal(size=unit.output.shape).astype(np.float32)
+        err_src = DummyUnit(wf, err=Vector(err.copy(), name="err"))
+        bwd = depooling.GDDepooling(wf)
+        bwd.forward_unit = unit
+        bwd.link_attrs(unit, "input", "output")
+        bwd.link_attrs(err_src, ("err_output", "err"))
+        bwd.initialize(device=device)
+        bwd.run()
+        bwd.err_input.map_read()
+        outs[name] = (unit.output.mem.copy(), bwd.err_input.mem.copy())
+    np.testing.assert_allclose(outs["np"][0], outs["xla"][0],
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs["np"][1], outs["xla"][1],
+                               rtol=1e-5, atol=1e-6)
+    # total mass is conserved by the scatter
+    np.testing.assert_allclose(outs["np"][0].sum(), outs["x"].sum(),
+                               rtol=1e-4)

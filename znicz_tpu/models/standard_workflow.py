@@ -32,7 +32,7 @@ from znicz_tpu.accelerated_units import AcceleratedWorkflow, RegionUnit
 from znicz_tpu.backends import NumpyDevice
 from znicz_tpu.loader.base import TRAIN, Loader
 from znicz_tpu.mutable import Bool
-from znicz_tpu.ops import activation, all2all, conv, dropout, pooling
+from znicz_tpu.ops import activation, all2all, conv, cutter, dropout, pooling
 from znicz_tpu.ops import normalization
 from znicz_tpu.ops import gd, gd_conv, gd_pooling  # noqa: F401 (pairs)
 from znicz_tpu.ops.decision import DecisionGD, DecisionMSE
@@ -75,6 +75,7 @@ for _name, _cls in {
     "avg_pooling": pooling.AvgPooling,
     "stochastic_pooling": pooling.StochasticPooling,
     "norm": normalization.LRNormalizerForward,
+    "cutter": cutter.Cutter,
     "dropout": dropout.DropoutForward,
     "activation_tanh": activation.ForwardTanh,
     "activation_relu": activation.ForwardRELU,

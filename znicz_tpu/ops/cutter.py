@@ -1,0 +1,80 @@
+"""Cutter: crop a spatial region (reference: ``znicz/cutter.py``).
+
+``Cutter(padding=(left, top, right, bottom))`` removes that many
+pixels from each border of an NHWC tensor; :class:`GDCutter` zero-pads
+the error back.  On TPU both are static ``lax.slice`` / ``jnp.pad`` —
+offsets are compile-time constants (SURVEY.md §2.3:
+"lax.dynamic_slice"; static slices compile tighter, and the
+reference's crop geometry is fixed per instantiation anyway).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.ops.nn_units import Forward, WeightlessGradientUnit
+
+
+class Cutter(Forward):
+    """Crop ``padding=(left, top, right, bottom)`` pixels off NHWC
+    (an int means the same crop on every border, as in Conv)."""
+
+    def __init__(self, workflow, padding=(0, 0, 0, 0), name=None,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        if isinstance(padding, (int, np.integer)):
+            padding = (padding,) * 4
+        self.padding = tuple(int(p) for p in padding)
+        if len(self.padding) != 4:
+            raise ValueError("padding must be (left, top, right, bottom)")
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        n, h, w, c = self.input.shape
+        lf, tp, rt, bt = self.padding
+        oh, ow = h - tp - bt, w - lf - rt
+        if oh <= 0 or ow <= 0:
+            raise ValueError(f"{self}: crop {self.padding} leaves "
+                             f"nothing of {h}x{w}")
+        self.output.reset(np.zeros((n, oh, ow, c), dtype=np.float32))
+        self.init_vectors(self.input, self.output)
+
+    def _crop(self, x):
+        lf, tp, rt, bt = self.padding
+        n, h, w, c = self.input.shape
+        return x[:, tp:h - bt, lf:w - rt, :]
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = self._crop(self.input.mem)
+
+    def xla_run(self) -> None:
+        self.output.devmem = self._crop(self.input.devmem)
+
+
+class GDCutter(WeightlessGradientUnit):
+    """Zero-pad the error back to the uncropped shape."""
+
+    MATCHES = (Cutter,)
+
+    def _pad_spec(self):
+        lf, tp, rt, bt = self.forward_unit.padding
+        return ((0, 0), (tp, bt), (lf, rt), (0, 0))
+
+    def numpy_run(self) -> None:
+        if not self.need_err_input:
+            return
+        self.err_output.map_read()
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = np.pad(self.err_output.mem,
+                                         self._pad_spec())
+
+    def xla_run(self) -> None:
+        if self.need_err_input:
+            self.err_input.devmem = jnp.pad(self.err_output.devmem,
+                                            self._pad_spec())

@@ -1,0 +1,149 @@
+"""Transposed convolution ("deconvolution") forward unit.
+
+Rebuilds the reference's ``znicz/deconv.py`` ``Deconv``: the decoder
+half of convolutional autoencoders (MnistAE / ImagenetAE samples).  A
+``Deconv`` inverts the geometry of a paired :class:`~znicz_tpu.ops.conv.Conv`
+— input has ``n_kernels`` channels, output has the conv's input
+channels — and may *share* the conv's weight Vector (tied-weight AE).
+
+The reference lowered this as a hand-written col2im scatter kernel.
+TPU-first, the XLA path is the **vjp of the paired conv's pure
+forward** — XLA's native transposed-conv lowering onto the MXU; the
+numpy oracle is the explicit ``x @ Wᵀ`` + ``col2im`` math (an
+independent implementation doubling as the spec, same pattern as
+``gd_conv.py``).
+
+Geometry contract (reference: ``Deconv.compute_padding`` /
+``get_output_shape_from``): the output shape comes from
+``output_shape_source`` (typically the paired conv's ``input``), and
+``conv(output_shape) == input_shape`` is validated at initialize.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops import activations_math
+from znicz_tpu.ops.conv import DIMNUMS, col2im, im2col, normalize_padding
+from znicz_tpu.ops.nn_units import Forward
+
+
+class Deconv(Forward):
+    """Transposed 2-D convolution (linear flavor)."""
+
+    ACTIVATION = "linear"
+
+    def __init__(self, workflow, n_kernels: int, kx: int, ky: int,
+                 sliding=(1, 1), padding=0, name=None,
+                 include_bias: bool = False, **kwargs) -> None:
+        # reference Deconv carries no bias by default (decoder half)
+        super().__init__(workflow, name=name, include_bias=include_bias,
+                         **kwargs)
+        self.n_kernels = int(n_kernels)
+        self.kx, self.ky = int(kx), int(ky)
+        self.sliding = (int(sliding[0]), int(sliding[1]))
+        self.padding = normalize_padding(padding)
+        self.activation = activations_math.get(self.ACTIVATION)
+        #: Vector whose shape defines the output (reference:
+        #: ``get_output_shape_from``) — usually the paired conv's input
+        self.output_shape_source: Vector | None = None
+
+    # ------------------------------------------------------------------
+    def conv_spatial(self, h: int, w: int) -> tuple[int, int]:
+        """Spatial shape the paired conv would produce from (h, w)."""
+        pt, pb, pl, pr = self.padding
+        sy, sx = self.sliding
+        return ((h + pt + pb - self.ky) // sy + 1,
+                (w + pl + pr - self.kx) // sx + 1)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if self.output_shape_source is None \
+                or not self.output_shape_source:
+            raise AttributeError(
+                f"{self}: output_shape_source not linked — link it to "
+                f"the paired conv's input (reference: "
+                f"get_output_shape_from)")
+        out_shape = tuple(self.output_shape_source.shape)
+        n, ih, iw, k = self.input.shape
+        if k != self.n_kernels:
+            raise ValueError(f"{self}: input has {k} channels, "
+                             f"expected n_kernels={self.n_kernels}")
+        oh, ow = self.conv_spatial(out_shape[1], out_shape[2])
+        if (oh, ow) != (ih, iw):
+            raise ValueError(
+                f"{self}: conv({out_shape[1:3]}) = {(oh, ow)} does not "
+                f"match input spatial {(ih, iw)} — bad deconv geometry")
+        c = out_shape[3]
+        fan_in = self.ky * self.kx * c
+        if not self.weights:  # may be shared with the paired conv
+            self.weights.reset(self.fill_array(
+                (self.ky, self.kx, c, self.n_kernels),
+                self.weights_filling, self.weights_stddev, fan_in=fan_in))
+        if self.include_bias and not self.bias:
+            self.bias.reset(self.fill_array(
+                (c,), self.bias_filling, self.bias_stddev, fan_in=fan_in))
+        self.output.reset(np.zeros(out_shape, dtype=np.float32))
+        self.init_vectors(self.input, self.output, self.weights, self.bias)
+
+    # -- pure forward (jnp; the backward unit vjp's this) ---------------
+    def xla_forward(self, x, w, b):
+        pt, pb, pl, pr = self.padding
+        out_shape = self.output.shape
+
+        def conv_fn(y):
+            return jax.lax.conv_general_dilated(
+                y, w, window_strides=self.sliding,
+                padding=((pt, pb), (pl, pr)),
+                dimension_numbers=DIMNUMS)
+
+        y0 = jnp.zeros(out_shape, x.dtype)
+        _, vjp = jax.vjp(conv_fn, y0)
+        (out,) = vjp(x)
+        if b is not None:
+            out = out + b
+        return self.activation.fwd(jnp, out)
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.weights.map_read()
+        x = self.input.mem.astype(np.float32)
+        w = self.weights.mem
+        n, ih, iw, k = x.shape
+        w2d = w.reshape(-1, k)                      # (ky*kx*C, K)
+        cols = (x.reshape(-1, k) @ w2d.T).reshape(
+            n, ih, iw, w2d.shape[0])
+        out = col2im(cols, self.output.shape, self.ky, self.kx,
+                     *self.sliding, self.padding)
+        if self.include_bias:
+            self.bias.map_read()
+            out = out + self.bias.mem
+        self.output.map_invalidate()
+        self.output.mem[...] = self.activation.fwd(np, out)
+
+    def xla_run(self) -> None:
+        b = self.bias.devmem if self.include_bias else None
+        self.output.devmem = self.xla_forward(
+            self.input.devmem, self.weights.devmem, b)
+
+
+class DeconvTanh(Deconv):
+    ACTIVATION = "tanh"
+
+
+class DeconvRELU(Deconv):
+    ACTIVATION = "relu"
+
+
+class DeconvSigmoid(Deconv):
+    ACTIVATION = "sigmoid"
+
+
+# keep the reference's module split: gradient unit in gd_deconv.py
+from znicz_tpu.ops import gd_deconv  # noqa: E402,F401  (registers pairing)

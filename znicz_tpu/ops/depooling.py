@@ -1,0 +1,155 @@
+"""Depooling ("unpooling") unit (reference: ``znicz/depooling.py``).
+
+The reference's ``Depooling`` scattered its input back to the winner
+offsets recorded by a paired max-pooling unit during *its* forward
+pass (``input_offset``) — the decoder half of conv autoencoders.
+
+TPU-first there are no recorded offsets in the hot path (SURVEY.md
+§2.3: recompute-in-bwd); instead the XLA path is the **vjp of the
+paired pooling unit's pure forward at the pooling's own input** —
+for max pooling this scatters exactly to the winners, for avg pooling
+it spreads uniformly, both matching the reference semantics.  The
+numpy oracle recomputes winners per window explicitly.
+
+Wiring: ``pooling_unit`` must be set to the paired
+:class:`~znicz_tpu.ops.pooling.Pooling` instance; its ``input`` Vector
+(still holding the encoder activations of the current minibatch)
+defines the output shape and the winner positions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops.nn_units import Forward, WeightlessGradientUnit
+from znicz_tpu.ops.pooling import AvgPooling, MaxAbsPooling, MaxPooling
+
+
+class Depooling(Forward):
+    """Scatter input to the paired pooling's winner positions."""
+
+    def __init__(self, workflow, name=None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.pooling_unit = None              # paired Pooling instance
+        #: the pooling's input Vector (linked; defines output shape)
+        self.pooling_input: Vector | None = None
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if self.pooling_unit is None:
+            raise AttributeError(f"{self}: pooling_unit not set")
+        if self.pooling_input is None or not self.pooling_input:
+            self.pooling_input = self.pooling_unit.input
+        if tuple(self.input.shape) != tuple(
+                self.pooling_unit.output.shape):
+            raise ValueError(
+                f"{self}: input shape {self.input.shape} != paired "
+                f"pooling output {self.pooling_unit.output.shape}")
+        self.output.reset(
+            np.zeros(self.pooling_input.shape, dtype=np.float32))
+        self.init_vectors(self.input, self.output, self.pooling_input)
+
+    # winner scatter, shared with the backward's gather ----------------
+    def _winner_idx_np(self, pool, px: np.ndarray):
+        """Per-window argmax index (full-window coords) for max/maxabs
+        pooling of the paired input ``px``."""
+        n, h, w, c = px.shape
+        idx = {}
+        for oy, ox, y0, y1, x0, x1 in pool._windows(h, w):
+            win = np.full((n, pool.ky, pool.kx, c), -np.inf,
+                          dtype=px.dtype)
+            win[:, :y1 - y0, :x1 - x0, :] = px[:, y0:y1, x0:x1, :]
+            win = win.reshape(n, -1, c)
+            key = np.abs(win) if isinstance(pool, MaxAbsPooling) else win
+            key = np.where(np.isfinite(win), key, -np.inf)
+            idx[(oy, ox)] = key.argmax(axis=1)
+        return idx
+
+    def numpy_run(self) -> None:
+        pool = self.pooling_unit
+        self.input.map_read()
+        self.pooling_input.map_read()
+        x = self.input.mem
+        px = self.pooling_input.mem
+        n, h, w, c = px.shape
+        self.output.map_invalidate()
+        out = self.output.mem
+        out[...] = 0.0
+        if isinstance(pool, AvgPooling):
+            for oy, ox, y0, y1, x0, x1 in pool._windows(h, w):
+                cnt = (y1 - y0) * (x1 - x0)
+                out[:, y0:y1, x0:x1, :] += \
+                    x[:, oy, ox, None, None, :] / cnt
+            return
+        if not isinstance(pool, (MaxPooling, MaxAbsPooling)):
+            raise TypeError(f"{self}: unsupported pooling type "
+                            f"{type(pool).__name__}")
+        winners = self._winner_idx_np(pool, px)
+        for oy, ox, y0, y1, x0, x1 in pool._windows(h, w):
+            idx = winners[(oy, ox)]                    # (n, c)
+            wy = y0 + idx // pool.kx
+            wx = x0 + idx % pool.kx
+            for s in range(n):
+                for ch in range(c):
+                    out[s, wy[s, ch], wx[s, ch], ch] += x[s, oy, ox, ch]
+
+    def xla_forward(self, x, px):
+        _, vjp = jax.vjp(self.pooling_unit.xla_forward, px)
+        (out,) = vjp(x)
+        return out
+
+    def xla_run(self) -> None:
+        self.output.devmem = self.xla_forward(
+            self.input.devmem, self.pooling_input.devmem)
+
+
+class GDDepooling(WeightlessGradientUnit):
+    """Transpose of depooling = the pooling gather itself:
+    ``err_input[o] = err_output[winner(o)]`` (max) / window mean (avg)."""
+
+    MATCHES = (Depooling,)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        self.init_vectors(self.forward_unit.pooling_input)
+
+    def numpy_run(self) -> None:
+        if not self.need_err_input:
+            return
+        fwd = self.forward_unit
+        pool = fwd.pooling_unit
+        self.err_output.map_read()
+        fwd.pooling_input.map_read()
+        err = self.err_output.mem
+        px = fwd.pooling_input.mem
+        n, h, w, c = px.shape
+        self.err_input.map_invalidate()
+        out = self.err_input.mem
+        if isinstance(pool, AvgPooling):
+            for oy, ox, y0, y1, x0, x1 in pool._windows(h, w):
+                cnt = (y1 - y0) * (x1 - x0)
+                out[:, oy, ox, :] = \
+                    err[:, y0:y1, x0:x1, :].sum(axis=(1, 2)) / cnt
+            return
+        winners = fwd._winner_idx_np(pool, px)
+        for oy, ox, y0, y1, x0, x1 in pool._windows(h, w):
+            idx = winners[(oy, ox)]
+            wy = y0 + idx // pool.kx
+            wx = x0 + idx % pool.kx
+            for s in range(n):
+                for ch in range(c):
+                    out[s, oy, ox, ch] = err[s, wy[s, ch], wx[s, ch], ch]
+
+    def xla_run(self) -> None:
+        fwd = self.forward_unit
+        px = fwd.pooling_input.devmem
+        _, vjp = jax.vjp(lambda xx: fwd.xla_forward(xx, px),
+                         self.input.devmem)
+        (grad_x,) = vjp(self.err_output.devmem)
+        if self.need_err_input:
+            self.err_input.devmem = grad_x

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from znicz_tpu.memory import Vector
-from znicz_tpu.ops.nn_units import Forward, GradientDescentBase
+from znicz_tpu.ops.nn_units import Forward, WeightlessGradientUnit
 
 
 class DropoutForward(Forward):
@@ -73,26 +73,12 @@ class DropoutForward(Forward):
             self.output.devmem = x
 
 
-class DropoutBackward(GradientDescentBase):
+class DropoutBackward(WeightlessGradientUnit):
     MATCHES = (DropoutForward,)
-
-    def __init__(self, workflow, name=None, **kwargs):
-        kwargs.pop("learning_rate", None)  # weightless
-        super().__init__(workflow, name=name, **kwargs)
-        self.forward_unit: DropoutForward | None = None
 
     def region_key(self) -> tuple:
         fwd = self.forward_unit
         return (fwd.forward_mode if fwd is not None else "train",)
-
-    def initialize(self, device=None, **kwargs) -> None:
-        if self.input is None or not self.input:
-            raise AttributeError(f"{self}: input not linked yet")
-        if self.need_err_input and not self.err_input:
-            self.err_input.reset(np.zeros(self.input.shape,
-                                          dtype=np.float32))
-        super().initialize(device=device, **kwargs)
-        self.init_vectors(self.err_input, self.err_output, self.input)
 
     def numpy_run(self) -> None:
         fwd = self.forward_unit

@@ -1,0 +1,73 @@
+"""Gradient unit for Deconv (reference: ``znicz/gd_deconv.py``).
+
+XLA path: ``jax.vjp`` of :meth:`Deconv.xla_forward` — for a transposed
+conv that is again a plain conv, lowered natively by XLA.  Numpy
+oracle: the explicit transpose math (im2col of the incoming error),
+independently implemented.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+from znicz_tpu.ops.conv import im2col
+from znicz_tpu.ops.deconv import Deconv
+from znicz_tpu.ops.nn_units import GradientDescentBase
+
+
+class GDDeconv(GradientDescentBase):
+    MATCHES = (Deconv,)
+
+    def __init__(self, workflow, name=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.forward_unit = None  # set by link_gds / the sample
+
+    def initialize(self, device=None, **kwargs) -> None:
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if self.need_err_input and not self.err_input:
+            self.err_input.reset(np.zeros(self.input.shape,
+                                          dtype=np.float32))
+        super().initialize(device=device, **kwargs)
+        self.init_vectors(self.err_input, self.err_output, self.input,
+                          self.output, self.weights, self.bias)
+
+    def numpy_run(self) -> None:
+        fwd = self.forward_unit
+        for vec in (self.err_output, self.input, self.output):
+            vec.map_read()
+        self.weights.map_write()
+        x = self.input.mem.astype(np.float32)
+        w = self.weights.mem
+        n, ih, iw, k = x.shape
+        w2d = w.reshape(-1, k)                       # (ky*kx*C, K)
+        delta = self.err_output.mem * fwd.activation.derivative(
+            np, self.output.mem, None)
+        ecols = im2col(delta, fwd.ky, fwd.kx, *fwd.sliding, fwd.padding)
+        ecols2d = ecols.reshape(-1, ecols.shape[-1])
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = (
+                ecols2d @ w2d).reshape(x.shape)
+        grad_w = (ecols2d.T @ x.reshape(-1, k)).reshape(w.shape)
+        self._apply_weights_np(grad_w)
+        if self.bias is not None and self.bias:
+            self.bias.map_write()
+            self._apply_bias_np(delta.sum(axis=(0, 1, 2)))
+
+    def xla_run(self) -> None:
+        fwd = self.forward_unit
+        x = self.input.devmem
+        w = self.weights.devmem
+        has_bias = self.bias is not None and self.bias
+        b = self.bias.devmem if has_bias else None
+        _, vjp = jax.vjp(lambda xx, ww, bb: fwd.xla_forward(xx, ww, bb),
+                         x, w, b)
+        grad_x, grad_w, grad_b = vjp(self.err_output.devmem)
+        if self.need_err_input:
+            self.err_input.devmem = grad_x
+        self._apply_weights_xla(grad_w)
+        if has_bias:
+            self._apply_bias_xla(grad_b)

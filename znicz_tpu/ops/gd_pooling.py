@@ -16,33 +16,17 @@ import numpy as np
 
 import jax.numpy as jnp
 
-from znicz_tpu.ops.nn_units import GradientDescentBase
+from znicz_tpu.ops.nn_units import WeightlessGradientUnit
 from znicz_tpu.ops.pooling import (
     AvgPooling,
     MaxAbsPooling,
     MaxPooling,
-    Pooling,
     StochasticPooling,
 )
 
 
-class GDPoolingBase(GradientDescentBase):
+class GDPoolingBase(WeightlessGradientUnit):
     """Weightless backward: transforms err_output → err_input."""
-
-    def __init__(self, workflow, name=None, **kwargs):
-        kwargs.pop("learning_rate", None)  # weightless; tolerate configs
-        super().__init__(workflow, name=name, **kwargs)
-        self.forward_unit: Pooling | None = None
-
-    def initialize(self, device=None, **kwargs) -> None:
-        if self.input is None or not self.input:
-            raise AttributeError(f"{self}: input not linked yet")
-        if self.need_err_input and not self.err_input:
-            self.err_input.reset(np.zeros(self.input.shape,
-                                          dtype=np.float32))
-        super().initialize(device=device, **kwargs)
-        self.init_vectors(self.err_input, self.err_output, self.input,
-                          self.output)
 
     # -- shared geometry helpers ---------------------------------------
     def _stack_windows(self, x):

@@ -1,0 +1,121 @@
+"""InputJoiner (reference: ``veles/input_joiner.py``).
+
+Concatenates several units' outputs into one ``(batch, Σ features)``
+Vector — the reference used a small OpenCL copy kernel per input; here
+it is one ``jnp.concatenate`` the jit region fuses away.
+
+Wiring: ``join.link_inputs(a, b, ...)`` aliases each source's
+``output`` Vector; a paired :class:`GDInputJoiner` splits the error
+back by the recorded offsets.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops.nn_units import Forward, WeightlessGradientUnit
+
+
+class InputJoiner(Forward):
+    def __init__(self, workflow, name=None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.inputs: list[Vector] = []
+        self.offsets: list[int] = []
+
+    def link_inputs(self, *units) -> "InputJoiner":
+        for unit in units:
+            self.inputs.append(unit.output)
+        return self
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if not self.inputs:
+            raise AttributeError(f"{self}: no inputs linked")
+        for vec in self.inputs:
+            if not vec:
+                raise AttributeError(f"{self}: input '{vec.name}' "
+                                     f"not allocated yet")
+        n = self.inputs[0].shape[0]
+        sizes = []
+        for vec in self.inputs:
+            if vec.shape[0] != n:
+                raise ValueError(f"{self}: batch mismatch")
+            sizes.append(vec.sample_size)
+        self.offsets = list(np.cumsum([0] + sizes))
+        self.output.reset(np.zeros((n, self.offsets[-1]),
+                                   dtype=np.float32))
+        self.init_vectors(self.output, *self.inputs)
+
+    def region_vectors(self) -> list[Vector]:
+        # the inputs list is invisible to the default __dict__ scan
+        vecs = super().region_vectors()
+        seen = {id(v) for v in vecs}
+        for vec in self.inputs:
+            if id(vec) not in seen:
+                vecs.append(vec)
+        return vecs
+
+    def numpy_run(self) -> None:
+        n = self.inputs[0].shape[0]
+        self.output.map_invalidate()
+        parts = []
+        for vec in self.inputs:
+            vec.map_read()
+            parts.append(vec.mem.reshape(n, -1))
+        self.output.mem[...] = np.concatenate(parts, axis=1)
+
+    def xla_run(self) -> None:
+        n = self.inputs[0].shape[0]
+        self.output.devmem = jnp.concatenate(
+            [vec.devmem.reshape(n, -1) for vec in self.inputs], axis=1)
+
+
+class GDInputJoiner(WeightlessGradientUnit):
+    """Split the joined error back into per-source pieces
+    (``err_inputs[i]`` matches ``forward_unit.inputs[i]``)."""
+
+    MATCHES = (InputJoiner,)
+    REQUIRES_INPUT = False  # fans the error out to err_inputs instead
+
+    def __init__(self, workflow, name=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.err_inputs: list[Vector] = []
+
+    def initialize(self, device=None, **kwargs) -> None:
+        fwd = self.forward_unit
+        if fwd is not None and not fwd.inputs:
+            raise AttributeError(f"{self}: forward_unit has no inputs yet")
+        if fwd is not None and not self.err_inputs:
+            self.err_inputs = [
+                Vector(np.zeros(vec.shape, dtype=np.float32),
+                       name=f"{self.name}.err_input{i}", batch_major=True)
+                for i, vec in enumerate(fwd.inputs)]
+        super().initialize(device=device, **kwargs)
+        self.init_vectors(*self.err_inputs)
+
+    def region_vectors(self) -> list[Vector]:
+        vecs = super().region_vectors()
+        seen = {id(v) for v in vecs}
+        for vec in self.err_inputs:
+            if id(vec) not in seen:
+                vecs.append(vec)
+        return vecs
+
+    def numpy_run(self) -> None:
+        fwd = self.forward_unit
+        self.err_output.map_read()
+        err = self.err_output.mem
+        for vec, lo, hi in zip(self.err_inputs, fwd.offsets,
+                               fwd.offsets[1:]):
+            vec.map_invalidate()
+            vec.mem[...] = err[:, lo:hi].reshape(vec.shape)
+
+    def xla_run(self) -> None:
+        fwd = self.forward_unit
+        err = self.err_output.devmem
+        for vec, lo, hi in zip(self.err_inputs, fwd.offsets,
+                               fwd.offsets[1:]):
+            vec.devmem = err[:, lo:hi].reshape(vec.shape)

@@ -1,0 +1,69 @@
+"""MeanDispNormalizer (reference: ``znicz/mean_disp_normalizer.py``).
+
+``y = (x − mean) · rdisp`` — per-feature input whitening using dataset
+statistics computed by the loader (the reference shipped ``mean`` and
+reciprocal-dispersion ``rdisp`` Vectors from its ImageNet loader).
+Elementwise — XLA fuses it into the first conv's prologue; no Pallas
+needed.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops.nn_units import Forward, WeightlessGradientUnit
+
+
+class MeanDispNormalizer(Forward):
+    """Weightless whitening unit; ``mean``/``rdisp`` usually linked
+    from the loader (``link_attrs(loader, "mean", "rdisp")``)."""
+
+    def __init__(self, workflow, name=None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.mean: Vector | None = None
+        self.rdisp: Vector | None = None
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if self.mean is None or not self.mean:
+            raise AttributeError(f"{self}: mean not linked/set")
+        if self.rdisp is None or not self.rdisp:
+            raise AttributeError(f"{self}: rdisp not linked/set")
+        self.output.reset(np.zeros(self.input.shape, dtype=np.float32))
+        self.init_vectors(self.input, self.output, self.mean, self.rdisp)
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.mean.map_read()
+        self.rdisp.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = (
+            (self.input.mem.astype(np.float32) - self.mean.mem)
+            * self.rdisp.mem)
+
+    def xla_run(self) -> None:
+        self.output.devmem = (
+            (self.input.devmem - self.mean.devmem) * self.rdisp.devmem)
+
+
+class GDMeanDispNormalizer(WeightlessGradientUnit):
+    """``err_input = err_output · rdisp`` (linear unit transpose)."""
+
+    MATCHES = (MeanDispNormalizer,)
+
+    def numpy_run(self) -> None:
+        if not self.need_err_input:
+            return
+        fwd = self.forward_unit
+        self.err_output.map_read()
+        fwd.rdisp.map_read()
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = self.err_output.mem * fwd.rdisp.mem
+
+    def xla_run(self) -> None:
+        if self.need_err_input:
+            self.err_input.devmem = (
+                self.err_output.devmem * self.forward_unit.rdisp.devmem)

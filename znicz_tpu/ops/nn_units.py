@@ -254,3 +254,44 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             self.bias.devmem = b + acc
         else:
             self.bias.devmem = b - self.learning_rate_bias * g
+
+
+# ----------------------------------------------------------------------
+# Weightless backward base
+# ----------------------------------------------------------------------
+class WeightlessGradientUnit(GradientDescentBase):
+    """Base for backward units of weightless forwards (pooling, dropout,
+    cutter, depooling, normalizers, joiners): no learning-rate state,
+    ``err_output → err_input`` only.
+
+    Handles the shared lifecycle: tolerating optimizer kwargs from
+    ``"<-"`` configs, requiring a linked ``input``, allocating
+    ``err_input`` to match it, and registering the standard region
+    leaves.  Subclasses that need their paired forward at initialize
+    time set ``REQUIRES_FORWARD_UNIT = True`` to get a labeled error
+    instead of a mid-training ``NoneType`` crash.
+    """
+
+    REQUIRES_FORWARD_UNIT = True
+    REQUIRES_INPUT = True
+
+    def __init__(self, workflow, name=None, **kwargs):
+        kwargs.pop("learning_rate", None)  # weightless; tolerate configs
+        super().__init__(workflow, name=name, **kwargs)
+        self.forward_unit = None  # set by link_gds / the sample
+
+    def initialize(self, device=None, **kwargs) -> None:
+        if self.REQUIRES_FORWARD_UNIT and self.forward_unit is None:
+            raise ValueError(
+                f"{self}: forward_unit not set — assign the paired "
+                f"forward unit before initialize (link_attrs does not "
+                f"do this)")
+        if self.REQUIRES_INPUT:
+            if self.input is None or not self.input:
+                raise AttributeError(f"{self}: input not linked yet")
+            if self.need_err_input and not self.err_input:
+                self.err_input.reset(np.zeros(self.input.shape,
+                                              dtype=np.float32))
+        super().initialize(device=device, **kwargs)
+        self.init_vectors(self.err_input, self.err_output, self.input,
+                          self.output)
