@@ -1,0 +1,97 @@
+"""Kohonen SOM: forward winner math + trainer update vs oracle, and
+functional self-organization (reference pattern:
+``znicz/tests/unit/test_kohonen.py``)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow
+from znicz_tpu.memory import Vector
+from znicz_tpu.models.samples import kohonen as kohonen_sample
+from znicz_tpu.ops.kohonen import KohonenForward, KohonenTrainer
+
+RNG = np.random.default_rng(77)
+
+
+def build_pair(device, x, w, **trainer_kwargs):
+    wf = DummyWorkflow()
+    src = DummyUnit(wf, output=Vector(x.copy(), name="x"))
+    fwd = KohonenForward(wf, shape=(3, 4))
+    fwd.link_attrs(src, ("input", "output"))
+    fwd.weights.reset(w.copy())
+    fwd.initialize(device=device)
+    tr = KohonenTrainer(wf, **trainer_kwargs)
+    tr.link_attrs(src, ("input", "output"))
+    tr.link_attrs(fwd, "weights", "winners")
+    tr.shape_grid = (3, 4)
+    tr.initialize(device=device)
+    return fwd, tr
+
+
+def test_forward_and_trainer_agreement():
+    x = RNG.normal(size=(10, 5)).astype(np.float32)
+    w = RNG.normal(size=(12, 5)).astype(np.float32)
+    outs = {}
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        fwd, tr = build_pair(device, x, w, learning_rate=0.4,
+                             decay_steps=50)
+        for _ in range(3):           # three steps advance the clock too
+            fwd.run()
+            tr.run()
+        for vec in (fwd.winners, fwd.output, fwd.weights, tr.time):
+            vec.map_read()
+        outs[name] = (fwd.winners.mem.copy(), fwd.output.mem.copy(),
+                      fwd.weights.mem.copy(), float(tr.time.mem))
+    np.testing.assert_array_equal(outs["np"][0], outs["xla"][0])
+    np.testing.assert_allclose(outs["np"][1], outs["xla"][1],
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(outs["np"][2], outs["xla"][2],
+                               rtol=1e-4, atol=1e-5)
+    assert outs["np"][3] == outs["xla"][3] == 3.0
+
+
+def test_forward_winner_golden():
+    wf = DummyWorkflow()
+    w = np.zeros((12, 2), np.float32)
+    w[7] = [1.0, 1.0]
+    x = np.array([[0.9, 1.1], [-5.0, -5.0]], np.float32)
+    src = DummyUnit(wf, output=Vector(x, name="x"))
+    fwd = KohonenForward(wf, shape=(3, 4))
+    fwd.link_attrs(src, ("input", "output"))
+    fwd.weights.reset(w)
+    fwd.initialize(device=NumpyDevice())
+    fwd.run()
+    assert fwd.winners.mem[0] == 7          # nearest is the [1,1] neuron
+    assert fwd.winners.mem[1] != 7
+    fwd.hits.map_read()
+    assert fwd.hits.mem.sum() == 2
+
+
+def test_trainer_pulls_weights_toward_data():
+    """One update moves the winner's weight strictly toward the sample."""
+    x = np.tile([2.0, 2.0], (8, 1)).astype(np.float32)
+    w = RNG.normal(size=(12, 2)).astype(np.float32)
+    fwd, tr = build_pair(NumpyDevice(), x, w, learning_rate=0.5)
+    fwd.run()
+    before = np.linalg.norm(fwd.weights.mem - [2.0, 2.0], axis=1).copy()
+    tr.run()
+    after = np.linalg.norm(fwd.weights.mem - [2.0, 2.0], axis=1)
+    assert (after < before + 1e-6).all()     # nobody moves away
+    assert after[fwd.winners.mem[0]] < before[fwd.winners.mem[0]]
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, XLADevice])
+def test_som_sample_organizes(device_cls):
+    """Functional: quantization error drops sharply vs the first epoch
+    and most neurons get used (the map unfolds)."""
+    wf = kohonen_sample.build(max_epochs=1)
+    wf.initialize(device=device_cls())
+    wf.run()
+    first_qe = wf.decision.epoch_qe
+    wf2 = kohonen_sample.build(max_epochs=10)
+    wf2.initialize(device=device_cls())
+    wf2.run()
+    assert wf2.decision.best_qe < 0.5 * first_qe, (
+        f"SOM did not organize: first {first_qe}, "
+        f"best {wf2.decision.best_qe}")

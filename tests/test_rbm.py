@@ -1,0 +1,137 @@
+"""RBM units: deterministic CD-1 math vs oracle, sampling statistics,
+and functional convergence of the MnistRBM-style sample
+(reference pattern: ``znicz/tests/unit/test_rbm.py`` +
+``tests/functional/test_mnist_rbm.py``)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow
+from znicz_tpu.memory import Vector
+from znicz_tpu.models.samples import mnist_rbm
+from znicz_tpu.ops.rbm_units import BatchWeights, Binarization, GradientRBM
+
+RNG = np.random.default_rng(11)
+
+
+def test_binarization_statistics():
+    """Sampled means track the probabilities on both backends
+    (streams differ by design; parity is statistical)."""
+    p = np.tile(np.linspace(0.05, 0.95, 10), (4000, 1)).astype(np.float32)
+    for device in (NumpyDevice(), XLADevice()):
+        wf = DummyWorkflow()
+        src = DummyUnit(wf, output=Vector(p.copy(), name="p"))
+        unit = Binarization(wf)
+        unit.link_attrs(src, ("input", "output"))
+        unit.initialize(device=device)
+        unit.run()
+        unit.output.map_read()
+        out = unit.output.mem
+        assert set(np.unique(out)) <= {0.0, 1.0}
+        np.testing.assert_allclose(out.mean(axis=0), p[0], atol=0.04)
+
+
+def test_batch_weights_agreement():
+    v = RNG.normal(size=(16, 12)).astype(np.float32)
+    h = RNG.normal(size=(16, 7)).astype(np.float32)
+    outs = {}
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        wf = DummyWorkflow()
+        uv = DummyUnit(wf, output=Vector(v.copy(), name="v"))
+        uh = DummyUnit(wf, output=Vector(h.copy(), name="h"))
+        unit = BatchWeights(wf)
+        unit.link_attrs(uv, ("v", "output"))
+        unit.link_attrs(uh, ("h", "output"))
+        unit.initialize(device=device)
+        unit.run()
+        for vec in (unit.weights_batch, unit.v_mean, unit.h_mean):
+            vec.map_read()
+        outs[name] = (unit.weights_batch.mem.copy(),
+                      unit.v_mean.mem.copy(), unit.h_mean.mem.copy())
+    for a, b in zip(outs["np"], outs["xla"]):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(outs["np"][0], v.T @ h / 16,
+                               rtol=1e-5, atol=1e-6)
+
+
+def build_grbm(device, v0, h0, s0, w, hb, vb, **kwargs):
+    wf = DummyWorkflow()
+    uv = DummyUnit(wf, output=Vector(v0.copy(), name="v0"))
+    uh = DummyUnit(wf, output=Vector(h0.copy(), name="h0"))
+    us = DummyUnit(wf, output=Vector(s0.copy(), name="s0"))
+    uw = DummyUnit(wf, w=Vector(w.copy(), name="w"),
+                   b=Vector(hb.copy(), name="hb"))
+    unit = GradientRBM(wf, learning_rate=0.1, **kwargs)
+    unit.link_attrs(uv, ("input", "output"))
+    unit.link_attrs(uh, ("hidden", "output"))
+    unit.link_attrs(us, ("hidden_sample", "output"))
+    unit.link_attrs(uw, ("weights", "w"), ("hbias", "b"))
+    unit.vbias.reset(vb.copy())
+    unit.initialize(device=device)
+    return unit
+
+
+def test_gradient_rbm_cd1_agreement():
+    """CD-1 given a fixed hidden sample is deterministic — numpy and
+    XLA must agree on reconstruction AND updated parameters."""
+    n, nv, nh = 8, 12, 6
+    v0 = (RNG.uniform(size=(n, nv)) < 0.4).astype(np.float32)
+    w = RNG.normal(0, 0.1, size=(nv, nh)).astype(np.float32)
+    hb = RNG.normal(0, 0.1, size=(nh,)).astype(np.float32)
+    vb = RNG.normal(0, 0.1, size=(nv,)).astype(np.float32)
+    h0 = 1.0 / (1.0 + np.exp(-(v0 @ w + hb)))
+    s0 = (RNG.uniform(size=h0.shape) < h0).astype(np.float32)
+    outs = {}
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        unit = build_grbm(device, v0, h0.astype(np.float32), s0, w, hb, vb)
+        unit.run()
+        for vec in (unit.reconstruction, unit.weights, unit.hbias,
+                    unit.vbias):
+            vec.map_read()
+        outs[name] = (unit.reconstruction.mem.copy(),
+                      unit.weights.mem.copy(), unit.hbias.mem.copy(),
+                      unit.vbias.mem.copy())
+    for a, b in zip(outs["np"], outs["xla"]):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+    # golden: the oracle's own CD-1 written out longhand
+    v1 = 1.0 / (1.0 + np.exp(-(s0 @ w.T + vb)))
+    h1 = 1.0 / (1.0 + np.exp(-(v1 @ w + hb)))
+    grad_w = (v0.T @ h0 - v1.T @ h1) / n
+    np.testing.assert_allclose(outs["np"][1], w + 0.1 * grad_w,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_gradient_rbm_eval_mode_freezes_weights():
+    n, nv, nh = 4, 6, 3
+    v0 = (RNG.uniform(size=(n, nv)) < 0.5).astype(np.float32)
+    w = RNG.normal(0, 0.1, size=(nv, nh)).astype(np.float32)
+    hb = np.zeros(nh, np.float32)
+    vb = np.zeros(nv, np.float32)
+    h0 = 1.0 / (1.0 + np.exp(-(v0 @ w)))
+    s0 = (h0 > 0.5).astype(np.float32)
+    for device in (NumpyDevice(), XLADevice()):
+        unit = build_grbm(device, v0, h0.astype(np.float32), s0, w, hb, vb)
+        unit.forward_mode = "eval"
+        unit.run()
+        unit.weights.map_read()
+        np.testing.assert_array_equal(unit.weights.mem, w)
+        unit.reconstruction.map_read()
+        assert unit.reconstruction.mem.shape == (n, nv)
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, XLADevice])
+def test_rbm_sample_reconstruction_improves(device_cls):
+    """Functional: CD-1 training lowers validation reconstruction MSE
+    well below the untrained level (reference pattern: fixed-seed
+    convergence bound)."""
+    wf = mnist_rbm.build(max_epochs=1)
+    wf.initialize(device=device_cls())
+    wf.run()
+    first_epoch_mse = wf.decision.epoch_mse[1]
+    wf2 = mnist_rbm.build(max_epochs=15)
+    wf2.initialize(device=device_cls())
+    wf2.run()
+    assert wf2.decision.min_validation_mse < 0.75 * first_epoch_mse, (
+        f"no improvement: first epoch {first_epoch_mse}, "
+        f"best {wf2.decision.min_validation_mse}")

@@ -27,9 +27,9 @@ from znicz_tpu import datasets
 from znicz_tpu.backends import Device
 from znicz_tpu.loader.fullbatch import ArrayLoader
 from znicz_tpu.models.standard_workflow import StandardWorkflow
-from znicz_tpu.utils.config import root
+from znicz_tpu.utils.config import register_defaults, root
 
-root.alexnet.update({
+register_defaults("alexnet", {
     "minibatch_size": 128,
     "learning_rate": 0.01,
     "gradient_moment": 0.9,

@@ -12,9 +12,9 @@ from znicz_tpu import datasets
 from znicz_tpu.backends import Device
 from znicz_tpu.loader.fullbatch import ArrayLoader
 from znicz_tpu.models.standard_workflow import StandardWorkflow
-from znicz_tpu.utils.config import root
+from znicz_tpu.utils.config import register_defaults, root
 
-root.cifar.update({
+register_defaults("cifar", {
     "minibatch_size": 100,
     "learning_rate": 0.02,
     "gradient_moment": 0.9,

@@ -1,0 +1,178 @@
+"""Kohonen SOM sample (reference: ``znicz/samples/Kohonen/`` /
+``DemoKohonen`` — unsupervised 2-D map of a point cloud).
+
+Topology:
+
+.. code-block:: text
+
+    repeater → loader → kohonen_forward(winners) → kohonen_trainer
+             → decision(epochs) → loop
+
+Quality metric: mean quantization error (squared distance to the
+winner), accumulated on device per epoch like the evaluators do.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.accelerated_units import AcceleratedWorkflow, RegionUnit
+from znicz_tpu.backends import Device, NumpyDevice
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops.decision import DecisionBase
+from znicz_tpu.ops.kohonen import KohonenForward, KohonenTrainer
+from znicz_tpu.units import Repeater
+from znicz_tpu.utils.config import register_defaults, root
+
+register_defaults("kohonen", {
+    "minibatch_size": 40,
+    "shape": (8, 8),
+    "learning_rate": 0.5,
+    "max_epochs": 12,
+})
+
+
+def make_data(seed: int = 31, n: int = 800):
+    """Ring + two blobs in 2-D — classic SOM demo distribution."""
+    rng = np.random.default_rng(seed)
+    theta = rng.uniform(0, 2 * np.pi, n // 2)
+    ring = np.stack([np.cos(theta), np.sin(theta)], 1)
+    ring += 0.05 * rng.normal(size=ring.shape)
+    blobs = np.concatenate([
+        [2.0, 0.5] + 0.15 * rng.normal(size=(n // 4, 2)),
+        [-1.5, -1.5] + 0.15 * rng.normal(size=(n // 4, 2))])
+    data = np.concatenate([ring, blobs]).astype(np.float32)
+    return data[rng.permutation(len(data))]
+
+
+class DecisionSOM(DecisionBase):
+    """Epoch bookkeeping on the accumulated quantization error."""
+
+    SNAPSHOT_ATTRS = ("epoch_qe", "best_qe", "_epochs_without_improvement")
+
+    def __init__(self, workflow, name=None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.forward = None   # KohonenForward (hits + epoch_qe live there)
+        self.epoch_qe = np.inf
+        self.best_qe = None
+
+    def accumulate_minibatch(self) -> None:
+        pass  # accumulated on device (forward.epoch_qe)
+
+    def on_epoch_ended(self) -> None:
+        acc: Vector = self.forward.epoch_qe
+        acc.map_read()
+        n = max(self.loader.total_samples, 1)
+        self.epoch_qe = float(acc.mem) / n
+        acc.map_invalidate()
+        acc.mem[...] = 0
+        hits = self.forward.hits
+        hits.map_read()
+        used = int((hits.mem > 0).sum())
+        hits.map_invalidate()
+        hits.mem[...] = 0
+        if self.best_qe is None or self.epoch_qe < self.best_qe:
+            self.best_qe = self.epoch_qe
+            self.improved.value = True
+        self.info("epoch %d: quantization err %.5f, neurons used %d/%d",
+                  self.loader.epoch_number, self.epoch_qe, used,
+                  self.forward.n_neurons)
+
+
+class KohonenQE(KohonenForward):
+    """KohonenForward + on-device epoch accumulator of the
+    quantization error (one host sync per epoch, as the evaluators
+    do)."""
+
+    def __init__(self, workflow, shape, name=None, **kwargs) -> None:
+        super().__init__(workflow, shape, name=name, **kwargs)
+        self.epoch_qe = Vector(name=f"{self.name}.epoch_qe")
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if not self.epoch_qe:
+            self.epoch_qe.reset(np.zeros((), dtype=np.float32))
+        self.init_vectors(self.epoch_qe)
+
+    def numpy_run(self) -> None:
+        super().numpy_run()
+        self.epoch_qe.map_write()
+        self.epoch_qe.mem[...] += self.output.mem.sum()
+
+    def xla_run(self) -> None:
+        super().xla_run()
+        self.epoch_qe.devmem = (self.epoch_qe.devmem
+                                + jnp.sum(self.output.devmem))
+
+
+class KohonenWorkflow(AcceleratedWorkflow):
+    def __init__(self, workflow=None, name=None, loader_factory=None,
+                 shape=(8, 8), learning_rate: float = 0.5,
+                 max_epochs: int = 12, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.repeater = Repeater(self, name="repeater")
+        self.loader = loader_factory(self)
+        self.forward = KohonenQE(self, shape, name="kohonen")
+        self.forward.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.trainer = KohonenTrainer(self, name="trainer",
+                                      learning_rate=learning_rate)
+        self.trainer.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.trainer.link_attrs(self.loader, "forward_mode",
+                                two_way=False)
+        self.trainer.link_attrs(self.forward, "weights", "winners")
+        self.trainer.shape_grid = shape
+        self.decision = DecisionSOM(self, name="decision",
+                                    max_epochs=max_epochs)
+        self.decision.loader = self.loader
+        self.decision.forward = self.forward
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        self.forward.link_from(self.loader)
+        self.trainer.link_from(self.forward)
+        self.decision.link_from(self.trainer)
+        self.repeater.link_from(self.decision)
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+        self._region_unit: RegionUnit | None = None
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if not isinstance(self.device, NumpyDevice) \
+                and self._region_unit is None:
+            members = [self.loader, self.forward, self.trainer]
+            region = RegionUnit(self, members, name="som_region")
+            region.initialize(device=self.device)
+            region._initialized = True
+            self.forward.unlink_from(self.loader)
+            self.decision.unlink_from(self.trainer)
+            region.link_from(self.loader)
+            self.decision.link_from(region)
+            self._region_unit = region
+
+
+def build(**overrides) -> KohonenWorkflow:
+    cfg = dict(root.kohonen.as_dict())
+    cfg.update(overrides)
+    data = make_data()
+    n_train = int(0.9 * len(data))
+    wf = KohonenWorkflow(
+        name="kohonen",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:n_train], valid_data=data[n_train:],
+            minibatch_size=cfg["minibatch_size"]),
+        shape=tuple(cfg["shape"]),
+        learning_rate=cfg["learning_rate"],
+        max_epochs=cfg["max_epochs"])
+    wf._max_fires = 10_000_000
+    return wf
+
+
+def run(device: Device | None = None) -> KohonenWorkflow:
+    wf = build()
+    wf.initialize(device=device)
+    wf.run()
+    return wf

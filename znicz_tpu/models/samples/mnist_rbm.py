@@ -1,0 +1,141 @@
+"""MnistRBM: RBM pretraining sample (reference:
+``znicz/samples/MnistRBM/`` — north-star config #4).
+
+No MNIST download in this environment (zero egress); the dataset is a
+synthetic stand-in: noisy binary prototype patterns per class with the
+same value range ([0,1] probabilities) and minibatch geometry.
+
+Workflow topology (custom, like the reference's — RBMs have no
+backward chain, so StandardWorkflow does not apply):
+
+.. code-block:: text
+
+    repeater → loader → encoder(All2AllSigmoid) → binarization
+             → gradient_rbm(CD-1, shares encoder weights/bias)
+             → evaluator_rbm(reconstruction MSE) → decision → loop
+
+On the XLA backend the loader-gather → encoder → sampling → CD update
+→ evaluation chain compiles into ONE jit region per forward_mode.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.accelerated_units import AcceleratedWorkflow, RegionUnit
+from znicz_tpu.backends import Device, NumpyDevice
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.ops.all2all import All2AllSigmoid
+from znicz_tpu.ops.decision import DecisionMSE
+from znicz_tpu.ops.rbm_units import Binarization, EvaluatorRBM, GradientRBM
+from znicz_tpu.units import Repeater
+from znicz_tpu.utils.config import register_defaults, root
+
+register_defaults("mnist_rbm", {
+    "minibatch_size": 32,
+    "n_hidden": 48,
+    "learning_rate": 0.08,
+    "max_epochs": 25,
+})
+
+
+def make_data(seed: int = 23, n_per_class: int = 64, n_classes: int = 6,
+              side: int = 8):
+    """Noisy binary prototype images in [0,1]."""
+    rng = np.random.default_rng(seed)
+    protos = (rng.uniform(size=(n_classes, side * side)) < 0.35)
+    data = np.concatenate([
+        np.clip(p.astype(np.float32)
+                + 0.15 * rng.normal(size=(n_per_class, side * side)),
+                0.0, 1.0)
+        for p in protos]).astype(np.float32)
+    order = rng.permutation(len(data))
+    return data[order]
+
+
+class RBMWorkflow(AcceleratedWorkflow):
+    """CD-1 RBM training workflow."""
+
+    def __init__(self, workflow=None, name: str | None = None,
+                 loader_factory=None, n_hidden: int = 48,
+                 learning_rate: float = 0.08,
+                 max_epochs: int | None = 25, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.repeater = Repeater(self, name="repeater")
+        self.loader = loader_factory(self)
+        self.encoder = All2AllSigmoid(
+            self, output_sample_shape=n_hidden, name="encoder")
+        self.encoder.link_attrs(self.loader,
+                                ("input", "minibatch_data"))
+        self.binarization = Binarization(self, name="binarization")
+        self.binarization.link_attrs(self.encoder, ("input", "output"))
+        self.grbm = GradientRBM(self, name="gradient_rbm",
+                                learning_rate=learning_rate)
+        self.grbm.link_attrs(self.loader, ("input", "minibatch_data"))
+        self.grbm.link_attrs(self.loader, "forward_mode", two_way=False)
+        self.grbm.link_attrs(self.encoder, ("hidden", "output"),
+                             "weights", ("hbias", "bias"))
+        self.grbm.link_attrs(self.binarization,
+                             ("hidden_sample", "output"))
+        self.evaluator = EvaluatorRBM(self, name="evaluator")
+        self.evaluator.link_attrs(self.grbm,
+                                  ("output", "reconstruction"))
+        self.evaluator.link_attrs(self.loader,
+                                  ("target", "minibatch_data"),
+                                  "minibatch_valid", "minibatch_class")
+        self.decision = DecisionMSE(self, name="decision",
+                                    max_epochs=max_epochs)
+        self.decision.loader = self.loader
+        self.decision.evaluator = self.evaluator
+        # control flow
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        self.encoder.link_from(self.loader)
+        self.binarization.link_from(self.encoder)
+        self.grbm.link_from(self.binarization)
+        self.evaluator.link_from(self.grbm)
+        self.decision.link_from(self.evaluator)
+        self.repeater.link_from(self.decision)
+        self.repeater.gate_block = self.decision.complete
+        self.end_point.link_from(self.decision)
+        self.end_point.gate_block = ~self.decision.complete
+        self._region_unit: RegionUnit | None = None
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if not isinstance(self.device, NumpyDevice) \
+                and self._region_unit is None:
+            members = [self.loader, self.encoder, self.binarization,
+                       self.grbm, self.evaluator]
+            region = RegionUnit(self, members, name="rbm_region")
+            region.initialize(device=self.device)
+            region._initialized = True
+            self.encoder.unlink_from(self.loader)
+            self.decision.unlink_from(self.evaluator)
+            region.link_from(self.loader)
+            self.decision.link_from(region)
+            self._region_unit = region
+
+
+def build(**overrides) -> RBMWorkflow:
+    cfg = dict(root.mnist_rbm.as_dict())
+    cfg.update(overrides)
+    data = make_data()
+    n_train = int(0.8 * len(data))
+    wf = RBMWorkflow(
+        name="mnist_rbm",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:n_train], valid_data=data[n_train:],
+            minibatch_size=cfg["minibatch_size"]),
+        n_hidden=cfg["n_hidden"],
+        learning_rate=cfg["learning_rate"],
+        max_epochs=cfg["max_epochs"])
+    wf._max_fires = 10_000_000
+    return wf
+
+
+def run(device: Device | None = None) -> RBMWorkflow:
+    wf = build()
+    wf.initialize(device=device)
+    wf.run()
+    return wf

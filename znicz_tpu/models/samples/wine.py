@@ -12,9 +12,9 @@ import numpy as np
 from znicz_tpu.backends import Device
 from znicz_tpu.loader.fullbatch import ArrayLoader
 from znicz_tpu.models.standard_workflow import StandardWorkflow
-from znicz_tpu.utils.config import root
+from znicz_tpu.utils.config import register_defaults, root
 
-root.wine.update({
+register_defaults("wine", {
     "minibatch_size": 10,
     "learning_rate": 0.3,
     "layers": [8],

@@ -1,0 +1,204 @@
+"""Kohonen self-organizing map units (reference: ``znicz/kohonen.py``
+— ``KohonenForward`` + ``KohonenTrainer`` driving the Kohonen/
+DemoKohonen samples).
+
+- :class:`KohonenForward`: winner neuron per sample,
+  ``argmin ||x − w_i||²`` over an ``sy×sx`` neuron grid; accumulates
+  per-neuron hit counts on device (feeds the KohonenHits plotter).
+- :class:`KohonenTrainer`: classic SOM batch update with Gaussian
+  neighborhood and exponentially decaying radius/learning-rate:
+
+  .. code-block:: text
+
+      h_bi  = exp(−‖grid(win_b) − grid(i)‖² / (2σ(t)²))
+      W    += lr(t)/n · Σ_b h_bi (x_b − w_i)
+
+TPU-first: the distance matrix is one GEMM
+(‖x‖² − 2xWᵀ + ‖w‖²) on the MXU; the neighborhood update is two more
+GEMMs (Hᵀx and column sums) — no scatter, fully deterministic, so
+numpy and XLA agree bit-for-bit up to float tolerance.  The decay
+clock ``time`` lives in a device scalar so the whole trainer stays
+inside the jit region (the reference kept it host-side).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.accelerated_units import AcceleratedUnit
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops.nn_units import Forward
+from znicz_tpu.utils import prng
+
+
+def grid_coords(sy: int, sx: int) -> np.ndarray:
+    """(sy*sx, 2) float grid coordinates, row-major."""
+    yy, xx = np.mgrid[0:sy, 0:sx]
+    return np.stack([yy.ravel(), xx.ravel()], axis=1).astype(np.float32)
+
+
+class KohonenForward(Forward):
+    """Winner lookup (weightless output; weights shared with the
+    trainer)."""
+
+    def __init__(self, workflow, shape: tuple[int, int], name=None,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.shape_grid = (int(shape[0]), int(shape[1]))
+        self.winners = Vector(name=f"{self.name}.winners",
+                              batch_major=True)
+        self.hits = Vector(name=f"{self.name}.hits")  # per-epoch counts
+
+    @property
+    def n_neurons(self) -> int:
+        return self.shape_grid[0] * self.shape_grid[1]
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        n = self.input.shape[0]
+        features = self.input.sample_size
+        if not self.weights:
+            self.weights.reset(self.fill_array(
+                (self.n_neurons, features), self.weights_filling,
+                self.weights_stddev, fan_in=features))
+        # output = squared distance to winner (the SOM's quantization
+        # error contribution); winners = indices
+        self.output.reset(np.zeros((n,), dtype=np.float32))
+        self.winners.reset(np.zeros((n,), dtype=np.int32))
+        if not self.hits:
+            self.hits.reset(np.zeros(self.n_neurons, dtype=np.int32))
+        self.init_vectors(self.input, self.output, self.weights,
+                          self.winners, self.hits)
+
+    @staticmethod
+    def distances(xp, x, w):
+        """(n, n_neurons) squared euclidean distances via one GEMM."""
+        x2 = (x * x).sum(axis=1)[:, None]
+        w2 = (w * w).sum(axis=1)[None, :]
+        return x2 - 2.0 * (x @ w.T) + w2
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.weights.map_read()
+        n = self.input.shape[0]
+        x = self.input.mem.reshape(n, -1).astype(np.float32)
+        d = self.distances(np, x, self.weights.mem)
+        win = d.argmin(axis=1)
+        self.winners.map_invalidate()
+        self.winners.mem[...] = win.astype(np.int32)
+        self.output.map_invalidate()
+        self.output.mem[...] = d[np.arange(n), win]
+        self.hits.map_write()
+        np.add.at(self.hits.mem, win, 1)
+
+    def xla_run(self) -> None:
+        x = self.input.devmem
+        n = x.shape[0]
+        x = x.reshape(n, -1)
+        d = self.distances(jnp, x, self.weights.devmem)
+        win = d.argmin(axis=1).astype(jnp.int32)
+        self.winners.devmem = win
+        self.output.devmem = jnp.take_along_axis(
+            d, win[:, None].astype(jnp.int32), axis=1)[:, 0]
+        self.hits.devmem = self.hits.devmem.at[win].add(1)
+
+
+class KohonenTrainer(AcceleratedUnit):
+    """Batch SOM update (reference: ``KohonenTrainer``)."""
+
+    SNAPSHOT_ATTRS = ("learning_rate", "sigma0", "sigma_inf",
+                      "decay_steps")
+
+    def __init__(self, workflow, name=None,
+                 learning_rate: float = 0.5,
+                 sigma0: float | None = None,
+                 sigma_inf: float = 0.5,
+                 decay_steps: int = 1000,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.learning_rate = learning_rate
+        self.sigma0 = sigma0          # default: half the grid diagonal
+        self.sigma_inf = sigma_inf
+        self.decay_steps = int(decay_steps)
+        self.forward_mode = "train"   # usually linked from loader
+        self.input: Vector | None = None     # (n, features) linked
+        self.weights: Vector | None = None   # shared with forward
+        self.winners: Vector | None = None   # linked from forward
+        self.time = Vector(name=f"{self.name}.time")  # device clock
+        self._coords = Vector(name=f"{self.name}.coords")
+        self.shape_grid: tuple[int, int] | None = None  # from forward
+
+    def region_key(self) -> tuple:
+        return (self.forward_mode,)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        for vec, nm in ((self.input, "input"), (self.weights, "weights"),
+                        (self.winners, "winners")):
+            if vec is None or not vec:
+                raise AttributeError(f"{self}: {nm} not linked yet")
+        if self.shape_grid is None:
+            raise ValueError(f"{self}: shape_grid not set (assign the "
+                             f"paired KohonenForward's grid shape)")
+        sy, sx = self.shape_grid
+        if self.sigma0 is None:
+            self.sigma0 = max(sy, sx) / 2.0
+        self._coords.reset(grid_coords(sy, sx))
+        if not self.time:
+            self.time.reset(np.zeros((), dtype=np.float32))
+        self.init_vectors(self.input, self.weights, self.winners,
+                          self.time, self._coords)
+
+    # -- decayed schedule ----------------------------------------------
+    def _schedule(self, xp, t):
+        frac = xp.minimum(t / float(self.decay_steps), 1.0)
+        sigma = self.sigma0 * (self.sigma_inf / self.sigma0) ** frac
+        lr = self.learning_rate * (0.01) ** frac
+        return sigma, lr
+
+    def _update(self, xp, x, w, win, coords, t):
+        sigma, lr = self._schedule(xp, t)
+        n = x.shape[0]
+        winc = coords[win]                       # (n, 2)
+        d2 = ((winc[:, None, :] - coords[None, :, :]) ** 2).sum(-1)
+        h = xp.exp(-d2 / (2.0 * sigma * sigma))  # (n, n_neurons)
+        num = h.T @ x                            # (n_neurons, features)
+        den = h.sum(axis=0)[:, None]             # (n_neurons, 1)
+        return w + lr / n * (num - den * w)
+
+    def numpy_run(self) -> None:
+        if self.forward_mode != "train":
+            return
+        for vec in (self.input, self.winners, self._coords):
+            vec.map_read()
+        self.weights.map_write()
+        self.time.map_write()
+        n = self.input.shape[0]
+        x = self.input.mem.reshape(n, -1).astype(np.float32)
+        self.weights.mem[...] = self._update(
+            np, x, self.weights.mem, self.winners.mem, self._coords.mem,
+            float(self.time.mem))
+        self.time.mem[...] += 1.0
+
+    def xla_run(self) -> None:
+        if self.forward_mode != "train":
+            return
+        x = self.input.devmem
+        n = x.shape[0]
+        x = x.reshape(n, -1)
+        self.weights.devmem = self._update(
+            jnp, x, self.weights.devmem, self.winners.devmem,
+            self._coords.devmem, self.time.devmem)
+        self.time.devmem = self.time.devmem + 1.0
+
+
+def init_som_weights(shape: tuple[int, int], features: int,
+                     scale: float = 1.0) -> np.ndarray:
+    """Seeded uniform init helper for samples/tests."""
+    gen = prng.get()
+    return gen.fill_uniform((shape[0] * shape[1], features),
+                            -scale, scale, dtype=np.float32)

@@ -1,0 +1,264 @@
+"""Restricted Boltzmann machine units (reference:
+``znicz/rbm_units.py`` — the MnistRBM sample's pretraining stack:
+``Binarization``, ``BatchWeights``, ``GradientRBM``, ``EvaluatorRBM``).
+
+Training is CD-k (contrastive divergence):
+
+.. code-block:: text
+
+    h0 = σ(v0·W + hb)            (All2AllSigmoid — the encoder)
+    s0 = bernoulli(h0)           (Binarization)
+    v1 = σ(s0·Wᵀ + vb)           (reconstruction; probabilities)
+    h1 = σ(v1·W + hb)
+    ΔW = (v0ᵀh0 − v1ᵀh1)/n;  Δhb = mean(h0−h1);  Δvb = mean(v0−v1)
+
+TPU-first: the whole Gibbs chain is a handful of MXU GEMMs +
+elementwise σ inside one jit region; sampling uses the unit's
+device-resident PRNG key chain (``take_key``) so the chain stays
+compiled (reference: custom CUDA/OpenCL sampling kernels).  The numpy
+oracle uses the seeded host PRNG — RNG streams differ across backends
+by design; parity is statistical (SURVEY.md §2.3 PRNG note).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.accelerated_units import AcceleratedUnit
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops.evaluator import EvaluatorMSE
+from znicz_tpu.ops.nn_units import Forward
+from znicz_tpu.utils import prng
+
+
+def _sigmoid(xp, x):
+    return 1.0 / (1.0 + xp.exp(-x))
+
+
+class Binarization(Forward):
+    """Bernoulli-sample a probability tensor: ``out = 1[u < p]``
+    (reference: ``Binarization`` — feeds sampled hidden states into
+    the CD chain)."""
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        self.output.reset(np.zeros(self.input.shape, dtype=np.float32))
+        self.init_vectors(self.input, self.output)
+        self.init_rng()
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.output.map_invalidate()
+        u = prng.get().numpy.uniform(size=self.input.shape)
+        self.output.mem[...] = (u < self.input.mem).astype(np.float32)
+
+    def xla_run(self) -> None:
+        p = self.input.devmem
+        u = jax.random.uniform(self.take_key(), p.shape, dtype=p.dtype)
+        self.output.devmem = (u < p).astype(p.dtype)
+
+
+class BatchWeights(AcceleratedUnit):
+    """Batch outer product ``vᵀh / n`` plus column means — the
+    sufficient statistics of one CD phase (reference:
+    ``BatchWeights``; ``GradientRBM`` composes two of these)."""
+
+    def __init__(self, workflow, name=None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.v: Vector | None = None        # (n, nv) linked
+        self.h: Vector | None = None        # (n, nh) linked
+        self.weights_batch = Vector(name=f"{self.name}.weights_batch")
+        self.v_mean = Vector(name=f"{self.name}.v_mean")
+        self.h_mean = Vector(name=f"{self.name}.h_mean")
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        for vec, nm in ((self.v, "v"), (self.h, "h")):
+            if vec is None or not vec:
+                raise AttributeError(f"{self}: {nm} not linked yet")
+        nv, nh = self.v.shape[1], self.h.shape[1]
+        self.weights_batch.reset(np.zeros((nv, nh), dtype=np.float32))
+        self.v_mean.reset(np.zeros(nv, dtype=np.float32))
+        self.h_mean.reset(np.zeros(nh, dtype=np.float32))
+        self.init_vectors(self.v, self.h, self.weights_batch,
+                          self.v_mean, self.h_mean)
+
+    @staticmethod
+    def stats(xp, v, h):
+        n = v.shape[0]
+        return v.T @ h / n, v.mean(axis=0), h.mean(axis=0)
+
+    def numpy_run(self) -> None:
+        self.v.map_read()
+        self.h.map_read()
+        w, vm, hm = self.stats(np, self.v.mem, self.h.mem)
+        for vec, val in ((self.weights_batch, w), (self.v_mean, vm),
+                         (self.h_mean, hm)):
+            vec.map_invalidate()
+            vec.mem[...] = val
+
+    def xla_run(self) -> None:
+        w, vm, hm = self.stats(jnp, self.v.devmem, self.h.devmem)
+        self.weights_batch.devmem = w
+        self.v_mean.devmem = vm
+        self.h_mean.devmem = hm
+
+
+class GradientRBM(AcceleratedUnit):
+    """CD-k weight update + reconstruction (reference:
+    ``GradientRBM``).
+
+    Links: ``input`` = v0 (data), ``hidden`` = h0 probabilities,
+    ``hidden_sample`` = binarized h0, shared ``weights`` (nv, nh) and
+    ``hbias`` with the encoder All2AllSigmoid; owns ``vbias``.
+    ``forward_mode`` (linked from the loader) gates the update: eval
+    minibatches only compute the reconstruction.
+    """
+
+    SNAPSHOT_ATTRS = ("learning_rate", "gradient_moment")
+
+    def __init__(self, workflow, name=None, learning_rate: float = 0.1,
+                 gradient_moment: float = 0.0, cd_k: int = 1,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.learning_rate = learning_rate
+        self.gradient_moment = gradient_moment
+        self.cd_k = int(cd_k)
+        self.forward_mode = "train"     # usually linked from loader
+        self.input: Vector | None = None
+        self.hidden: Vector | None = None
+        self.hidden_sample: Vector | None = None
+        self.weights: Vector | None = None
+        self.hbias: Vector | None = None
+        self.vbias = Vector(name=f"{self.name}.vbias")
+        self.reconstruction = Vector(name=f"{self.name}.reconstruction",
+                                     batch_major=True)
+        self._acc_w = Vector(name=f"{self.name}.acc_w")
+        self._acc_vb = Vector(name=f"{self.name}.acc_vb")
+        self._acc_hb = Vector(name=f"{self.name}.acc_hb")
+
+    def region_key(self) -> tuple:
+        return (self.forward_mode,)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        for vec, nm in ((self.input, "input"), (self.hidden, "hidden"),
+                        (self.hidden_sample, "hidden_sample"),
+                        (self.weights, "weights"), (self.hbias, "hbias")):
+            if vec is None or not vec:
+                raise AttributeError(f"{self}: {nm} not linked yet")
+        nv = self.input.sample_size
+        if not self.vbias:
+            self.vbias.reset(np.zeros(nv, dtype=np.float32))
+        self.reconstruction.reset(
+            np.zeros((self.input.shape[0], nv), dtype=np.float32))
+        if self.gradient_moment:
+            self._acc_w.reset(np.zeros(self.weights.shape,
+                                       dtype=np.float32))
+            self._acc_vb.reset(np.zeros(nv, dtype=np.float32))
+            self._acc_hb.reset(np.zeros(self.hbias.shape,
+                                        dtype=np.float32))
+        self.init_vectors(self.input, self.hidden, self.hidden_sample,
+                          self.weights, self.hbias, self.vbias,
+                          self.reconstruction, self._acc_w,
+                          self._acc_vb, self._acc_hb)
+        self.init_rng()
+
+    # -- the CD chain (xp-generic except sampling) ----------------------
+    def _gibbs(self, xp, v0, h0, s0, w, hb, vb, sample):
+        """One CD-k chain from sampled h; returns (v1, h1)."""
+        s = s0
+        for _ in range(self.cd_k):
+            v1 = _sigmoid(xp, s @ w.T + vb)
+            h1 = _sigmoid(xp, v1 @ w + hb)
+            if self.cd_k > 1:
+                s = sample(h1)
+        return v1, h1
+
+    def numpy_run(self) -> None:
+        for vec in (self.input, self.hidden, self.hidden_sample,
+                    self.weights, self.hbias, self.vbias):
+            vec.map_read()
+        n = self.input.shape[0]
+        v0 = self.input.mem.reshape(n, -1).astype(np.float32)
+        h0 = self.hidden.mem
+        s0 = self.hidden_sample.mem
+        w = self.weights.mem
+        rnd = prng.get().numpy
+
+        def sample(p):
+            return (rnd.uniform(size=p.shape) < p).astype(np.float32)
+
+        v1, h1 = self._gibbs(np, v0, h0, s0, w, self.hbias.mem,
+                             self.vbias.mem, sample)
+        self.reconstruction.map_invalidate()
+        self.reconstruction.mem[...] = v1
+        if self.forward_mode != "train":
+            return
+        pos_w, pos_v, pos_h = BatchWeights.stats(np, v0, h0)
+        neg_w, neg_v, neg_h = BatchWeights.stats(np, v1, h1)
+        self.weights.map_write()
+        self.hbias.map_write()
+        self.vbias.map_write()
+        self._apply_np(self.weights.mem, pos_w - neg_w, self._acc_w)
+        self._apply_np(self.vbias.mem, pos_v - neg_v, self._acc_vb)
+        self._apply_np(self.hbias.mem, pos_h - neg_h, self._acc_hb)
+
+    def _apply_np(self, param, grad, acc_vec) -> None:
+        if self.gradient_moment:
+            acc_vec.map_write()
+            acc = acc_vec.mem
+            acc *= self.gradient_moment
+            acc += self.learning_rate * grad
+            param += acc
+        else:
+            param += self.learning_rate * grad
+
+    def xla_run(self) -> None:
+        n = self.input.devmem.shape[0]
+        v0 = self.input.devmem.reshape(n, -1)
+        h0 = self.hidden.devmem
+        s0 = self.hidden_sample.devmem
+        w = self.weights.devmem
+        hb = self.hbias.devmem
+        vb = self.vbias.devmem
+
+        def sample(p):
+            u = jax.random.uniform(self.take_key(), p.shape,
+                                   dtype=p.dtype)
+            return (u < p).astype(p.dtype)
+
+        v1, h1 = self._gibbs(jnp, v0, h0, s0, w, hb, vb, sample)
+        self.reconstruction.devmem = v1
+        if self.forward_mode != "train":
+            return
+        pos_w, pos_v, pos_h = BatchWeights.stats(jnp, v0, h0)
+        neg_w, neg_v, neg_h = BatchWeights.stats(jnp, v1, h1)
+        lr = self.learning_rate
+        if self.gradient_moment:
+            m = self.gradient_moment
+            acc_w = m * self._acc_w.devmem + lr * (pos_w - neg_w)
+            acc_vb = m * self._acc_vb.devmem + lr * (pos_v - neg_v)
+            acc_hb = m * self._acc_hb.devmem + lr * (pos_h - neg_h)
+            self._acc_w.devmem = acc_w
+            self._acc_vb.devmem = acc_vb
+            self._acc_hb.devmem = acc_hb
+            self.weights.devmem = w + acc_w
+            self.vbias.devmem = vb + acc_vb
+            self.hbias.devmem = hb + acc_hb
+        else:
+            self.weights.devmem = w + lr * (pos_w - neg_w)
+            self.vbias.devmem = vb + lr * (pos_v - neg_v)
+            self.hbias.devmem = hb + lr * (pos_h - neg_h)
+
+
+class EvaluatorRBM(EvaluatorMSE):
+    """Reconstruction-error evaluator (reference: ``EvaluatorRBM``):
+    MSE between ``GradientRBM.reconstruction`` and the input data.
+    The err_output it emits is unused — an RBM has no backward chain —
+    but the epoch-accumulated metric drives DecisionMSE unchanged."""

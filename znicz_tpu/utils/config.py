@@ -20,6 +20,7 @@ TPU-first deltas vs the reference:
 
 from __future__ import annotations
 
+import copy
 import os
 from typing import Any, Iterator
 
@@ -102,9 +103,26 @@ def _default_root() -> Config:
 #: The global configuration tree, mutated by sample ``*_config.py`` files.
 root = _default_root()
 
+#: sample-default subtrees re-applied on reset (name → dict)
+_registered_defaults: dict[str, dict] = {}
+
+
+def register_defaults(name: str, defaults: dict) -> None:
+    """Register a sample's default config subtree under ``root.<name>``.
+
+    Samples call this at import; the defaults survive :func:`reset_root`
+    (tests reset between cases) while explicit ``root.<name>.*``
+    mutations by config files still override them.
+    """
+    _registered_defaults[name] = copy.deepcopy(defaults)
+    getattr(root, name).update(copy.deepcopy(defaults))
+
 
 def reset_root() -> None:
-    """Restore ``root`` to platform defaults (used by tests)."""
+    """Restore ``root`` to platform + registered sample defaults
+    (used by tests)."""
     fresh = _default_root()
     root.__dict__.clear()
     root.__dict__.update(fresh.__dict__)
+    for name, defaults in _registered_defaults.items():
+        getattr(root, name).update(copy.deepcopy(defaults))
