@@ -36,6 +36,7 @@ from znicz_tpu.ops import activation, all2all, conv, cutter, dropout, pooling
 from znicz_tpu.ops import normalization
 from znicz_tpu.ops import gd, gd_conv, gd_pooling  # noqa: F401 (pairs)
 from znicz_tpu.ops.decision import DecisionGD, DecisionMSE
+from znicz_tpu.ops.lr_adjust import LearningRateAdjust
 from znicz_tpu.ops.evaluator import EvaluatorMSE, EvaluatorSoftmax
 from znicz_tpu.ops.nn_units import Forward, gd_for
 from znicz_tpu.units import Repeater
@@ -110,6 +111,7 @@ class StandardWorkflow(AcceleratedWorkflow):
                  loss: str = "softmax",
                  decision_config: dict[str, Any] | None = None,
                  snapshotter_config: dict[str, Any] | None = None,
+                 lr_adjuster_config: dict[str, Any] | None = None,
                  **kwargs) -> None:
         super().__init__(workflow, name=name, **kwargs)
         if loader_factory is None:
@@ -130,6 +132,9 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.snapshotter = None
         if snapshotter_config is not None:
             self.link_snapshotter(**snapshotter_config)
+        self.lr_adjuster = None
+        if lr_adjuster_config is not None:
+            self.link_lr_adjuster(**lr_adjuster_config)
         self._region_unit: RegionUnit | None = None
 
     # ------------------------------------------------------------------
@@ -180,8 +185,10 @@ class StandardWorkflow(AcceleratedWorkflow):
         for i, fwd in enumerate(reversed(self.forwards)):
             spec = self.layers_config[len(self.forwards) - 1 - i]
             cls = gd_for(type(fwd))
+            gd_kwargs = {k: v for k, v in spec.get("<-", {}).items()
+                         if k not in ("lr_policy", "bias_lr_policy")}
             unit = cls(self, need_err_input=(i != len(self.forwards) - 1),
-                       **spec.get("<-", {}))
+                       **gd_kwargs)
             unit.forward_unit = fwd  # geometry/mask/activation source
             unit.link_attrs(fwd, "input", "output", "weights", "bias")
             if next_gd is None:
@@ -221,6 +228,25 @@ class StandardWorkflow(AcceleratedWorkflow):
             gd_unit.link_from(prev)
             prev = gd_unit
         return prev
+
+    def link_lr_adjuster(self, lr_policy=None, bias_lr_policy=None) -> None:
+        """Attach a :class:`LearningRateAdjust` over the weighted GD
+        units (reference: ``link_lr_adjuster``).  Per-layer overrides
+        ride in the layer spec's ``"<-"`` dict as ``lr_policy`` /
+        ``bias_lr_policy``; the arguments here are the defaults."""
+        adj = LearningRateAdjust(self, name="lr_adjuster")
+        adj.loader = self.loader
+        for i, gd_unit in enumerate(self.gds):
+            if gd_unit.weights is None or not hasattr(gd_unit,
+                                                      "learning_rate"):
+                continue
+            spec = self.layers_config[i].get("<-", {})
+            adj.add_gd_unit(
+                gd_unit,
+                lr_policy=spec.get("lr_policy", lr_policy),
+                bias_lr_policy=spec.get("bias_lr_policy", bias_lr_policy))
+        adj.link_from(self.decision)
+        self.lr_adjuster = adj
 
     def link_snapshotter(self, **config) -> None:
         self.snapshotter = Snapshotter(self, name="snapshotter", **config)

@@ -1,0 +1,207 @@
+"""Learning-rate schedules applied to gradient-descent units.
+
+Rebuilds the reference's ``znicz/lr_adjust.py``: a
+:class:`LearningRateAdjust` unit holding per-GD-unit policies, fired
+once per training minibatch, rewriting each unit's learning rate as a
+function of the global training-iteration counter.  The policy set is
+the Caffe-era family the reference targeted (step/exp/inv per
+SURVEY.md §2.2, plus the arbitrary-step list form).
+
+TPU-first delta: the adjusted rate is not a Python float captured at
+trace time — that would force a jit-region recompile every time it
+changed.  Each adjusted GD unit instead carries a tiny device-resident
+``lr_state`` Vector ``[lr, lr_bias]`` that is a region leaf; the
+adjuster rewrites it host-side between steps and the compiled program
+reads it as data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.loader.base import TRAIN
+from znicz_tpu.ops.nn_units import GradientDescentBase
+from znicz_tpu.units import Unit
+
+
+# ----------------------------------------------------------------------
+# policies: callables (base_lr, iteration) -> lr
+# ----------------------------------------------------------------------
+class LRPolicyBase:
+    """A learning-rate schedule ``lr = f(base_lr, iteration)``."""
+
+    def __call__(self, base_lr: float, itr: int) -> float:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        args = ", ".join(f"{k}={v!r}" for k, v in sorted(
+            self.__dict__.items()))
+        return f"{type(self).__name__}({args})"
+
+
+class FixedPolicy(LRPolicyBase):
+    """Constant rate (optionally overriding the unit's base)."""
+
+    def __init__(self, lr: float | None = None) -> None:
+        self.lr = lr
+
+    def __call__(self, base_lr: float, itr: int) -> float:
+        return base_lr if self.lr is None else self.lr
+
+
+class StepExpPolicy(LRPolicyBase):
+    """``lr = base · gamma^⌊itr / step⌋`` (Caffe "step")."""
+
+    def __init__(self, gamma: float, step: int) -> None:
+        self.gamma = gamma
+        self.step = int(step)
+
+    def __call__(self, base_lr: float, itr: int) -> float:
+        return base_lr * self.gamma ** (itr // self.step)
+
+
+class ExpPolicy(LRPolicyBase):
+    """``lr = base · gamma^itr``."""
+
+    def __init__(self, gamma: float) -> None:
+        self.gamma = gamma
+
+    def __call__(self, base_lr: float, itr: int) -> float:
+        return base_lr * self.gamma ** itr
+
+
+class InvPolicy(LRPolicyBase):
+    """``lr = base · (1 + gamma·itr)^(−power)``."""
+
+    def __init__(self, gamma: float, power: float = 1.0) -> None:
+        self.gamma = gamma
+        self.power = power
+
+    def __call__(self, base_lr: float, itr: int) -> float:
+        return base_lr * (1.0 + self.gamma * itr) ** (-self.power)
+
+
+class PolyPolicy(LRPolicyBase):
+    """``lr = base · (1 − itr/max_iter)^power`` (clamped at 0)."""
+
+    def __init__(self, max_iter: int, power: float = 1.0) -> None:
+        self.max_iter = int(max_iter)
+        self.power = power
+
+    def __call__(self, base_lr: float, itr: int) -> float:
+        frac = max(0.0, 1.0 - itr / self.max_iter)
+        return base_lr * frac ** self.power
+
+
+class ArbitraryStepPolicy(LRPolicyBase):
+    """Explicit piecewise-constant schedule: ``[(lr, n_steps), …]``;
+    the last rate holds forever (reference: arbitrary-step policy fed
+    from AlexNet-style hand schedules)."""
+
+    def __init__(self, lrs_with_lengths: list[tuple[float, int]]) -> None:
+        if not lrs_with_lengths:
+            raise ValueError("empty schedule")
+        self.lrs_with_lengths = [(float(lr), int(n))
+                                 for lr, n in lrs_with_lengths]
+
+    def __call__(self, base_lr: float, itr: int) -> float:
+        remaining = itr
+        for lr, length in self.lrs_with_lengths:
+            if remaining < length:
+                return lr
+            remaining -= length
+        return self.lrs_with_lengths[-1][0]
+
+
+POLICIES = {
+    "fixed": FixedPolicy,
+    "step_exp": StepExpPolicy,
+    "exp": ExpPolicy,
+    "inv": InvPolicy,
+    "poly": PolyPolicy,
+    "arbitrary_step": ArbitraryStepPolicy,
+}
+
+
+def make_policy(spec) -> LRPolicyBase | None:
+    """Build a policy from ``None`` / a policy object / a
+    ``(name, kwargs)`` pair / a ``{"name": ..., **kwargs}`` dict."""
+    if spec is None or isinstance(spec, LRPolicyBase):
+        return spec
+    if isinstance(spec, dict):
+        spec = dict(spec)
+        name = spec.pop("name")
+        return POLICIES[name](**spec)
+    if isinstance(spec, (tuple, list)):
+        name, kwargs = spec
+        return POLICIES[name](**kwargs)
+    raise TypeError(f"cannot build LR policy from {spec!r}")
+
+
+# ----------------------------------------------------------------------
+# the adjuster unit
+# ----------------------------------------------------------------------
+class LearningRateAdjust(Unit):
+    """Rewrites GD units' learning rates per training iteration.
+
+    Wire after the decision unit (``StandardWorkflow.link_lr_adjuster``
+    does this); the FIFO scheduler then guarantees it fires before the
+    next minibatch's compute region.  The iteration counter advances
+    once per *training* minibatch, matching the reference's
+    minibatch-count semantics.
+    """
+
+    SNAPSHOT_ATTRS = ("_n_iterations",)
+
+    def __init__(self, workflow, name: str | None = None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self._gd_units: list[tuple[GradientDescentBase, LRPolicyBase | None,
+                                   LRPolicyBase | None]] = []
+        self._n_iterations = 0
+        self.loader = None  # linked by the workflow builder
+
+    def add_gd_unit(self, gd_unit: GradientDescentBase,
+                    lr_policy=None, bias_lr_policy=None) -> None:
+        self._gd_units.append((gd_unit, make_policy(lr_policy),
+                               make_policy(bias_lr_policy)))
+
+    def initialize(self, **kwargs) -> None:
+        if self.loader is None:
+            raise ValueError(f"{self}: loader not set")
+        for gd_unit, lr_policy, bias_policy in self._gd_units:
+            if lr_policy is None and bias_policy is None:
+                continue
+            if gd_unit.device is None:
+                raise AttributeError(f"{gd_unit} has no device yet")
+            gd_unit.lr_state.reset(np.asarray(
+                [gd_unit.learning_rate, gd_unit.learning_rate_bias],
+                dtype=np.float32))
+            gd_unit.init_vectors(gd_unit.lr_state)
+        super().initialize(**kwargs)
+        self._apply()  # iteration 0 rates in place before the first step
+
+    def run(self) -> None:
+        if self.loader.minibatch_class != TRAIN:
+            return  # only training minibatches advance the schedule
+        self._n_iterations += 1
+        self._apply()
+
+    def load_state(self, state: dict) -> None:
+        super().load_state(state)
+        self._apply()
+
+    def _apply(self) -> None:
+        itr = self._n_iterations
+        for gd_unit, lr_policy, bias_policy in self._gd_units:
+            if lr_policy is None and bias_policy is None:
+                continue
+            vec = gd_unit.lr_state
+            vec.map_write()
+            if lr_policy is not None:
+                vec.mem[0] = lr_policy(gd_unit.learning_rate, itr)
+            if bias_policy is not None:
+                vec.mem[1] = bias_policy(gd_unit.learning_rate_bias, itr)
+            elif lr_policy is not None:
+                # reference behavior: bias follows the weight policy
+                # unless given its own
+                vec.mem[1] = lr_policy(gd_unit.learning_rate_bias, itr)

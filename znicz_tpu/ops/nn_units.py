@@ -174,6 +174,10 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             name=f"{self.name}.acc_grad_w")
         self.accumulated_gradient_bias = Vector(
             name=f"{self.name}.acc_grad_b")
+        # device-resident [lr, lr_bias]; only populated when a
+        # LearningRateAdjust unit schedules this GD unit — a region
+        # leaf, so schedule changes never recompile the step program
+        self.lr_state = Vector(name=f"{self.name}.lr_state")
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
@@ -195,6 +199,19 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             self.init_vectors(self.accumulated_gradient_weights,
                               self.accumulated_gradient_bias)
 
+    # -- learning-rate source (scheduled vector or static float) --------
+    def _lr(self, xla: bool):
+        if self.lr_state:
+            return (self.lr_state.devmem[0] if xla
+                    else float(self.lr_state.mem[0]))
+        return self.learning_rate
+
+    def _lr_bias(self, xla: bool):
+        if self.lr_state:
+            return (self.lr_state.devmem[1] if xla
+                    else float(self.lr_state.mem[1]))
+        return self.learning_rate_bias
+
     # -- shared update math (xp = np or jnp) ----------------------------
     def _regularized(self, xp, grad, weights, decay: float):
         if not decay:
@@ -208,38 +225,41 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
     def _apply_weights_np(self, grad_w: np.ndarray) -> None:
         w = self.weights.mem
         g = self._regularized(np, grad_w, w, self.weights_decay)
+        lr = self._lr(xla=False)
         if self.gradient_moment:
             acc = self.accumulated_gradient_weights.mem
             acc *= self.gradient_moment
-            acc -= self.learning_rate * g
+            acc -= lr * g
             w += acc
         else:
-            w -= self.learning_rate * g
+            w -= lr * g
 
     def _apply_bias_np(self, grad_b: np.ndarray) -> None:
         if self.bias is None or not self.bias:
             return
         b = self.bias.mem
         g = self._regularized(np, grad_b, b, self.weights_decay_bias)
+        lr = self._lr_bias(xla=False)
         if self.gradient_moment_bias:
             acc = self.accumulated_gradient_bias.mem
             acc *= self.gradient_moment_bias
-            acc -= self.learning_rate_bias * g
+            acc -= lr * g
             b += acc
         else:
-            b -= self.learning_rate_bias * g
+            b -= lr * g
 
     def _apply_weights_xla(self, grad_w) -> None:
         grad_w = maybe_pmean(grad_w)
         w = self.weights.devmem
         g = self._regularized(jnp, grad_w, w, self.weights_decay)
+        lr = self._lr(xla=True)
         if self.gradient_moment:
             acc = self.accumulated_gradient_weights.devmem
-            acc = self.gradient_moment * acc - self.learning_rate * g
+            acc = self.gradient_moment * acc - lr * g
             self.accumulated_gradient_weights.devmem = acc
             self.weights.devmem = w + acc
         else:
-            self.weights.devmem = w - self.learning_rate * g
+            self.weights.devmem = w - lr * g
 
     def _apply_bias_xla(self, grad_b) -> None:
         if self.bias is None or not self.bias:
@@ -247,13 +267,14 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         grad_b = maybe_pmean(grad_b)
         b = self.bias.devmem
         g = self._regularized(jnp, grad_b, b, self.weights_decay_bias)
+        lr = self._lr_bias(xla=True)
         if self.gradient_moment_bias:
             acc = self.accumulated_gradient_bias.devmem
-            acc = self.gradient_moment_bias * acc - self.learning_rate_bias * g
+            acc = self.gradient_moment_bias * acc - lr * g
             self.accumulated_gradient_bias.devmem = acc
             self.bias.devmem = b + acc
         else:
-            self.bias.devmem = b - self.learning_rate_bias * g
+            self.bias.devmem = b - lr * g
 
 
 # ----------------------------------------------------------------------
