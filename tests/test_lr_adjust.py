@@ -148,3 +148,39 @@ def test_snapshot_resume_restores_schedule():
     wf2.gds[0].lr_state.map_read()
     np.testing.assert_allclose(wf2.gds[0].lr_state.mem[0],
                                0.1 * 0.9 ** itr, rtol=1e-6)
+
+
+def test_per_layer_policy_implies_adjuster_and_skips_weightless():
+    """A layer-level lr_policy with no explicit adjuster config must
+    still produce a live schedule; weightless backwards (dropout etc.)
+    must not be scheduled at all."""
+    data, labels = make_blobs(40, N_CLASSES, DIM)
+    wf = StandardWorkflow(
+        name="mlp_implied",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:90], train_labels=labels[:90],
+            valid_data=data[90:], valid_labels=labels[90:],
+            minibatch_size=30),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": {"learning_rate": 0.1,
+                    "lr_policy": ("exp", {"gamma": 0.9})}},
+            {"type": "dropout", "->": {"dropout_ratio": 0.2}},
+            {"type": "softmax", "->": {"output_sample_shape": N_CLASSES},
+             "<-": {"learning_rate": 0.1}},
+        ],
+        decision_config={"max_epochs": 1})
+    wf._max_fires = 100_000
+    assert wf.lr_adjuster is not None
+    scheduled = [gd for gd, _, _ in wf.lr_adjuster._gd_units]
+    from znicz_tpu.ops.nn_units import WeightlessGradientUnit
+    assert not any(isinstance(g, WeightlessGradientUnit) for g in scheduled)
+    wf.initialize(device=XLADevice())
+    wf.run()
+    gd0 = wf.gds[0]
+    gd0.lr_state.map_read()
+    itr = wf.lr_adjuster._n_iterations
+    np.testing.assert_allclose(gd0.lr_state.mem[0], 0.1 * 0.9 ** itr,
+                               rtol=1e-6)
+    # the dropout backward carries no lr_state leaf
+    assert not wf.gds[1].lr_state

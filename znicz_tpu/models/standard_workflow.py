@@ -133,6 +133,11 @@ class StandardWorkflow(AcceleratedWorkflow):
         if snapshotter_config is not None:
             self.link_snapshotter(**snapshotter_config)
         self.lr_adjuster = None
+        if lr_adjuster_config is None and any(
+                "lr_policy" in spec.get("<-", {})
+                or "bias_lr_policy" in spec.get("<-", {})
+                for spec in self.layers_config):
+            lr_adjuster_config = {}  # per-layer policies imply an adjuster
         if lr_adjuster_config is not None:
             self.link_lr_adjuster(**lr_adjuster_config)
         self._region_unit: RegionUnit | None = None
@@ -234,12 +239,12 @@ class StandardWorkflow(AcceleratedWorkflow):
         units (reference: ``link_lr_adjuster``).  Per-layer overrides
         ride in the layer spec's ``"<-"`` dict as ``lr_policy`` /
         ``bias_lr_policy``; the arguments here are the defaults."""
+        from znicz_tpu.ops.nn_units import WeightlessGradientUnit
         adj = LearningRateAdjust(self, name="lr_adjuster")
         adj.loader = self.loader
         for i, gd_unit in enumerate(self.gds):
-            if gd_unit.weights is None or not hasattr(gd_unit,
-                                                      "learning_rate"):
-                continue
+            if isinstance(gd_unit, WeightlessGradientUnit):
+                continue  # no learning-rate state to schedule
             spec = self.layers_config[i].get("<-", {})
             adj.add_gd_unit(
                 gd_unit,

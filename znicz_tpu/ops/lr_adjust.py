@@ -196,12 +196,17 @@ class LearningRateAdjust(Unit):
             if lr_policy is None and bias_policy is None:
                 continue
             vec = gd_unit.lr_state
-            vec.map_write()
             if lr_policy is not None:
+                # both slots rewritten — skip the device→host fetch
+                vec.map_invalidate()
                 vec.mem[0] = lr_policy(gd_unit.learning_rate, itr)
-            if bias_policy is not None:
-                vec.mem[1] = bias_policy(gd_unit.learning_rate_bias, itr)
-            elif lr_policy is not None:
                 # reference behavior: bias follows the weight policy
                 # unless given its own
-                vec.mem[1] = lr_policy(gd_unit.learning_rate_bias, itr)
+                follow = bias_policy if bias_policy is not None else lr_policy
+                vec.mem[1] = follow(gd_unit.learning_rate_bias, itr)
+            else:
+                vec.map_write()
+                vec.mem[1] = bias_policy(gd_unit.learning_rate_bias, itr)
+            # restore the device-authoritative invariant so eager
+            # (non-region) xla_run can read devmem immediately
+            vec.unmap()
