@@ -26,11 +26,18 @@ from znicz_tpu.utils.config import reset_root  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
-def fresh_state():
-    """Deterministic seed + pristine config tree per test."""
+def fresh_state(tmp_path):
+    """Deterministic seed + pristine config tree per test; all output
+    dirs (plots/images/snapshots) redirected into the test's tmp."""
     reset_root()
+    from znicz_tpu.utils.config import root
+    root.common.dirs.plots = str(tmp_path / "plots")
+    root.common.dirs.images = str(tmp_path / "images")
+    root.common.dirs.snapshots = str(tmp_path / "snapshots")
     prng.seed_all(1234)
     yield
+    from znicz_tpu import graphics
+    graphics.reset_server()
 
 
 def make_blobs(n_per_class: int, n_classes: int, dim: int,

@@ -76,10 +76,7 @@ def test_schedule_applied_in_training(device_cls):
     wf.initialize(device=device_cls())
     wf.run()
     itr = wf.lr_adjuster._n_iterations
-    # 90 train samples / minibatch 30 × 2 epochs = 6 train minibatches;
-    # the tick after the last one is cut short by workflow completion
-    # (no further step would consume it)
-    assert itr == 2 * 3 - 1
+    assert itr == 2 * 3  # 90 train samples / minibatch 30 × 2 epochs
     for gd_unit in wf.gds:
         gd_unit.lr_state.map_read()
         np.testing.assert_allclose(

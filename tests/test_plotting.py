@@ -1,0 +1,212 @@
+"""Observability subsystem: graphics service, plotter units,
+accumulators, confusion matrix, image saver (reference patterns:
+``veles/plotting_units.py``, ``znicz/nn_plotting_units.py``,
+``znicz/accumulator.py``, ``znicz/image_saver.py``)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from tests.conftest import make_blobs
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.graphics import GraphicsClient, GraphicsServer
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.ops.accumulator import FixAccumulator, RangeAccumulator
+from znicz_tpu.ops.nn_plotting_units import tile_filters
+from znicz_tpu.units import Unit
+from znicz_tpu.workflow import Workflow
+
+N_CLASSES, DIM = 3, 10
+
+
+# ----------------------------------------------------------------------
+# graphics service
+# ----------------------------------------------------------------------
+def test_server_renders_and_logs_all_kinds(tmp_path):
+    srv = GraphicsServer(out_dir=str(tmp_path), render=True)
+    srv.submit({"kind": "curve", "name": "err",
+                "series": {"train": [[0, 1, 2], [3.0, 2.0, 1.0]]},
+                "step": 2})
+    srv.submit({"kind": "matrix", "name": "conf",
+                "data": np.arange(9).reshape(3, 3)})
+    srv.submit({"kind": "image", "name": "img",
+                "data": np.random.rand(8, 8)})
+    srv.submit({"kind": "hist", "name": "h",
+                "data": np.array([1, 5, 2]),
+                "bin_centers": np.array([0.1, 0.2, 0.3]),
+                "bar_width": 0.05})
+    srv.stop()
+    for name in ("err", "conf", "img", "h"):
+        assert os.path.exists(tmp_path / f"{name}.png"), name
+    events = [json.loads(line)
+              for line in open(tmp_path / "events.jsonl")]
+    assert len(events) == 4
+    assert events[0]["series"]["train"] == [[0, 1, 2], [3.0, 2.0, 1.0]]
+
+
+def test_server_summarizes_large_arrays(tmp_path):
+    srv = GraphicsServer(out_dir=str(tmp_path), render=False)
+    srv.submit({"kind": "image", "name": "big",
+                "data": np.ones((64, 64))})
+    srv.stop()
+    event = json.loads(open(tmp_path / "events.jsonl").read())
+    assert event["data"] == {"shape": [64, 64], "min": 1.0, "max": 1.0,
+                             "mean": 1.0}
+
+
+def test_zmq_pub_sub_roundtrip(tmp_path):
+    """Reference-parity live-viewer channel: PUB server → SUB client
+    renders the payload in another 'process' (same process here)."""
+    srv = GraphicsServer(out_dir=str(tmp_path / "srv"), render=False,
+                         publish_port=0)  # random free port
+    cli = GraphicsClient(srv.endpoint, out_dir=str(tmp_path / "cli"))
+    import time
+    time.sleep(0.2)  # PUB/SUB joining is async
+    got = False
+    for _ in range(20):
+        srv.submit({"kind": "image", "name": "live",
+                    "data": np.random.rand(4, 4)})
+        if cli.poll_once(200):
+            got = True
+            break
+    cli.close()
+    srv.stop()
+    assert got
+    assert os.path.exists(tmp_path / "cli" / "live.png")
+
+
+# ----------------------------------------------------------------------
+# accumulators
+# ----------------------------------------------------------------------
+def test_fix_accumulator():
+    acc = FixAccumulator(None, lo=0.0, hi=1.0, n_bins=10)
+    acc.observe(np.array([0.05, 0.15, 0.15, 5.0, -3.0]))
+    h = acc.histogram.mem
+    assert h[0] == 2  # 0.05 and the clamped -3.0
+    assert h[1] == 2
+    assert h[-1] == 1  # clamped 5.0
+    assert acc.n_observed == 5
+
+
+def test_range_accumulator_rebins():
+    acc = RangeAccumulator(None, n_bins=4)
+    acc.observe(np.array([0.0, 1.0]))
+    assert acc.x_min == 0.0 and acc.x_max == 1.0
+    acc.observe(np.array([3.0]))  # widens → rebin all 3 samples
+    assert acc.x_max == 3.0
+    assert int(acc.histogram.mem.sum()) == 3
+    assert acc.n_observed == 3
+
+
+# ----------------------------------------------------------------------
+# tile_filters
+# ----------------------------------------------------------------------
+def test_tile_filters_square_inference():
+    w = np.random.rand(16, 6).astype(np.float32)  # 4×4 fields, 6 units
+    img = tile_filters(w)
+    side = int(np.ceil(np.sqrt(6)))
+    assert img.shape == (side * 5 + 1, side * 5 + 1)
+    assert img.max() <= 1.0 and img.min() >= 0.0
+
+
+def test_tile_filters_conv_kernels():
+    w = np.random.rand(3, 3, 3, 5).astype(np.float32)
+    img = tile_filters(w)
+    assert img.ndim == 3 and img.shape[-1] == 3  # RGB kernels stay RGB
+    # non-displayable channel counts collapse to grayscale (imshow
+    # accepts only 1/3/4 channels)
+    img2 = tile_filters(np.random.rand(3, 3, 2, 5).astype(np.float32))
+    assert img2.ndim == 2
+
+
+# ----------------------------------------------------------------------
+# end-to-end: plotters + image saver riding a training workflow
+# ----------------------------------------------------------------------
+def build(tmp_path, device_cls, max_epochs=3):
+    data, labels = make_blobs(40, N_CLASSES, DIM)
+    wf = StandardWorkflow(
+        name="mlp_plot",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:90], train_labels=labels[:90],
+            valid_data=data[90:], valid_labels=labels[90:],
+            minibatch_size=30),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": N_CLASSES},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+        ],
+        evaluator_config={"compute_confusion": True},
+        decision_config={"max_epochs": max_epochs})
+    wf._max_fires = 100_000
+    srv = GraphicsServer(out_dir=str(tmp_path / "plots"), render=True)
+    wf.link_error_plotter(server=srv)
+    wf.link_confusion_plotter(server=srv)
+    wf.link_weights_plotter(server=srv)
+    wf.link_image_saver(out_dir=str(tmp_path / "images"), limit=16)
+    wf.initialize(device=device_cls())
+    return wf, srv
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, XLADevice])
+def test_workflow_observability(tmp_path, device_cls):
+    wf, srv = build(tmp_path, device_cls)
+    wf.run()
+    srv.stop()
+    # curves got one point per epoch per non-empty class
+    xs, _ys = wf.error_plotter.values["train"]
+    assert len(xs) == 3
+    # confusion matrix: valid-class counts sum to the valid set size
+    cm = wf.decision.confusion_matrixes[1]
+    assert cm is not None and cm.sum() == 30
+    # trace of the matrix = correct predictions = total - errors
+    # (epoch_n_err is reset after each epoch; last_epoch_n_err holds
+    # the final epoch's counts)
+    assert np.trace(cm) == 30 - wf.decision.last_epoch_n_err[1]
+    for png in ("error_plotter.png", "confusion_matrix.png",
+                "weights2d_l0.png"):
+        assert os.path.exists(tmp_path / "plots" / png), png
+    events = [json.loads(line)
+              for line in open(tmp_path / "plots" / "events.jsonl")]
+    assert len(events) == 9  # 3 epochs × 3 plotters
+    # image saver wrote misclassified PNGs for the last epoch
+    img_root = tmp_path / "images"
+    epochs = sorted(os.listdir(img_root))
+    assert epochs, "no image-saver output"
+    files = os.listdir(img_root / epochs[-1])
+    for f in files:
+        assert f.endswith(".png")
+    # file count bounded by limit and consistent with naming scheme
+    assert 0 < len(files) <= 16
+    name = files[0][:-4]
+    idx, t, p = name.split("_")
+    assert t.startswith("t") and p.startswith("p") and int(idx) >= 0
+
+
+def test_mse_decision_error_plotter(tmp_path):
+    """The error plotter also rides MSE workflows (epoch_mse metric)."""
+    data, _labels = make_blobs(40, N_CLASSES, DIM)
+    wf = StandardWorkflow(
+        name="ae_plot",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:90], valid_data=data[90:],
+            minibatch_size=30),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+             "<-": {"learning_rate": 0.05}},
+            {"type": "all2all", "->": {"output_sample_shape": DIM},
+             "<-": {"learning_rate": 0.05}},
+        ],
+        loss="mse",
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 100_000
+    srv = GraphicsServer(out_dir=str(tmp_path / "plots"), render=False)
+    wf.link_error_plotter(server=srv)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    srv.stop()
+    xs, ys = wf.error_plotter.values["validation"]
+    assert len(xs) == 2 and all(np.isfinite(ys))

@@ -109,6 +109,7 @@ class StandardWorkflow(AcceleratedWorkflow):
                  | None = None,
                  layers: Sequence[dict] = (),
                  loss: str = "softmax",
+                 evaluator_config: dict[str, Any] | None = None,
                  decision_config: dict[str, Any] | None = None,
                  snapshotter_config: dict[str, Any] | None = None,
                  lr_adjuster_config: dict[str, Any] | None = None,
@@ -125,7 +126,7 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.forwards: list[Forward] = []
         self.gds: list = []
         self.link_forwards()
-        self.link_evaluator()
+        self.link_evaluator(**(evaluator_config or {}))
         self.link_decision(**(decision_config or {}))
         self.link_gds()
         self.link_loop()
@@ -160,15 +161,15 @@ class StandardWorkflow(AcceleratedWorkflow):
             self.forwards.append(unit)
             prev = unit
 
-    def link_evaluator(self) -> None:
+    def link_evaluator(self, **config) -> None:
         last = self.forwards[-1]
         if self.loss == "softmax":
-            ev = EvaluatorSoftmax(self, name="evaluator")
+            ev = EvaluatorSoftmax(self, name="evaluator", **config)
             ev.link_attrs(last, "output", "max_idx")
             ev.link_attrs(self.loader, ("labels", "minibatch_labels"),
                           "minibatch_valid", "minibatch_class")
         elif self.loss == "mse":
-            ev = EvaluatorMSE(self, name="evaluator")
+            ev = EvaluatorMSE(self, name="evaluator", **config)
             ev.link_attrs(last, "output")
             ev.link_attrs(self.loader, ("target", "minibatch_data"),
                           "minibatch_valid", "minibatch_class")
@@ -234,6 +235,15 @@ class StandardWorkflow(AcceleratedWorkflow):
             prev = gd_unit
         return prev
 
+    def _relink_end_point_last(self) -> None:
+        """Keep ``end_point`` the LAST successor of the decision so
+        epoch side-chain units (snapshotter, plotters, image saver,
+        lr adjuster) still fire on the final epoch before the workflow
+        stops (the scheduler drains successors in link order)."""
+        if self.decision in self.end_point.links_from:
+            self.end_point.unlink_from(self.decision)
+            self.end_point.link_from(self.decision)
+
     def link_lr_adjuster(self, lr_policy=None, bias_lr_policy=None) -> None:
         """Attach a :class:`LearningRateAdjust` over the weighted GD
         units (reference: ``link_lr_adjuster``).  Per-layer overrides
@@ -251,15 +261,89 @@ class StandardWorkflow(AcceleratedWorkflow):
                 lr_policy=spec.get("lr_policy", lr_policy),
                 bias_lr_policy=spec.get("bias_lr_policy", bias_lr_policy))
         adj.link_from(self.decision)
+        self._relink_end_point_last()
         self.lr_adjuster = adj
 
     def link_snapshotter(self, **config) -> None:
         self.snapshotter = Snapshotter(self, name="snapshotter", **config)
         self.snapshotter.decision = self.decision
         self.snapshotter.link_from(self.decision)
+        self._relink_end_point_last()
         self.snapshotter.gate_skip = ~self.decision.improved
         # snapshotter rides the loop edge; repeater waits for no one
         # extra (Repeater = any-gate), so no deadlock.
+
+    # -- observability side chains (reference: link_image_saver and the
+    # samples' plotter wiring) -----------------------------------------
+    def _epoch_side_unit(self, unit) -> None:
+        unit.link_from(self.decision)
+        self._relink_end_point_last()
+        unit.gate_skip = ~self.decision.epoch_ended
+
+    def link_error_plotter(self, server=None):
+        """Error-percentage curves per sample class, one point per
+        epoch (reference: the AccumulatingPlotter triple every sample
+        wired)."""
+        from znicz_tpu.loader.base import CLASS_NAME
+        from znicz_tpu.plotting_units import AccumulatingPlotter
+        p = AccumulatingPlotter(self, name="error_plotter",
+                                server=server, ylabel="error %")
+        metric = ("epoch_n_err_pt" if self.loss == "softmax"
+                  else "epoch_mse")
+        for cls in range(3):
+            p.add_series(
+                CLASS_NAME[cls],
+                lambda cls=cls: (getattr(self.decision, metric)[cls]
+                                 if self.loader.class_lengths[cls] else None))
+        self._epoch_side_unit(p)
+        self.error_plotter = p
+        return p
+
+    def link_confusion_plotter(self, klass: int = 1, server=None):
+        """Validation (or given class) confusion-matrix heatmap; turns
+        on the evaluator's device-side confusion accumulation."""
+        from znicz_tpu.plotting_units import MatrixPlotter
+        if not getattr(self.evaluator, "compute_confusion", False):
+            raise ValueError(
+                "confusion plotter needs the evaluator built with "
+                "compute_confusion=True (pass evaluator_config)")
+        p = MatrixPlotter(
+            self, name="confusion_matrix", server=server,
+            fetch=lambda: self.decision.confusion_matrixes[klass])
+        self._epoch_side_unit(p)
+        self.confusion_plotter = p
+        return p
+
+    def link_weights_plotter(self, layer: int = 0, sample_shape=None,
+                             server=None):
+        """First-layer filters as a tiled image (reference:
+        ``Weights2D``)."""
+        from znicz_tpu.ops.nn_plotting_units import Weights2D
+        p = Weights2D(self, name=f"weights2d_l{layer}", server=server,
+                      sample_shape=sample_shape)
+        p.link_attrs(self.forwards[layer], ("input", "weights"),
+                     two_way=False)
+        self._epoch_side_unit(p)
+        self.weights_plotter = p
+        return p
+
+    def link_image_saver(self, **config):
+        """Dump misclassified samples per epoch (reference:
+        ``link_image_saver``); classification workflows only."""
+        from znicz_tpu.ops.image_saver import ImageSaver
+        if self.loss != "softmax":
+            raise ValueError("image saver needs a classification loss")
+        s = ImageSaver(self, name="image_saver", **config)
+        s.link_attrs(self.loader, ("input", "minibatch_data"),
+                     ("labels", "minibatch_labels"),
+                     ("indices", "minibatch_indices"),
+                     "minibatch_valid", "minibatch_class", "epoch_number",
+                     two_way=False)
+        s.link_attrs(self.forwards[-1], "max_idx", two_way=False)
+        s.link_from(self.decision)  # after the step's compute
+        self._relink_end_point_last()
+        self.image_saver = s
+        return s
 
     # ------------------------------------------------------------------
     def initialize(self, device=None, **kwargs) -> None:

@@ -1,0 +1,118 @@
+"""Signal accumulators: running histograms/ranges for diagnostics
+(reference: ``znicz/accumulator.py`` — ``FixAccumulator`` over a fixed
+bin range, ``RangeAccumulator`` tracking the observed min/max).
+
+Host-side units: they read their input Vector between steps (wire on a
+side chain or gate per-epoch) and keep numpy histogram state that
+plotters or the metrics stream can consume.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.memory import Vector
+from znicz_tpu.units import Unit
+
+
+class FixAccumulator(Unit):
+    """Histogram over a fixed ``[lo, hi]`` range with ``n_bins`` bins;
+    out-of-range values clamp into the edge bins."""
+
+    SNAPSHOT_ATTRS = ("n_observed",)
+
+    def __init__(self, workflow, name: str | None = None,
+                 lo: float = 0.0, hi: float = 1.0, n_bins: int = 30,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.input: Vector | None = None
+        self.lo = float(lo)
+        self.hi = float(hi)
+        self.n_bins = int(n_bins)
+        self.histogram = Vector(
+            np.zeros(self.n_bins, dtype=np.int64),
+            name=f"{self.name}.histogram")
+        self.n_observed = 0
+
+    @property
+    def bin_centers(self) -> np.ndarray:
+        edges = np.linspace(self.lo, self.hi, self.n_bins + 1)
+        return 0.5 * (edges[:-1] + edges[1:])
+
+    def reset(self) -> None:
+        self.histogram.mem[...] = 0
+        self.n_observed = 0
+
+    def observe(self, values: np.ndarray) -> None:
+        v = np.clip(np.asarray(values, dtype=np.float64).ravel(),
+                    self.lo, self.hi)
+        counts, _ = np.histogram(v, bins=self.n_bins,
+                                 range=(self.lo, self.hi))
+        self.histogram.mem += counts
+        self.n_observed += v.size
+
+    def run(self) -> None:
+        if isinstance(self.input, Vector) and self.input:
+            self.input.map_read()
+            self.observe(np.asarray(self.input.mem))
+
+
+class RangeAccumulator(Unit):
+    """Tracks the running min/max of a signal and a histogram over the
+    range seen so far (rebinned as the range grows)."""
+
+    SNAPSHOT_ATTRS = ("x_min", "x_max", "n_observed")
+
+    def __init__(self, workflow, name: str | None = None,
+                 n_bins: int = 30, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.input: Vector | None = None
+        self.n_bins = int(n_bins)
+        self.x_min = np.inf
+        self.x_max = -np.inf
+        self.n_observed = 0
+        self.histogram = Vector(
+            np.zeros(self.n_bins, dtype=np.int64),
+            name=f"{self.name}.histogram")
+        self._samples: list[np.ndarray] = []  # kept until range settles
+
+    @property
+    def bin_centers(self) -> np.ndarray:
+        lo = self.x_min if np.isfinite(self.x_min) else 0.0
+        hi = self.x_max if np.isfinite(self.x_max) else 1.0
+        edges = np.linspace(lo, hi, self.n_bins + 1)
+        return 0.5 * (edges[:-1] + edges[1:])
+
+    def reset(self) -> None:
+        self.x_min, self.x_max = np.inf, -np.inf
+        self.n_observed = 0
+        self.histogram.mem[...] = 0
+        self._samples.clear()
+
+    def observe(self, values: np.ndarray) -> None:
+        v = np.asarray(values, dtype=np.float64).ravel()
+        if v.size == 0:
+            return
+        lo, hi = float(v.min()), float(v.max())
+        grew = lo < self.x_min or hi > self.x_max
+        self.x_min = min(self.x_min, lo)
+        self.x_max = max(self.x_max, hi)
+        self._samples.append(v)
+        self.n_observed += v.size
+        if grew:  # rebin everything over the widened range
+            self.histogram.mem[...] = 0
+            for s in self._samples:
+                self._bin(s)
+        else:
+            self._bin(v)
+
+    def _bin(self, v: np.ndarray) -> None:
+        hi = self.x_max if self.x_max > self.x_min else self.x_min + 1.0
+        counts, _ = np.histogram(v, bins=self.n_bins,
+                                 range=(self.x_min, hi))
+        self.histogram.mem += counts
+
+    def run(self) -> None:
+        if isinstance(self.input, Vector) and self.input:
+            self.input.map_read()
+            self.observe(np.asarray(self.input.mem))

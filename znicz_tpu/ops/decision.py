@@ -83,6 +83,12 @@ class DecisionGD(DecisionBase):
         self.min_validation_n_err = None
         self.min_validation_n_err_pt = 100.0
         self.min_train_n_err = None
+        # last epoch's per-class confusion matrices (filled when the
+        # evaluator has compute_confusion enabled)
+        self.confusion_matrixes = [None, None, None]
+        # last COMPLETED epoch's error counts (epoch_n_err is a running
+        # accumulator reset at each epoch end)
+        self.last_epoch_n_err = [None, None, None]
 
     def accumulate_minibatch(self) -> None:
         # per-class accumulation happens ON DEVICE in the evaluator
@@ -96,6 +102,13 @@ class DecisionGD(DecisionBase):
         self.epoch_n_err = [int(x) for x in acc.mem]
         acc.map_invalidate()
         acc.mem[...] = 0  # uploaded on the next region fire
+        cm: Vector = getattr(self.evaluator, "confusion_matrix", None)
+        if isinstance(cm, Vector) and cm:
+            cm.map_read()
+            self.confusion_matrixes = [np.array(cm.mem[c])
+                                       for c in range(3)]
+            cm.map_invalidate()
+            cm.mem[...] = 0
         for cls in range(3):
             length = loader.class_lengths[cls]
             if length:
@@ -117,6 +130,7 @@ class DecisionGD(DecisionBase):
             "  ".join(f"{CLASS_NAME[c]} err {self.epoch_n_err[c]} "
                       f"({self.epoch_n_err_pt[c]:.2f}%)"
                       for c in range(3) if loader.class_lengths[c]))
+        self.last_epoch_n_err = list(self.epoch_n_err)
         self.epoch_n_err = [0, 0, 0]
 
 

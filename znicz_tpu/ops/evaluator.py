@@ -46,7 +46,8 @@ class EvaluatorSoftmax(EvaluatorBase):
     """Softmax cross-entropy evaluator (reference:
     ``EvaluatorSoftmax``)."""
 
-    def __init__(self, workflow, name: str | None = None, **kwargs) -> None:
+    def __init__(self, workflow, name: str | None = None,
+                 compute_confusion: bool = False, **kwargs) -> None:
         super().__init__(workflow, name=name, **kwargs)
         self.labels: Vector | None = None      # link from loader
         self.max_idx: Vector | None = None     # link from All2AllSoftmax
@@ -57,6 +58,10 @@ class EvaluatorSoftmax(EvaluatorBase):
         # once per step (a TPU-first change: the per-step device→host
         # scalar fetch dominated step time through the PJRT tunnel)
         self.epoch_n_err = Vector(name=f"{self.name}.epoch_n_err")
+        # optional (3, C, C) confusion counts, same epoch-accumulation
+        # scheme (reference: EvaluatorSoftmax confusion matrix)
+        self.compute_confusion = compute_confusion
+        self.confusion_matrix = Vector(name=f"{self.name}.confusion")
 
     def region_key(self) -> tuple:
         # minibatch_class indexes the on-device accumulator statically
@@ -70,7 +75,11 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.n_err.reset(np.zeros((), dtype=np.int32))
         if not self.epoch_n_err:
             self.epoch_n_err.reset(np.zeros(3, dtype=np.int32))
+        if self.compute_confusion and not self.confusion_matrix:
+            c = self.n_classes
+            self.confusion_matrix.reset(np.zeros((3, c, c), dtype=np.int32))
         self.init_vectors(self.err_output, self.n_err, self.epoch_n_err,
+                          self.confusion_matrix,
                           self.output, self.labels, self.max_idx,
                           self.minibatch_valid)
 
@@ -95,6 +104,11 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.n_err.mem[...] = n_err
         self.epoch_n_err.map_write()
         self.epoch_n_err.mem[int(self.minibatch_class)] += n_err
+        if self.compute_confusion:
+            self.confusion_matrix.map_write()
+            cm = self.confusion_matrix.mem[int(self.minibatch_class)]
+            pred = self.max_idx.mem
+            np.add.at(cm, (t[mask], pred[mask]), 1)
 
     def xla_run(self) -> None:
         p = self.output.devmem
@@ -107,6 +121,12 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.n_err.devmem = n_err
         self.epoch_n_err.devmem = self.epoch_n_err.devmem.at[
             int(self.minibatch_class)].add(n_err)
+        if self.compute_confusion:
+            # masked rows contribute 0; duplicate (t, pred) pairs
+            # accumulate via scatter-add
+            cls = int(self.minibatch_class)
+            self.confusion_matrix.devmem = self.confusion_matrix.devmem.at[
+                cls, t, self.max_idx.devmem].add(mask.astype(jnp.int32))
 
 
 class EvaluatorMSE(EvaluatorBase):

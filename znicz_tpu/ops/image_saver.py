@@ -1,0 +1,121 @@
+"""ImageSaver: dumps misclassified sample images to disk per epoch
+(reference: ``znicz/image_saver.py`` — wrongly-classified validation
+samples written as image files named by true/predicted label so a
+human can inspect what the net gets wrong).
+
+Host-side unit: wire after the evaluator (per minibatch); it reads the
+minibatch data + labels + the evaluator's argmax, converts offending
+samples to PNG via PIL and writes them under
+``root.common.dirs.images/<workflow>/epoch_<N>/``.  A per-epoch limit
+bounds disk traffic.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+import numpy as np
+
+from znicz_tpu.loader.base import TRAIN
+from znicz_tpu.memory import Vector
+from znicz_tpu.units import Unit
+from znicz_tpu.utils.config import root
+
+
+def to_image_array(sample: np.ndarray) -> np.ndarray:
+    """Normalize one sample to an uint8 H×W or H×W×3 image array."""
+    img = np.asarray(sample, dtype=np.float32)
+    if img.ndim == 1:  # flat vector → square if possible
+        side = int(np.sqrt(img.size))
+        if side * side == img.size:
+            img = img.reshape(side, side)
+        else:
+            img = img.reshape(1, -1)
+    if img.ndim == 3 and img.shape[-1] == 1:
+        img = img[..., 0]
+    if img.ndim == 3 and img.shape[-1] not in (3,):
+        img = img[..., :1][..., 0]  # first channel as grayscale
+    lo, hi = float(img.min()), float(img.max())
+    if hi > lo:
+        img = (img - lo) / (hi - lo)
+    return (img * 255.0 + 0.5).astype(np.uint8)
+
+
+class ImageSaver(Unit):
+    """Saves misclassified (or all-eval, see ``save_all``) samples.
+
+    File name: ``<n>_t<true>_p<pred>.png`` inside
+    ``out_dir/epoch_<epoch>/``; at most ``limit`` files per epoch.
+    """
+
+    def __init__(self, workflow, name: str | None = None,
+                 out_dir: str | None = None, limit: int = 64,
+                 save_all: bool = False, classes=(1, 0),
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        wf_name = workflow.name if workflow is not None else "wf"
+        self.out_dir = out_dir or os.path.join(
+            str(root.common.dirs.images), wf_name)
+        self.limit = int(limit)
+        self.save_all = save_all
+        self.classes = tuple(classes)  # minibatch classes to inspect
+        # linked attrs (StandardWorkflow.link_image_saver wires these):
+        self.input: Vector | None = None        # loader.minibatch_data
+        self.labels: Vector | None = None       # loader.minibatch_labels
+        self.max_idx: Vector | None = None      # softmax argmax
+        self.indices: Vector | None = None      # loader.minibatch_indices
+        self.minibatch_class = TRAIN
+        self.minibatch_valid: Vector | None = None
+        self.epoch_number = 0                   # linked from loader
+        self._saved_this_epoch = 0
+        self._last_epoch = -1
+
+    def _epoch_dir(self) -> str:
+        d = os.path.join(self.out_dir, f"epoch_{int(self.epoch_number)}")
+        if self._last_epoch != int(self.epoch_number):
+            self._last_epoch = int(self.epoch_number)
+            self._saved_this_epoch = 0
+            shutil.rmtree(d, ignore_errors=True)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def run(self) -> None:
+        if int(self.minibatch_class) not in self.classes:
+            return
+        if self._saved_this_epoch >= self.limit \
+                and self._last_epoch == int(self.epoch_number):
+            return
+        from PIL import Image
+
+        for vec in (self.input, self.labels, self.max_idx,
+                    self.minibatch_valid):
+            if isinstance(vec, Vector) and vec:
+                vec.map_read()
+        data = np.asarray(self.input.mem)
+        truth = np.asarray(self.labels.mem)
+        pred = np.asarray(self.max_idx.mem)
+        n_valid = (int(self.minibatch_valid.mem)
+                   if isinstance(self.minibatch_valid, Vector)
+                   and self.minibatch_valid else data.shape[0])
+        if isinstance(self.indices, Vector) and self.indices:
+            self.indices.map_read()
+            sample_ids = np.asarray(self.indices.mem)
+        else:
+            sample_ids = np.arange(data.shape[0])
+        wrong = np.nonzero((truth[:n_valid] != pred[:n_valid])
+                           if not self.save_all
+                           else np.ones(n_valid, dtype=bool))[0]
+        if wrong.size == 0:
+            return
+        out = self._epoch_dir()
+        for i in wrong:
+            if self._saved_this_epoch >= self.limit:
+                break
+            img = to_image_array(data[i])
+            mode = "RGB" if img.ndim == 3 else "L"
+            path = os.path.join(
+                out, f"{int(sample_ids[i])}_t{int(truth[i])}"
+                     f"_p{int(pred[i])}.png")
+            Image.fromarray(img, mode=mode).save(path)
+            self._saved_this_epoch += 1

@@ -1,0 +1,158 @@
+"""Plotter units: workflow nodes that feed the graphics service.
+
+Rebuilds the reference's plotter-unit family (reference:
+``veles/plotting_units.py`` — ``AccumulatingPlotter``,
+``MatrixPlotter``, ``ImagePlotter`` riding a ``Plotter`` base that
+shipped payloads to the graphics server).  The unit API shape is kept
+so sample workflows port cleanly; the transport behind it is
+:mod:`znicz_tpu.graphics` (render thread + jsonl metrics + optional
+zmq PUB) instead of a mandatory separate process.
+
+All plotters are host-side units: wire them on the epoch side chain
+(``plotter.link_from(decision)`` with ``gate_skip`` following
+``~decision.epoch_ended``) so they never touch the per-minibatch hot
+path.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from znicz_tpu import graphics
+from znicz_tpu.memory import Vector
+from znicz_tpu.units import Unit
+
+
+class Plotter(Unit):
+    """Base plotter: resolves the graphics server, counts steps."""
+
+    def __init__(self, workflow, name: str | None = None,
+                 server: "graphics.GraphicsServer | None" = None,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self._server = server
+        self.step = 0
+
+    @property
+    def server(self) -> "graphics.GraphicsServer":
+        if self._server is None:
+            self._server = graphics.get_server()
+        return self._server
+
+    def make_payload(self) -> dict | None:
+        raise NotImplementedError
+
+    def run(self) -> None:
+        payload = self.make_payload()
+        if payload is None:
+            return
+        payload.setdefault("name", self.name)
+        payload.setdefault("step", self.step)
+        self.server.submit(payload)
+        self.step += 1
+
+
+class AccumulatingPlotter(Plotter):
+    """Accumulates scalar series over time and plots them as curves
+    (reference: error-percentage curves per class).
+
+    Add series with :meth:`add_series`: each is a label plus a
+    callable returning the current scalar (or ``None`` to skip the
+    point this firing).
+    """
+
+    SNAPSHOT_ATTRS = ("values", "step")
+
+    def __init__(self, workflow, name: str | None = None,
+                 ylabel: str = "", **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.ylabel = ylabel
+        self._series: list[tuple[str, Callable[[], float | None]]] = []
+        self.values: dict[str, list[list[float]]] = {}
+
+    def add_series(self, label: str,
+                   fetch: Callable[[], float | None]) -> None:
+        self._series.append((label, fetch))
+        self.values.setdefault(label, [[], []])
+
+    def make_payload(self) -> dict | None:
+        for label, fetch in self._series:
+            value = fetch()
+            if value is None:
+                continue
+            xs, ys = self.values.setdefault(label, [[], []])
+            xs.append(float(self.step))
+            ys.append(float(value))
+        if not any(xs for xs, _ in self.values.values()):
+            return None
+        return {"kind": "curve", "ylabel": self.ylabel,
+                "series": {k: [list(xs), list(ys)]
+                           for k, (xs, ys) in self.values.items() if xs}}
+
+
+class MatrixPlotter(Plotter):
+    """Plots a matrix (e.g. the confusion matrix) as a heatmap with
+    cell values (reference: ``MatrixPlotter``)."""
+
+    def __init__(self, workflow, name: str | None = None,
+                 fetch: Callable[[], np.ndarray | None] | None = None,
+                 labels=None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.fetch = fetch
+        self.labels = labels
+        self.input = None  # alternative: a Vector / ndarray attribute
+
+    def _matrix(self) -> np.ndarray | None:
+        if self.fetch is not None:
+            return self.fetch()
+        src = self.input
+        if isinstance(src, Vector):
+            if not src:
+                return None
+            src.map_read()
+            return np.array(src.mem)
+        return None if src is None else np.asarray(src)
+
+    def make_payload(self) -> dict | None:
+        m = self._matrix()
+        if m is None:
+            return None
+        return {"kind": "matrix", "data": np.asarray(m),
+                "labels": self.labels}
+
+
+class ImagePlotter(Plotter):
+    """Plots one 2-D array (or the first sample of a batch) as an
+    image (reference: ``ImagePlotter``)."""
+
+    def __init__(self, workflow, name: str | None = None,
+                 fetch: Callable[[], np.ndarray | None] | None = None,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.fetch = fetch
+        self.input = None
+
+    def make_payload(self) -> dict | None:
+        if self.fetch is not None:
+            img = self.fetch()
+        else:
+            src = self.input
+            if isinstance(src, Vector):
+                if not src:
+                    return None
+                src.map_read()
+                img = np.array(src.mem)
+            elif src is None:
+                return None
+            else:
+                img = np.asarray(src)
+        if img is None:
+            return None
+        img = np.asarray(img)
+        while img.ndim > 2 and img.shape[-1] not in (1, 3):
+            img = img[0]
+        if img.ndim == 3 and img.shape[-1] == 1:
+            img = img[..., 0]
+        return {"kind": "image", "data": img}

@@ -96,7 +96,11 @@ def _default_root() -> Config:
     r.common.dirs.cache = os.path.expanduser("~/.cache/znicz_tpu")
     r.common.dirs.snapshots = os.path.expanduser("~/.cache/znicz_tpu/snapshots")
     r.common.dirs.datasets = os.path.expanduser("~/.cache/znicz_tpu/datasets")
+    r.common.dirs.plots = os.path.expanduser("~/.cache/znicz_tpu/plots")
+    r.common.dirs.images = os.path.expanduser("~/.cache/znicz_tpu/images")
     r.common.seed = 1234
+    r.common.graphics.render = True       # draw PNGs in the render thread
+    r.common.graphics.publish_port = None  # zmq PUB port for live clients
     return r
 
 
