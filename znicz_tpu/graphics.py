@@ -64,7 +64,8 @@ class GraphicsServer(Logger):
 
     def __init__(self, out_dir: str | None = None,
                  render: bool | None = None,
-                 publish_port: int | None = None) -> None:
+                 publish_port: "int | bool | None" = None) -> None:
+        # publish_port: None → config default; False → never publish
         super().__init__()
         self.out_dir = out_dir or str(root.common.dirs.plots)
         os.makedirs(self.out_dir, exist_ok=True)
@@ -213,7 +214,11 @@ class GraphicsClient(Logger):
         self._sub = self._ctx.socket(zmq.SUB)
         self._sub.connect(endpoint)
         self._sub.setsockopt(zmq.SUBSCRIBE, b"")
-        self._renderer = GraphicsServer(out_dir=out_dir, render=False)
+        # publish_port=False: the internal renderer must never open its
+        # own PUB socket (it would race the real server for the
+        # configured port)
+        self._renderer = GraphicsServer(out_dir=out_dir, render=False,
+                                        publish_port=False)
 
     def poll_once(self, timeout_ms: int = 1000) -> bool:
         """Receive and draw one payload; False on timeout."""

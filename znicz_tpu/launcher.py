@@ -1,0 +1,256 @@
+"""Launcher: workflow lifecycle owner + execution-mode select.
+
+Rebuilds the reference's ``veles/launcher.py``.  The reference Launcher
+picked standalone / ``--master`` / ``--slave`` mode, owned the Twisted
+reactor, spawned the graphics server and drove ``workflow.run``.
+
+TPU-first deltas (SURVEY.md §2.5, §5.8): the master–slave cluster
+(Twisted TCP control + ZeroMQ data plane, ``veles/server.py`` /
+``veles/client.py``) is replaced by **synchronous SPMD** — every host
+runs the same program over a global device mesh and XLA inserts the
+gradient all-reduce over ICI/DCN.  So "mode" here means:
+
+- *standalone*: single process, all locally visible devices;
+- *distributed*: ``jax.distributed.initialize`` (PJRT multi-host
+  bootstrap over DCN) — the ``--listen`` host is process 0
+  ("master" in reference terms: it owns snapshots and logging), every
+  other host joins with ``--master host:port`` exactly like reference
+  slaves did.  There is no job queue: the loader shards minibatches
+  over the mesh's ``data`` axis instead
+  (``generate_data_for_slave`` → sharding spec).
+
+Failure handling parity (SURVEY.md §5.3): SPMD is gang-scheduled, so
+the reference's elastic drop-slave/requeue becomes **checkpoint +
+auto-resume**: SIGINT/SIGTERM write an emergency snapshot, and
+``retries > 0`` re-enters the run loop resuming from the newest
+snapshot.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import signal
+import traceback
+from typing import Any, Callable
+
+from znicz_tpu.backends import Device
+from znicz_tpu.utils.config import root
+from znicz_tpu.utils.logger import Logger
+from znicz_tpu.utils.snapshotter import Snapshotter
+from znicz_tpu.workflow import Workflow
+
+
+class Launcher(Logger):
+    """Owns device selection, distributed bootstrap and the run loop.
+
+    The reference sample protocol is preserved: every sample module
+    exposes ``run(load, main)``; :meth:`boot` calls it with closures
+    bound to this launcher — ``load(factory, **kwargs)`` constructs
+    (or resumes) the workflow, ``main(**kwargs)`` initializes and runs
+    it.
+    """
+
+    def __init__(self, backend: str | None = None,
+                 snapshot: str | None = None,
+                 listen: str | None = None,
+                 master: str | None = None,
+                 n_processes: int | None = None,
+                 process_id: int | None = None,
+                 retries: int = 0,
+                 graphics: bool | None = None,
+                 **kwargs) -> None:
+        super().__init__(**kwargs)
+        self.backend = backend
+        self.snapshot = snapshot
+        self.retries = int(retries)
+        self.workflow: Workflow | None = None
+        self.device: Device | None = None
+        self._snapshot_state: dict | None = None
+        self._graphics = graphics
+        self._interrupted = False
+        self._old_handlers: dict[int, Any] = {}
+        # distributed mode ------------------------------------------------
+        self.coordinator = listen or master
+        self.process_id = process_id
+        self.n_processes = n_processes
+        self.is_master = master is None  # standalone or the --listen host
+        if listen and master:
+            raise ValueError("--listen and --master are exclusive")
+        if self.coordinator:
+            self._init_distributed(listen is not None)
+
+    # ------------------------------------------------------------------
+    # modes
+    # ------------------------------------------------------------------
+    @property
+    def mode(self) -> str:
+        if not self.coordinator:
+            return "standalone"
+        return "master" if self.is_master else "slave"
+
+    def _init_distributed(self, is_coordinator: bool) -> None:
+        """PJRT multi-host bootstrap (replaces the reference's
+        Server/Client handshake; reference: ``veles/server.py``)."""
+        import jax
+        kwargs: dict = {"coordinator_address": self.coordinator}
+        if self.n_processes is not None:
+            kwargs["num_processes"] = self.n_processes
+        if self.process_id is not None:
+            kwargs["process_id"] = self.process_id
+        elif is_coordinator:
+            kwargs["process_id"] = 0
+        self.info("distributed init (%s) @ %s",
+                  "coordinator" if is_coordinator else "worker",
+                  self.coordinator)
+        jax.distributed.initialize(**kwargs)
+        self.is_master = jax.process_index() == 0
+
+    # ------------------------------------------------------------------
+    # device
+    # ------------------------------------------------------------------
+    def make_device(self) -> Device:
+        if self.device is None:
+            self.device = Device.create(self.backend)
+        return self.device
+
+    # ------------------------------------------------------------------
+    # reference sample protocol: run(load, main)
+    # ------------------------------------------------------------------
+    def boot(self, run_fn: Callable) -> Workflow:
+        """Drive a sample module's ``run(load, main)``."""
+        run_fn(self._load, self._main)
+        if self.workflow is None:
+            raise RuntimeError(
+                "run(load, main) never called load(factory, ...)")
+        return self.workflow
+
+    def _load(self, factory: Callable[..., Workflow], **kwargs):
+        """Construct the workflow; stage snapshot state when resuming.
+
+        Returns ``(workflow, snapshot_was_loaded)`` like the reference
+        ``Main._load``.
+        """
+        self.workflow = factory(**kwargs)
+        loaded = False
+        if self.snapshot:
+            self._snapshot_state = Snapshotter.load(self.snapshot)
+            loaded = True
+            self.info("staged snapshot %s", self.snapshot)
+        return self.workflow, loaded
+
+    def _main(self, **kwargs) -> None:
+        wf = self.workflow
+        if wf is None:
+            raise RuntimeError("main() called before load()")
+        attempt = 0
+        while True:
+            try:
+                self.run_workflow(wf, **kwargs)
+                return
+            except KeyboardInterrupt:
+                raise
+            except Exception:
+                attempt += 1
+                if attempt > self.retries:
+                    raise
+                latest = self.latest_snapshot(wf)
+                self.warning("workflow crashed (attempt %d/%d):\n%s",
+                             attempt, self.retries,
+                             traceback.format_exc())
+                if latest:
+                    self.info("auto-resume from %s", latest)
+                    self._snapshot_state = Snapshotter.load(latest)
+
+    # ------------------------------------------------------------------
+    def run_workflow(self, workflow: Workflow, **kwargs) -> Workflow:
+        """initialize → (resume state) → run, with signal-safe
+        emergency snapshots."""
+        if self._graphics is not None:
+            # reference Launcher owned the graphics server spawn; here
+            # the render thread starts lazily on first plotter use —
+            # the flag force-disables (or pre-warms) it
+            root.common.graphics.render = bool(self._graphics)
+            if self._graphics:
+                from znicz_tpu import graphics
+                graphics.get_server()
+        device = self.make_device()
+        if not workflow.is_initialized:
+            workflow.initialize(device=device, **kwargs)
+        if self._snapshot_state is not None:
+            workflow.load_state(self._snapshot_state)
+            self._snapshot_state = None
+        self._install_signal_handlers(workflow)
+        try:
+            workflow.run()
+        except KeyboardInterrupt:
+            self._emergency_snapshot(workflow)
+            raise
+        finally:
+            self._restore_signal_handlers()
+        return workflow
+
+    # ------------------------------------------------------------------
+    # failure handling (SURVEY.md §5.3 parity)
+    # ------------------------------------------------------------------
+    def _install_signal_handlers(self, workflow: Workflow) -> None:
+        def handler(signum, frame):
+            if self._interrupted:  # second signal: hard exit
+                raise KeyboardInterrupt
+            self._interrupted = True
+            self.warning("signal %d: emergency snapshot + stop", signum)
+            self._emergency_snapshot(workflow)
+            workflow.stop()
+
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                self._old_handlers[sig] = signal.signal(sig, handler)
+            except ValueError:  # not the main thread (tests)
+                pass
+
+    def _restore_signal_handlers(self) -> None:
+        for sig, old in self._old_handlers.items():
+            try:
+                signal.signal(sig, old)
+            except ValueError:
+                pass
+        self._old_handlers.clear()
+        self._interrupted = False
+
+    def _emergency_snapshot(self, workflow: Workflow) -> str | None:
+        if not self.is_master:  # reference: master owns snapshots
+            return None
+        try:
+            path = Snapshotter.write(
+                workflow.state_dict(), str(root.common.dirs.snapshots),
+                workflow.name, "interrupted")
+            self.info("emergency snapshot → %s", path)
+            return path
+        except Exception:  # pragma: no cover - best effort on the way out
+            self.exception("emergency snapshot failed")
+            return None
+
+    def latest_snapshot(self, workflow: Workflow) -> str | None:
+        """Newest snapshot belonging to THIS workflow (for auto-resume).
+
+        The snapshots directory is shared between samples, so only
+        files matching the workflow's snapshotter prefix (or the
+        workflow name for emergency snapshots) are candidates."""
+        snap = getattr(workflow, "snapshotter", None)
+        if snap is not None and snap.destination:
+            return snap.destination
+        directory = str(root.common.dirs.snapshots)
+        prefixes = {workflow.name}
+        if snap is not None:
+            prefixes.add(snap.prefix)
+        files: list[str] = []
+        for prefix in prefixes:
+            files += glob.glob(
+                os.path.join(directory, f"{prefix}_*.pickle.gz"))
+        files.sort(key=os.path.getmtime)
+        return files[-1] if files else None
+
+    # ------------------------------------------------------------------
+    def stop(self) -> None:
+        if self.workflow is not None:
+            self.workflow.stop()

@@ -24,7 +24,6 @@ content-independent).  With a real ImageNet pipeline on disk, swap the
 from __future__ import annotations
 
 from znicz_tpu import datasets
-from znicz_tpu.backends import Device
 from znicz_tpu.loader.fullbatch import ArrayLoader
 from znicz_tpu.models.standard_workflow import StandardWorkflow
 from znicz_tpu.utils.config import register_defaults, root
@@ -105,8 +104,9 @@ def build(**overrides) -> StandardWorkflow:
     return wf
 
 
-def run(device: Device | None = None) -> StandardWorkflow:
-    wf = build()
-    wf.initialize(device=device)
-    wf.run()
-    return wf
+def run(load, main):
+    """Reference sample entry protocol (``veles <sample> <config>``):
+    the launcher passes ``load`` (construct/resume) and ``main``
+    (initialize + train)."""
+    load(build)
+    main()

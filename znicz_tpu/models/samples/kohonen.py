@@ -171,8 +171,9 @@ def build(**overrides) -> KohonenWorkflow:
     return wf
 
 
-def run(device: Device | None = None) -> KohonenWorkflow:
-    wf = build()
-    wf.initialize(device=device)
-    wf.run()
-    return wf
+def run(load, main):
+    """Reference sample entry protocol (``veles <sample> <config>``):
+    the launcher passes ``load`` (construct/resume) and ``main``
+    (initialize + train)."""
+    load(build)
+    main()

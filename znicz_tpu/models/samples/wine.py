@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import numpy as np
 
-from znicz_tpu.backends import Device
 from znicz_tpu.loader.fullbatch import ArrayLoader
 from znicz_tpu.models.standard_workflow import StandardWorkflow
 from znicz_tpu.utils.config import register_defaults, root
@@ -36,6 +35,10 @@ def make_data(seed: int = 17):
 def build(**overrides) -> StandardWorkflow:
     cfg = dict(root.wine.as_dict())
     cfg.update(overrides)
+    wf_kwargs = {k: cfg.pop(k) for k in ("snapshotter_config",
+                                         "lr_adjuster_config",
+                                         "evaluator_config")
+                 if k in cfg}
     data, labels = make_data()
     n_train = 150
     layers = [
@@ -52,13 +55,15 @@ def build(**overrides) -> StandardWorkflow:
             valid_data=data[n_train:], valid_labels=labels[n_train:],
             minibatch_size=cfg["minibatch_size"]),
         layers=layers,
-        decision_config={"max_epochs": cfg["max_epochs"]})
+        decision_config={"max_epochs": cfg["max_epochs"]},
+        **wf_kwargs)
     wf._max_fires = 10_000_000
     return wf
 
 
-def run(device: Device | None = None) -> StandardWorkflow:
-    wf = build()
-    wf.initialize(device=device)
-    wf.run()
-    return wf
+def run(load, main):
+    """Reference sample entry protocol (``veles <sample> <config>``):
+    the launcher passes ``load`` (construct/resume) and ``main``
+    (initialize + train)."""
+    load(build)
+    main()

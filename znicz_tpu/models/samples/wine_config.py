@@ -1,0 +1,9 @@
+"""Wine sample config module (reference convention: a ``*_config.py``
+beside each sample mutates the global ``root`` tree before the
+workflow module builds — ``veles wine.py wine_config.py``)."""
+
+from znicz_tpu.utils.config import root
+
+root.wine.max_epochs = 12
+root.wine.learning_rate = 0.5
+root.wine.minibatch_size = 10

@@ -64,7 +64,8 @@ class RangeAccumulator(Unit):
     SNAPSHOT_ATTRS = ("x_min", "x_max", "n_observed")
 
     def __init__(self, workflow, name: str | None = None,
-                 n_bins: int = 30, **kwargs) -> None:
+                 n_bins: int = 30, max_retained: int = 1 << 20,
+                 **kwargs) -> None:
         super().__init__(workflow, name=name, **kwargs)
         self.input: Vector | None = None
         self.n_bins = int(n_bins)
@@ -74,7 +75,12 @@ class RangeAccumulator(Unit):
         self.histogram = Vector(
             np.zeros(self.n_bins, dtype=np.int64),
             name=f"{self.name}.histogram")
-        self._samples: list[np.ndarray] = []  # kept until range settles
+        #: exact-rebin buffer, bounded: once more than
+        #: ``max_retained`` values have been seen, retention stops and
+        #: later range growth rebins approximately from bin centers
+        self.max_retained = int(max_retained)
+        self._samples: list[np.ndarray] | None = []
+        self._retained = 0
 
     @property
     def bin_centers(self) -> np.ndarray:
@@ -87,7 +93,8 @@ class RangeAccumulator(Unit):
         self.x_min, self.x_max = np.inf, -np.inf
         self.n_observed = 0
         self.histogram.mem[...] = 0
-        self._samples.clear()
+        self._samples = []
+        self._retained = 0
 
     def observe(self, values: np.ndarray) -> None:
         v = np.asarray(values, dtype=np.float64).ravel()
@@ -95,16 +102,39 @@ class RangeAccumulator(Unit):
             return
         lo, hi = float(v.min()), float(v.max())
         grew = lo < self.x_min or hi > self.x_max
+        old_min, old_max = self.x_min, self.x_max
         self.x_min = min(self.x_min, lo)
         self.x_max = max(self.x_max, hi)
-        self._samples.append(v)
+        if self._samples is not None:
+            self._samples.append(v)
+            self._retained += v.size
         self.n_observed += v.size
         if grew:  # rebin everything over the widened range
-            self.histogram.mem[...] = 0
-            for s in self._samples:
-                self._bin(s)
+            if self._samples is not None:  # exact
+                self.histogram.mem[...] = 0
+                for s in self._samples:
+                    self._bin(s)
+            else:  # approximate: redistribute old counts by center
+                self._rebin_approx(old_min, old_max)
+                self._bin(v)
         else:
             self._bin(v)
+        if self._samples is not None and self._retained > self.max_retained:
+            self._samples = None  # memory bound reached
+
+    def _rebin_approx(self, old_min: float, old_max: float) -> None:
+        counts = np.array(self.histogram.mem, copy=True)
+        self.histogram.mem[...] = 0
+        if not np.isfinite(old_min) or counts.sum() == 0:
+            return
+        old_hi = old_max if old_max > old_min else old_min + 1.0
+        edges = np.linspace(old_min, old_hi, self.n_bins + 1)
+        centers = 0.5 * (edges[:-1] + edges[1:])
+        new_hi = (self.x_max if self.x_max > self.x_min
+                  else self.x_min + 1.0)
+        idx = np.clip(((centers - self.x_min) / (new_hi - self.x_min)
+                       * self.n_bins).astype(np.int64), 0, self.n_bins - 1)
+        np.add.at(self.histogram.mem, idx, counts)
 
     def _bin(self, v: np.ndarray) -> None:
         hi = self.x_max if self.x_max > self.x_min else self.x_min + 1.0

@@ -39,6 +39,8 @@ def to_image_array(sample: np.ndarray) -> np.ndarray:
     lo, hi = float(img.min()), float(img.max())
     if hi > lo:
         img = (img - lo) / (hi - lo)
+    else:  # constant sample: flat mid-gray, not a wrapped uint8 cast
+        img = np.full_like(img, 0.5)
     return (img * 255.0 + 0.5).astype(np.uint8)
 
 

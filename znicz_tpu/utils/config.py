@@ -111,15 +111,30 @@ root = _default_root()
 _registered_defaults: dict[str, dict] = {}
 
 
+def _merge_defaults(node: Config, defaults: dict) -> None:
+    """Fill missing leaves only — explicit config wins over defaults."""
+    for key, value in defaults.items():
+        if isinstance(value, dict):
+            child = node.__dict__.get(key)
+            if child is None:
+                child = getattr(node, key)  # vivify an empty subtree
+            if isinstance(child, Config):
+                _merge_defaults(child, value)
+            # else: an explicitly-set leaf shadows the default subtree
+        elif key not in node.__dict__:
+            setattr(node, key, copy.deepcopy(value))
+
+
 def register_defaults(name: str, defaults: dict) -> None:
     """Register a sample's default config subtree under ``root.<name>``.
 
     Samples call this at import; the defaults survive :func:`reset_root`
-    (tests reset between cases) while explicit ``root.<name>.*``
-    mutations by config files still override them.
+    (tests reset between cases).  Defaults never clobber leaves already
+    set (by a config module or CLI ``--root`` override) — import order
+    of sample modules vs config application is irrelevant.
     """
     _registered_defaults[name] = copy.deepcopy(defaults)
-    getattr(root, name).update(copy.deepcopy(defaults))
+    _merge_defaults(getattr(root, name), defaults)
 
 
 def reset_root() -> None:
@@ -129,4 +144,4 @@ def reset_root() -> None:
     root.__dict__.clear()
     root.__dict__.update(fresh.__dict__)
     for name, defaults in _registered_defaults.items():
-        getattr(root, name).update(copy.deepcopy(defaults))
+        _merge_defaults(getattr(root, name), defaults)

@@ -61,18 +61,24 @@ class Snapshotter(Unit):
         self._fire_count += 1
         if self._fire_count % self.interval:
             return
-        wf = self.workflow
-        state = wf.state_dict()
-        os.makedirs(self.directory, exist_ok=True)
-        path = os.path.join(
-            self.directory,
-            f"{self.prefix}_{self.snapshot_suffix()}.pickle.gz")
+        path = self.write(self.workflow.state_dict(), self.directory,
+                          self.prefix, self.snapshot_suffix())
+        self.destination = path
+        self.info("snapshot → %s", path)
+
+    @staticmethod
+    def write(state: dict, directory: str, prefix: str,
+              suffix: str) -> str:
+        """Atomic ``<prefix>_<suffix>.pickle.gz`` state write — the one
+        serialization point (the launcher's emergency snapshots and the
+        periodic unit both use it)."""
+        os.makedirs(directory, exist_ok=True)
+        path = os.path.join(directory, f"{prefix}_{suffix}.pickle.gz")
         tmp = path + ".tmp"
         with gzip.open(tmp, "wb") as f:
             pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
         os.replace(tmp, path)
-        self.destination = path
-        self.info("snapshot → %s", path)
+        return path
 
     @staticmethod
     def load(path: str) -> dict:
