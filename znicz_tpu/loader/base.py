@@ -77,6 +77,7 @@ class Loader(AcceleratedUnit):
         self._schedule: list[tuple[int, int, int]] = []  # (class, lo, hi)
         self._cursor = 0
         self._shuffled: np.ndarray | None = None
+        self._host_indices: np.ndarray | None = None
 
     # ------------------------------------------------------------------
     @property
@@ -176,6 +177,8 @@ class Loader(AcceleratedUnit):
         self.minibatch_class = cls
         self.minibatch_size = count
         self.minibatch_offset = lo
+        self._host_indices = idx  # host copy (streaming loaders read
+        #                           it back without a device round-trip)
         self.minibatch_indices.map_invalidate()
         self.minibatch_indices.mem[...] = idx
         self.minibatch_valid.map_invalidate()

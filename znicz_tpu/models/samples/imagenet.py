@@ -1,0 +1,75 @@
+"""ImageNet from disk: AlexNet over the streaming image pipeline
+(reference: ``znicz/samples/imagenet/`` — the dataset-preparation +
+training workflow pair; here preparation collapses into the
+native-decode streaming loader).
+
+Point ``root.imagenet.train_dir`` (class-per-subdirectory JPEG tree,
+standard ImageNet layout) at the dataset; ``valid_dir`` optional
+(else ``validation_fraction`` carves one out).  The decode/augment
+path is the C++ worker pool (:mod:`znicz_tpu.native`): resize-256 →
+random-crop-227 + horizontal flip on train, center crop on eval,
+double-buffered so decode of batch N+1 overlaps device compute of
+batch N — the SURVEY.md §7 "input pipeline at 8k img/s" design.
+
+The AlexNet layer stack is shared with :mod:`.alexnet` (the
+synthetic-data benchmark variant).
+"""
+
+from __future__ import annotations
+
+from znicz_tpu.loader.image import FileImageLoader
+from znicz_tpu.models.samples.alexnet import layers
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils.config import register_defaults, root
+
+register_defaults("imagenet", {
+    "train_dir": None,           # REQUIRED: ImageNet train tree
+    "valid_dir": None,
+    "validation_fraction": 0.04,
+    "minibatch_size": 128,
+    "learning_rate": 0.01,
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0005,
+    "dropout": 0.5,
+    "n_classes": 1000,
+    "max_epochs": 90,
+    "image_size": 227,
+    "resize_size": 256,
+    "decode_threads": 0,         # 0 → hardware concurrency
+})
+
+
+def build(**overrides) -> StandardWorkflow:
+    cfg = dict(root.imagenet.as_dict())
+    cfg.update(overrides)
+    if not cfg["train_dir"]:
+        raise ValueError(
+            "root.imagenet.train_dir must point at an ImageNet-layout "
+            "image tree (class-per-subdirectory)")
+    size = int(cfg["image_size"])
+    resize = int(cfg["resize_size"])
+    wf_kwargs = {k: cfg.pop(k) for k in ("snapshotter_config",
+                                         "lr_adjuster_config",
+                                         "evaluator_config")
+                 if k in cfg}
+    wf = StandardWorkflow(
+        name="imagenet",
+        loader_factory=lambda w: FileImageLoader(
+            w, train_dir=cfg["train_dir"], valid_dir=cfg["valid_dir"],
+            validation_fraction=cfg["validation_fraction"],
+            out_hw=(size, size), resize_hw=(resize, resize),
+            random_crop=True, random_flip=True,
+            normalization_scale=2.0 / 255.0, normalization_bias=-1.0,
+            minibatch_size=cfg["minibatch_size"],
+            n_threads=cfg["decode_threads"]),
+        layers=layers(cfg),
+        decision_config={"max_epochs": cfg["max_epochs"]},
+        **wf_kwargs)
+    wf._max_fires = 10 ** 9
+    return wf
+
+
+def run(load, main):
+    """Reference sample entry protocol (``veles <sample> <config>``)."""
+    load(build)
+    main()

@@ -1,0 +1,179 @@
+"""Native host-side components, built on demand with the system
+toolchain.
+
+The reference shipped native code beside Python (OpenCL/CUDA kernel
+corpus, FFI runtimes — SURVEY.md §2.3); the TPU rebuild's device
+compute is XLA/Pallas, so the native layer moves to where TPU runs
+actually hurt: the **host input pipeline**.  :class:`ImagePipeline`
+wraps ``pipeline.cpp`` — a libjpeg/libpng decode + augment worker pool
+writing float32 NHWC minibatches — compiled at first use with g++ into
+the user cache dir (no pip installs in this environment; ctypes, not
+pybind11, per the same constraint).
+
+Falls back cleanly: ``ImagePipeline.available()`` is False when the
+toolchain or image libraries are missing, and the Python loaders use a
+PIL path instead.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "pipeline.cpp")
+_LIBS = ("-ljpeg", "-lpng")
+
+_lock = threading.Lock()
+_lib: "ctypes.CDLL | None" = None
+_build_error: str | None = None
+
+
+def _cache_dir() -> str:
+    from znicz_tpu.utils.config import root
+    d = os.path.join(str(root.common.dirs.cache), "native")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _build() -> ctypes.CDLL:
+    """Compile (once per source+host fingerprint) and load the shared
+    library.  The fingerprint includes the CPU feature flags because
+    the build uses ``-march=native`` — a cache dir shared across
+    heterogeneous hosts must not hand an AVX-512 binary to an older
+    core."""
+    h = hashlib.sha256()
+    with open(_SRC, "rb") as f:
+        h.update(f.read())
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    h.update(line.encode())
+                    break
+    except OSError:
+        import platform
+        h.update(platform.processor().encode())
+    tag = h.hexdigest()[:16]
+    so_path = os.path.join(_cache_dir(), f"znicz_pipeline_{tag}.so")
+    if not os.path.exists(so_path):
+        cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
+               "-std=c++17", _SRC, "-o", so_path + ".tmp",
+               "-pthread", *_LIBS]
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
+        os.replace(so_path + ".tmp", so_path)
+    lib = ctypes.CDLL(so_path)
+    lib.zp_create.restype = ctypes.c_void_p
+    lib.zp_create.argtypes = [ctypes.c_int]
+    lib.zp_destroy.argtypes = [ctypes.c_void_p]
+    lib.zp_submit.restype = ctypes.c_int
+    lib.zp_submit.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_char_p), ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.c_float, ctypes.c_float, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_float)]
+    lib.zp_wait.restype = ctypes.c_int
+    lib.zp_wait.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def _get_lib() -> "ctypes.CDLL | None":
+    global _lib, _build_error
+    with _lock:
+        if _lib is None and _build_error is None:
+            try:
+                _lib = _build()
+            except Exception as exc:  # toolchain/libs missing
+                _build_error = str(exc)
+        return _lib
+
+
+class ImagePipeline:
+    """Asynchronous decode+augment batches (one in flight per pool).
+
+    Usage::
+
+        pipe = ImagePipeline(n_threads=8)
+        pipe.submit(paths, out, out_hw=(227, 227), resize_hw=(256, 256),
+                    random_crop=True, random_flip=True,
+                    scale=1/127.5, bias=-1.0, seed=step)
+        ...                      # TPU works on the previous batch here
+        n_failed = pipe.wait()   # out is now filled (failed → zeros)
+    """
+
+    def __init__(self, n_threads: int = 0) -> None:
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError(f"native pipeline unavailable: "
+                               f"{_build_error}")
+        self._lib = lib
+        self._pool = lib.zp_create(int(n_threads))
+        self._keepalive: tuple | None = None  # paths array + out buffer
+
+    @staticmethod
+    def available() -> bool:
+        return _get_lib() is not None
+
+    @staticmethod
+    def build_error() -> str | None:
+        _get_lib()
+        return _build_error
+
+    def submit(self, paths: list[str], out: np.ndarray,
+               out_hw: tuple[int, int],
+               resize_hw: tuple[int, int] | None = None,
+               channels: int = 3, random_crop: bool = False,
+               random_flip: bool = False, scale: float = 1.0,
+               bias: float = 0.0, seed: int = 0) -> None:
+        if self._pool is None:
+            raise RuntimeError("pipeline destroyed")
+        n = len(paths)
+        out_h, out_w = out_hw
+        expected = (n, out_h, out_w, channels) if channels == 3 \
+            else (n, out_h, out_w)
+        if out.dtype != np.float32 or not out.flags.c_contiguous:
+            raise ValueError("out must be C-contiguous float32")
+        if out.size != n * out_h * out_w * channels:
+            raise ValueError(f"out size {out.shape} != {expected}")
+        arr = (ctypes.c_char_p * n)(
+            *[p.encode() for p in paths])
+        rh, rw = resize_hw if resize_hw is not None else (0, 0)
+        rc = self._lib.zp_submit(
+            self._pool, arr, n, rh, rw, out_h, out_w, channels,
+            int(random_crop), int(random_flip),
+            ctypes.c_float(scale), ctypes.c_float(bias),
+            ctypes.c_uint64(seed & (2 ** 64 - 1)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc != 0:
+            raise RuntimeError(f"zp_submit failed (rc={rc})")
+        # paths array and out buffer must outlive the async batch
+        self._keepalive = (arr, out)
+
+    def wait(self) -> int:
+        """Block until the in-flight batch completes; returns the
+        number of failed decodes (their slots are zero-filled)."""
+        if self._pool is None:
+            raise RuntimeError("pipeline destroyed")
+        n_failed = self._lib.zp_wait(self._pool)
+        self._keepalive = None
+        return int(n_failed)
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._lib.zp_wait(self._pool)
+            self._lib.zp_destroy(self._pool)
+            self._pool = None
+
+    def __del__(self) -> None:  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
