@@ -60,10 +60,17 @@ def _apply_root_overrides(pairs: list[str]) -> None:
         if "=" not in pair:
             raise ValueError(f"--root expects key=value, got '{pair}'")
         key, raw = pair.split("=", 1)
-        try:
-            value = ast.literal_eval(raw)
-        except (ValueError, SyntaxError):
-            value = raw  # plain string leaf
+        stripped = raw.strip()
+        if stripped.startswith("Tune(") and stripped.endswith(")"):
+            # tunable range for --optimize, e.g.
+            # --root wine.learning_rate="Tune(0.3, 0.05, 0.8)"
+            from znicz_tpu.genetics import Tune
+            value = Tune(*ast.literal_eval(stripped[len("Tune"):]))
+        else:
+            try:
+                value = ast.literal_eval(raw)
+            except (ValueError, SyntaxError):
+                value = raw  # plain string leaf
         node = root
         parts = key.split(".")
         if parts[0] == "root":
@@ -115,6 +122,10 @@ def make_parser() -> argparse.ArgumentParser:
                    help="debug-level logging (region compiles, timings)")
     p.add_argument("--no-graphics", action="store_true",
                    help="disable the plotting render thread")
+    p.add_argument("--optimize", metavar="GENSxPOP",
+                   help="genetic hyperparameter search over Tune "
+                        "leaves in the config tree, e.g. "
+                        "--optimize 5x8 (reference: veles/genetics)")
     p.add_argument("--dump-graph", metavar="FILE",
                    help="write the workflow's Graphviz DOT and exit")
     p.add_argument("--dry-run", action="store_true",
@@ -182,11 +193,53 @@ class Main(Logger):
                     f.write(dot)
                 self.info("graph → %s", args.dump_graph)
             return 0
+        if args.optimize:
+            return self._optimize(args, run_fn)
         try:
             launcher.boot(run_fn)
         except KeyboardInterrupt:
             self.warning("interrupted")
             return 130
+        return 0
+
+    def _optimize(self, args, run_fn) -> int:
+        """Genetic search: every ``Tune`` leaf in the config tree
+        (outside ``root.common``) is a gene; each candidate trains a
+        fresh workflow via the sample's own ``run(load, main)``."""
+        from znicz_tpu.genetics import (GeneticsOptimizer, apply_genome,
+                                        collect_tunes, workflow_fitness)
+        gens, _, pop = args.optimize.partition("x")
+        generations, population = int(gens), int(pop or 8)
+        space = {path: tune
+                 for path, tune in collect_tunes(root).items()
+                 if not path.startswith("common.")}
+        if not space:
+            self.error("--optimize given but no Tune leaves in the "
+                       "config tree")
+            return 1
+        self.info("optimizing %d genes: %s", len(space), sorted(space))
+
+        def fitness(genome: dict) -> float:
+            # same init/shuffle streams per candidate: scores compare
+            # hyperparameters, not seed luck
+            prng.seed_all(int(root.common.seed))
+            # dotted genes hit the config tree; plain genes ride into
+            # the sample's build via the trial launcher
+            build_kwargs = apply_genome(genome)
+            trial = Launcher(
+                backend=args.backend,
+                graphics=False if args.no_graphics else None,
+                load_kwargs=build_kwargs)
+            trial.boot(run_fn)
+            return workflow_fitness(trial.workflow)
+
+        opt = GeneticsOptimizer(
+            space=space, fitness_fn=fitness, generations=generations,
+            population_size=population, seed=int(root.common.seed))
+        best = opt.run()
+        self.best_genome = best  # introspection
+        self.info("best genome (fitness %.4f): %s",
+                  opt.best_fitness, best)
         return 0
 
 

@@ -1,0 +1,156 @@
+"""Ensemble: train N model instances, aggregate their evaluation.
+
+Rebuilds the reference's ``veles/ensemble/`` — N independent trainings
+of the same workflow (different seeds), followed by an aggregated
+evaluation pass (averaged class probabilities) that is typically
+better than any single member.
+
+The reference trained members as separate cluster jobs; here members
+train sequentially on the local device (process-level scale-out mirrors
+genetics: with ``jax.distributed``, process *p* trains members
+``p::process_count``).  The aggregated pass replays each member's
+validation/test minibatches through its compiled hot chain — backward
+units stay gated off on non-train classes, dropout runs in eval mode —
+and averages the softmax outputs per sample.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from znicz_tpu.loader.base import TRAIN, VALID
+from znicz_tpu.utils.logger import Logger
+
+
+def class_forward_pass(wf, klass: int) -> tuple[dict, dict]:
+    """Replay every minibatch of ``klass`` through the (trained)
+    workflow's hot chain; returns ``(outputs, labels)`` keyed by
+    global sample index.  Training side effects are impossible for
+    non-train classes: the GD units' ``gate_skip`` follows
+    ``minibatch_class != TRAIN`` and stochastic units track
+    ``forward_mode``."""
+    loader = wf.loader
+    outputs: dict[int, np.ndarray] = {}
+    labels: dict[int, int] = {}
+    out_vec = wf.forwards[-1].output
+    for cursor, (cls, _lo, _hi) in enumerate(loader._schedule):
+        if cls != klass:
+            continue
+        loader._cursor = cursor
+        loader.run()
+        if wf._region_unit is not None:
+            wf._region_unit.run()
+        else:
+            for unit in wf.forwards:
+                unit.run()
+        out_vec.map_read()
+        loader.minibatch_labels.map_read()
+        idx = loader._host_indices
+        for row in range(loader.minibatch_size):
+            gi = int(idx[row])
+            outputs[gi] = np.array(out_vec.mem[row], copy=True)
+            labels[gi] = int(loader.minibatch_labels.mem[row])
+    return outputs, labels
+
+
+class Ensemble(Logger):
+    """Train ``n_models`` instances of a sample and vote.
+
+    Parameters
+    ----------
+    build_fn:
+        ``callable(**overrides) -> StandardWorkflow`` (a sample's
+        ``build``); the loss must be classification (softmax head).
+    n_models / base_seed:
+        member *i* trains with PRNG seed ``base_seed + i`` — different
+        weight init and shuffle streams, same dataset split.
+    """
+
+    def __init__(self, build_fn: Callable, n_models: int = 3,
+                 base_seed: int = 1234,
+                 device_factory: Callable | None = None,
+                 train_kwargs: dict | None = None) -> None:
+        super().__init__()
+        if n_models < 1:
+            raise ValueError("n_models must be >= 1")
+        self.build_fn = build_fn
+        self.n_models = int(n_models)
+        self.base_seed = int(base_seed)
+        self.device_factory = device_factory
+        self.train_kwargs = dict(train_kwargs or {})
+        self.workflows: list = []
+        self.member_stats: list[dict] = []
+
+    # ------------------------------------------------------------------
+    def train(self) -> "Ensemble":
+        from znicz_tpu.backends import Device
+        from znicz_tpu.utils import prng
+        self.workflows = []
+        self.member_stats = []
+        for i in range(self.n_models):
+            prng.seed_all(self.base_seed + i)
+            wf = self.build_fn(**self.train_kwargs)
+            device = (self.device_factory() if self.device_factory
+                      else Device.create())
+            wf.initialize(device=device)
+            wf.run()
+            d = wf.decision
+            stats = {"seed": self.base_seed + i}
+            if getattr(d, "min_validation_n_err_pt", None) is not None:
+                stats["validation_err_pt"] = \
+                    float(d.min_validation_n_err_pt)
+            self.info("member %d/%d trained: %s", i + 1,
+                      self.n_models, stats)
+            self.workflows.append(wf)
+            self.member_stats.append(stats)
+        return self
+
+    # ------------------------------------------------------------------
+    def evaluate(self, klass: int = VALID) -> dict:
+        """Aggregate evaluation on ``klass`` minibatches.
+
+        Returns per-member error percentages and the ensemble's
+        (averaged class probabilities → argmax)."""
+        if not self.workflows:
+            raise RuntimeError("train() first")
+        if klass == TRAIN:
+            raise ValueError("evaluate on VALID or TEST, not TRAIN")
+        sum_probs: dict[int, np.ndarray] = {}
+        labels: dict[int, int] = {}
+        member_errs: list[float] = []
+        for wf in self.workflows:
+            outputs, wf_labels = class_forward_pass(wf, klass)
+            if not outputs:
+                raise ValueError(f"loader has no class-{klass} samples")
+            errs = 0
+            for gi, probs in outputs.items():
+                if int(np.argmax(probs)) != wf_labels[gi]:
+                    errs += 1
+                if gi in sum_probs:
+                    sum_probs[gi] = sum_probs[gi] + probs
+                else:
+                    sum_probs[gi] = probs.astype(np.float64)
+                # per-index labels must agree across members — a
+                # seed-dependent dataset split (e.g. a loader carving
+                # validation via the global PRNG) would silently
+                # average probabilities of unrelated samples
+                if labels.setdefault(gi, wf_labels[gi]) != wf_labels[gi]:
+                    raise ValueError(
+                        "members disagree on sample labels: the "
+                        "loader's class split depends on the PRNG "
+                        "seed; give the loader a fixed split (or its "
+                        "own prng_name) so every member sees the same "
+                        "sample at the same global index")
+            member_errs.append(100.0 * errs / len(outputs))
+        ens_errs = sum(
+            1 for gi, probs in sum_probs.items()
+            if int(np.argmax(probs)) != labels[gi])
+        result = {
+            "n_samples": len(sum_probs),
+            "member_err_pt": member_errs,
+            "ensemble_err_pt": 100.0 * ens_errs / len(sum_probs),
+        }
+        self.info("ensemble eval: %s", result)
+        return result

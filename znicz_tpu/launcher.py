@@ -59,11 +59,16 @@ class Launcher(Logger):
                  process_id: int | None = None,
                  retries: int = 0,
                  graphics: bool | None = None,
+                 load_kwargs: dict | None = None,
                  **kwargs) -> None:
         super().__init__(**kwargs)
         self.backend = backend
         self.snapshot = snapshot
         self.retries = int(retries)
+        #: extra kwargs merged into every _load(factory, ...) call —
+        #: the channel by which embedding drivers (e.g. --optimize
+        #: trials) parameterize a sample's build without editing it
+        self.load_kwargs = dict(load_kwargs or {})
         self.workflow: Workflow | None = None
         self.device: Device | None = None
         self._snapshot_state: dict | None = None
@@ -131,7 +136,9 @@ class Launcher(Logger):
         Returns ``(workflow, snapshot_was_loaded)`` like the reference
         ``Main._load``.
         """
-        self.workflow = factory(**kwargs)
+        merged = dict(self.load_kwargs)
+        merged.update(kwargs)
+        self.workflow = factory(**merged)
         loaded = False
         if self.snapshot:
             self._snapshot_state = Snapshotter.load(self.snapshot)

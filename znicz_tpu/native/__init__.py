@@ -61,14 +61,16 @@ def _build() -> ctypes.CDLL:
     tag = h.hexdigest()[:16]
     so_path = os.path.join(_cache_dir(), f"znicz_pipeline_{tag}.so")
     if not os.path.exists(so_path):
+        # per-process tmp: concurrent cold-cache builds (multi-process
+        # jax, pytest-xdist) must not interleave into one file
+        tmp = f"{so_path}.{os.getpid()}.tmp"
         cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC",
-               "-std=c++17", _SRC, "-o", so_path + ".tmp",
-               "-pthread", *_LIBS]
+               "-std=c++17", _SRC, "-o", tmp, "-pthread", *_LIBS]
         proc = subprocess.run(cmd, capture_output=True, text=True)
         if proc.returncode != 0:
             raise RuntimeError(
                 f"native build failed: {' '.join(cmd)}\n{proc.stderr}")
-        os.replace(so_path + ".tmp", so_path)
+        os.replace(tmp, so_path)
     lib = ctypes.CDLL(so_path)
     lib.zp_create.restype = ctypes.c_void_p
     lib.zp_create.argtypes = [ctypes.c_int]
