@@ -1,0 +1,151 @@
+"""ForwardExporter + Publisher tests (reference: the libZnicz export
+path and ``veles/publishing/`` reports)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.ensemble import class_forward_pass
+from znicz_tpu.export import ExportedModel, export_forward
+from znicz_tpu.loader.base import VALID
+from znicz_tpu.models.samples.wine import build, make_data
+from znicz_tpu.utils import prng
+
+
+def train_wine(device, **overrides):
+    prng.seed_all(321)
+    wf = build(max_epochs=4, **overrides)
+    wf.initialize(device=device)
+    wf.run()
+    return wf
+
+
+def test_export_reload_matches_workflow(tmp_path):
+    wf = train_wine(XLADevice())
+    path = str(tmp_path / "wine.npz")
+    assert wf.export_forward(path) == path
+
+    # ground truth: the trained workflow's own forward outputs
+    want, _ = class_forward_pass(wf, VALID)
+
+    model = ExportedModel.load(path, device=XLADevice())
+    data, _ = make_data()
+    x = data[150:]  # the validation rows (wine.build split point)
+    probs = model(x)
+    assert probs.shape == (27, 3)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+    # global sample order is test, validation, train — wine has no
+    # test split, so validation rows are global indices 0..26
+    got = np.stack([probs[i] for i in range(len(x))])
+    want_arr = np.stack([want[i] for i in range(len(x))])
+    np.testing.assert_allclose(got, want_arr, atol=1e-4)
+
+
+def test_export_numpy_equals_xla(tmp_path):
+    wf = train_wine(XLADevice())
+    path = str(tmp_path / "wine.npz")
+    export_forward(wf, path)
+    data, _ = make_data()
+    x = data[150:155]
+    xla_probs = ExportedModel.load(path, device=XLADevice())(x)
+    np_probs = ExportedModel.load(path, device=NumpyDevice())(x)
+    np.testing.assert_allclose(xla_probs, np_probs, atol=1e-4)
+
+
+def test_export_validates_input_shape(tmp_path):
+    wf = train_wine(NumpyDevice())
+    path = str(tmp_path / "wine.npz")
+    export_forward(wf, path)
+    model = ExportedModel.load(path, device=NumpyDevice())
+    with pytest.raises(ValueError, match="sample shape"):
+        model(np.zeros((4, 7), dtype=np.float32))
+    # batch-size changes just re-initialize
+    assert model.predict_classes(
+        np.zeros((2, 13), dtype=np.float32)).shape == (2,)
+    assert model.predict_classes(
+        np.zeros((5, 13), dtype=np.float32)).shape == (5,)
+
+
+def test_export_conv_chain(tmp_path):
+    """Conv/pooling topologies export and reload too."""
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+
+    prng.seed_all(7)
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(40, 12, 12, 1)).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) > 0).astype(np.int32)
+    wf = StandardWorkflow(
+        name="conv_export",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=x[:32], train_labels=y[:32],
+            valid_data=x[32:], valid_labels=y[32:], minibatch_size=8),
+        layers=[
+            {"type": "conv_relu", "->": {"n_kernels": 3, "kx": 3,
+                                         "ky": 3}},
+            {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},
+            {"type": "softmax", "->": {"output_sample_shape": 2}},
+        ],
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    path = str(tmp_path / "conv.npz")
+    wf.export_forward(path)
+    model = ExportedModel.load(path, device=XLADevice())
+    probs = model(x[:5])
+    assert probs.shape == (5, 2)
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+
+def test_publisher_writes_reports(tmp_path):
+    wf = train_wine(
+        NumpyDevice(),
+        snapshotter_config={"prefix": "pub", "directory": str(tmp_path)})
+    # publisher normally fires via the decision gate; fire directly
+    from znicz_tpu.publishing import Publisher
+    pub = Publisher(wf, out_dir=str(tmp_path), formats=("md", "html",
+                                                        "json"))
+    pub.run()
+    assert len(pub.destinations) == 3
+    md = open(os.path.join(tmp_path, "wine_report.md")).read()
+    assert "Training report: wine" in md
+    assert "best validation error %" in md
+    assert "All2AllTanh" in md and "All2AllSoftmax" in md
+    blob = json.load(open(os.path.join(tmp_path, "wine_report.json")))
+    assert blob["metrics"]["epochs"] >= 3
+    assert blob["snapshot"]
+    html_text = open(os.path.join(tmp_path, "wine_report.html")).read()
+    assert "<table" in html_text
+
+
+def test_publisher_fires_on_completion(tmp_path):
+    prng.seed_all(11)
+    wf = build(max_epochs=2)
+    wf.link_publisher(out_dir=str(tmp_path), formats=("json",))
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    assert wf.publisher.destinations
+    blob = json.load(open(wf.publisher.destinations[0]))
+    assert blob["title"] == "wine"
+    # fired exactly once, at completion
+    assert wf.publisher.run_count == 1
+
+
+def test_export_ragged_batches_cached_xla(tmp_path):
+    """Alternating batch sizes reuse cached per-size programs and
+    keep producing identical outputs."""
+    wf = train_wine(XLADevice())
+    path = str(tmp_path / "wine.npz")
+    export_forward(wf, path)
+    model = ExportedModel.load(path, device=XLADevice())
+    data, _ = make_data()
+    a = model(data[:8])
+    b = model(data[:3])
+    a2 = model(data[:8])  # cache hit for size 8
+    np.testing.assert_allclose(a, a2, atol=1e-6)
+    np.testing.assert_allclose(a[:3], b, atol=1e-4)
+    assert set(model._by_batch) == {8, 3}

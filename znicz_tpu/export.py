@@ -1,0 +1,234 @@
+"""ForwardExporter: serialize a trained forward chain for serving.
+
+Rebuilds the reference's ``ForwardExporter`` (reference:
+``znicz/nn_units.py`` / libZnicz — the trained forward chain written in
+a format a standalone C++ inference engine could execute without the
+training framework).
+
+TPU-native format: one ``.npz`` bundle holding a JSON manifest (layer
+types + constructor configs + input geometry) beside the parameter
+arrays.  :class:`ExportedModel` reloads the bundle **without any
+workflow, loader or training machinery** and rebuilds the forward
+chain from the layer-type registry — the same unit code that trained
+is the inference spec — then compiles it into a single jitted
+inference function (or runs the numpy oracle path).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+
+import numpy as np
+
+from znicz_tpu.backends import Device, NumpyDevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow
+from znicz_tpu.memory import Vector
+
+FORMAT_NAME = "znicz-tpu-forward"
+FORMAT_VERSION = 1
+
+
+def _manifest_for(workflow) -> dict:
+    """Collect layer specs + geometry from a trained
+    StandardWorkflow."""
+    layers = []
+    for spec, unit in zip(workflow.layers_config, workflow.forwards):
+        layers.append({
+            "type": spec["type"],
+            "config": spec.get("->", {}),
+            "has_weights": bool(unit.weights),
+            "has_bias": bool(unit.bias),
+            "name": unit.name,
+        })
+    return {
+        "format": FORMAT_NAME,
+        "version": FORMAT_VERSION,
+        "workflow": workflow.name,
+        "loss": workflow.loss,
+        "input_shape": list(workflow.loader.minibatch_data.shape[1:]),
+        "layers": layers,
+    }
+
+
+def export_forward(workflow, path: str) -> str:
+    """Write the trained forward chain of a ``StandardWorkflow`` to
+    ``path`` (``.npz`` bundle).  Returns the path written."""
+    manifest = _manifest_for(workflow)
+    arrays: dict[str, np.ndarray] = {}
+    for i, unit in enumerate(workflow.forwards):
+        for attr in ("weights", "bias"):
+            vec = getattr(unit, attr)
+            if vec:
+                vec.map_read()
+                arrays[f"layer{i}_{attr}"] = np.array(vec.mem, copy=True)
+    arrays["manifest"] = np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+    return path
+
+
+class ExportedModel:
+    """A servable forward chain loaded from an exported bundle.
+
+    ``model(x)`` maps a float32 batch (NHWC or flat, matching the
+    training loader's sample shape) to the final layer's output
+    (softmax head → class probabilities).  Stochastic layers (dropout)
+    run in eval mode.  The XLA path compiles the whole chain into one
+    program; the numpy path is the oracle."""
+
+    def __init__(self, manifest: dict,
+                 params: dict[str, np.ndarray],
+                 device: Device | None = None) -> None:
+        if manifest.get("format") != FORMAT_NAME:
+            raise ValueError("not a znicz-tpu forward bundle")
+        if manifest.get("version", 0) > FORMAT_VERSION:
+            raise ValueError(
+                f"bundle version {manifest['version']} is newer than "
+                f"this framework ({FORMAT_VERSION})")
+        self.manifest = manifest
+        self.input_shape = tuple(manifest["input_shape"])
+        self.device = device or Device.create()
+        self._params = params
+        self._params_loaded = False
+        self._by_batch: dict[int, "callable"] = {}  # jit fn per size
+        self._cur_batch: int | None = None
+        self._build_chain()
+
+    @classmethod
+    def load(cls, path: str,
+             device: Device | None = None) -> "ExportedModel":
+        with np.load(path) as bundle:
+            manifest = json.loads(bytes(bundle["manifest"]).decode())
+            params = {k: bundle[k] for k in bundle.files
+                      if k != "manifest"}
+        return cls(manifest, params, device=device)
+
+    # ------------------------------------------------------------------
+    def _build_chain(self) -> None:
+        from znicz_tpu.models.standard_workflow import layer_type
+        wf = DummyWorkflow(device=self.device)
+        self._input_vec = Vector(name="export.input", batch_major=True)
+        source = DummyUnit(wf, output=self._input_vec)
+        self.forwards = []
+        prev = source
+        for i, layer in enumerate(self.manifest["layers"]):
+            cls = layer_type(layer["type"])
+            unit = cls(wf, **layer["config"])
+            unit.link_attrs(prev, ("input", "output"))
+            if "forward_mode" in unit.__dict__:
+                unit.forward_mode = "eval"  # dropout = identity
+            self.forwards.append(unit)
+            prev = unit
+        self._wf = wf
+
+    def _initialize(self, batch: int) -> None:
+        """(Re-)shape the chain for a batch size.  Parameters load
+        exactly once — unit re-initialization keeps non-empty
+        weights/bias, so only the input and intermediate activations
+        reallocate per batch size."""
+        self._input_vec.reset(np.zeros(
+            (batch,) + self.input_shape, dtype=np.float32))
+        self._input_vec.initialize(self.device)
+        for i, unit in enumerate(self.forwards):
+            if not self._params_loaded:
+                # units must see the stored params BEFORE their first
+                # initialize (so they skip the random fill)
+                for attr in ("weights", "bias"):
+                    key = f"layer{i}_{attr}"
+                    if key in self._params:
+                        getattr(unit, attr).reset(
+                            np.array(self._params[key], copy=True))
+            unit.initialize(device=self.device)
+            if not self._params_loaded:
+                for attr in ("weights", "bias"):
+                    key = f"layer{i}_{attr}"
+                    if key in self._params:
+                        vec = getattr(unit, attr)
+                        if tuple(vec.shape) != self._params[key].shape:
+                            raise ValueError(
+                                f"layer {i} {attr}: bundle shape "
+                                f"{self._params[key].shape} != rebuilt "
+                                f"{tuple(vec.shape)}")
+        self._params_loaded = True
+        self._cur_batch = batch
+
+    # ------------------------------------------------------------------
+    def _compile(self):
+        import jax
+
+        vectors: list[Vector] = []
+        seen = {id(self._input_vec)}
+        for unit in self.forwards:
+            for vec in unit.region_vectors():
+                if id(vec) not in seen:
+                    seen.add(id(vec))
+                    vectors.append(vec)
+        for vec in vectors:
+            vec.unmap()
+        units = self.forwards
+        input_vec = self._input_vec
+
+        def fn(x, *leaves):
+            for vec, leaf in zip(vectors, leaves):
+                vec._tracing = True
+                vec._devmem = leaf
+            input_vec._tracing = True
+            input_vec._devmem = x
+            try:
+                for unit in units:
+                    unit.xla_run()
+                return units[-1].output._devmem
+            finally:
+                input_vec._tracing = False
+                for vec in vectors:
+                    vec._tracing = False
+
+        jitted = jax.jit(fn)
+        leaves = [vec._devmem for vec in vectors]
+        input_leaf = input_vec._devmem
+
+        def call(x):
+            out = jitted(x, *leaves)
+            # tracing wrote tracers into vec._devmem; restore the real
+            # arrays so later _initialize/_compile rounds (other batch
+            # sizes) never snapshot a dead tracer
+            for vec, leaf in zip(vectors, leaves):
+                vec._devmem = leaf
+            input_vec._devmem = input_leaf
+            return out
+
+        return call
+
+    def __call__(self, x: np.ndarray) -> np.ndarray:
+        x = np.ascontiguousarray(x, dtype=np.float32)
+        if x.shape[1:] != self.input_shape:
+            raise ValueError(f"input sample shape {x.shape[1:]} != "
+                             f"exported {self.input_shape}")
+        batch = x.shape[0]
+        if isinstance(self.device, NumpyDevice):
+            if self._cur_batch != batch:
+                self._initialize(batch)
+            self._input_vec.map_invalidate()
+            self._input_vec.mem[...] = x
+            for unit in self.forwards:
+                unit.numpy_run()
+            out = self.forwards[-1].output
+            out.map_read()
+            return np.array(out.mem, copy=True)
+        # XLA: one compiled program per batch size, cached — ragged
+        # serving streams (64,64,37,64,…) pay each size's trace once
+        fn = self._by_batch.get(batch)
+        if fn is None:
+            self._initialize(batch)
+            fn = self._by_batch[batch] = self._compile()
+        return np.asarray(fn(x))
+
+    def predict_classes(self, x: np.ndarray) -> np.ndarray:
+        return np.argmax(self(x), axis=1)

@@ -106,6 +106,16 @@ class GraphicsServer(Logger):
         if self._thread is not None:
             self._queue.put(payload)
 
+    def flush(self, timeout: float = 30.0) -> bool:
+        """Block until everything submitted so far has been drawn
+        (consumers like the Publisher embed the PNGs — they must not
+        read files the render thread is still writing)."""
+        if self._thread is None:
+            return True
+        event = threading.Event()
+        self._queue.put({"__flush__": event})
+        return event.wait(timeout)
+
     def stop(self) -> None:
         """Drain the render queue and join the thread."""
         if self._thread is not None:
@@ -130,13 +140,20 @@ class GraphicsServer(Logger):
             if payload is None:
                 return
             # collapse bursts: only the newest payload per name is drawn
-            latest: dict[str, dict] = {payload.get("name", "plot"): payload}
+            latest: dict[str, dict] = {}
+            flush_events = []
             stopping = False
+            if "__flush__" in payload:
+                flush_events.append(payload["__flush__"])
+            else:
+                latest[payload.get("name", "plot")] = payload
             try:
                 while not stopping:
                     extra = self._queue.get_nowait()
                     if extra is None:
                         stopping = True
+                    elif "__flush__" in extra:
+                        flush_events.append(extra["__flush__"])
                     else:
                         latest[extra.get("name", "plot")] = extra
             except queue.Empty:
@@ -147,6 +164,8 @@ class GraphicsServer(Logger):
                 except Exception as exc:  # noqa: BLE001 — keep rendering
                     self.warning("failed to draw %s: %s",
                                  p.get("name"), exc)
+            for event in flush_events:
+                event.set()
             if stopping:
                 return
 
@@ -246,6 +265,15 @@ def get_server() -> GraphicsServer:
         if _server is None:
             _server = GraphicsServer()
         return _server
+
+
+def flush_server() -> None:
+    """Flush the global server's render queue IF one exists (never
+    creates one)."""
+    with _server_lock:
+        server = _server
+    if server is not None:
+        server.flush()
 
 
 def reset_server() -> None:

@@ -345,6 +345,25 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.image_saver = s
         return s
 
+    def link_publisher(self, **config):
+        """Post-training report generation (reference: ``Publisher``
+        from ``veles/publishing/``): fires once, when the decision
+        raises ``complete``."""
+        from znicz_tpu.publishing import Publisher
+        p = Publisher(self, name="publisher", **config)
+        p.link_from(self.decision)
+        self._relink_end_point_last()
+        p.gate_skip = ~self.decision.complete
+        self.publisher = p
+        return p
+
+    def export_forward(self, path: str) -> str:
+        """Serialize the trained forward chain for serving
+        (reference: ``ForwardExporter``; see
+        :mod:`znicz_tpu.export`)."""
+        from znicz_tpu.export import export_forward
+        return export_forward(self, path)
+
     # ------------------------------------------------------------------
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)

@@ -1,0 +1,229 @@
+"""Publishing: post-training report generation.
+
+Rebuilds the reference's ``veles/publishing/`` — after a training run
+the Publisher unit renders a report of what ran and how well: model
+architecture, config, convergence metrics, timing, artifacts.  The
+reference had html/pdf/confluence backends; here the backends are
+Markdown and self-contained HTML (no external renderers in this
+environment — the HTML backend embeds the plot PNGs base64-inline so
+the report is one portable file).
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import glob
+import html
+import json
+import os
+
+import numpy as np
+
+from znicz_tpu.units import Unit
+from znicz_tpu.utils.config import root
+
+
+def _layer_rows(workflow) -> list[dict]:
+    rows = []
+    for unit in getattr(workflow, "forwards", []):
+        n_params = 0
+        for attr in ("weights", "bias"):
+            vec = getattr(unit, attr, None)
+            if vec:  # shape, not mem: the device copy may be
+                n_params += int(np.prod(vec.shape))  # authoritative
+        rows.append({
+            "name": unit.name,
+            "type": type(unit).__name__,
+            "output_shape": tuple(unit.output.shape[1:])
+            if unit.output else (),
+            "parameters": n_params,
+        })
+    return rows
+
+
+def _metric_rows(workflow) -> dict:
+    from znicz_tpu.loader.base import VALID
+    d = getattr(workflow, "decision", None)
+    out: dict = {}
+    if d is None:
+        return out
+    loader = getattr(workflow, "loader", None)
+    has_validation = False
+    if loader is not None:
+        out["epochs"] = int(loader.epoch_number)
+        has_validation = bool(loader.class_lengths[VALID])
+    if not has_validation:
+        return out  # the decision's validation fields are untouched
+        #             initials for train-only runs — not real metrics
+    for attr, label in (
+            ("min_validation_n_err_pt", "best validation error %"),
+            ("min_validation_mse", "best validation MSE")):
+        value = getattr(d, attr, None)
+        if value is not None:
+            out[label] = float(value)
+    return out
+
+
+def gather_report(workflow) -> dict:
+    """Everything a report renders, as plain data (also the json
+    side-output — scripts consume it)."""
+    timing = sorted(
+        ({"unit": u.name, "runs": u.run_count,
+          "total_s": round(u.run_time_total, 4)}
+         for u in workflow.units if u.run_count),
+        key=lambda r: r["total_s"], reverse=True)
+    # plots: only THIS workflow's plotter outputs (the plots dir is
+    # shared across runs), and only after the async render thread has
+    # drawn everything submitted
+    from znicz_tpu import graphics
+    graphics.flush_server()
+    plots_dir = str(root.common.dirs.plots)
+    unit_names = {u.name for u in workflow.units}
+    plots = sorted(
+        p for p in glob.glob(os.path.join(plots_dir, "*.png"))
+        if os.path.splitext(os.path.basename(p))[0] in unit_names)
+    snap = getattr(workflow, "snapshotter", None)
+    return {
+        "title": workflow.name,
+        "generated": datetime.datetime.now().isoformat(
+            sep=" ", timespec="seconds"),
+        "metrics": _metric_rows(workflow),
+        "layers": _layer_rows(workflow),
+        "timing": timing[:10],
+        "plots": plots,
+        "snapshot": snap.destination if snap is not None else None,
+        "config": root.get(workflow.name).as_dict()
+        if workflow.name in root else {},
+    }
+
+
+def render_markdown(report: dict) -> str:
+    lines = [f"# Training report: {report['title']}",
+             "", f"*Generated {report['generated']}*", ""]
+    if report["metrics"]:
+        lines += ["## Results", ""]
+        for key, value in report["metrics"].items():
+            lines.append(f"- **{key}**: {value}")
+        lines.append("")
+    if report["layers"]:
+        lines += ["## Model", "",
+                  "| layer | type | output shape | parameters |",
+                  "|---|---|---|---|"]
+        for row in report["layers"]:
+            lines.append(
+                f"| {row['name']} | {row['type']} | "
+                f"{row['output_shape']} | {row['parameters']:,} |")
+        total = sum(r["parameters"] for r in report["layers"])
+        lines += ["", f"Total parameters: **{total:,}**", ""]
+    if report["config"]:
+        lines += ["## Configuration", "", "```json",
+                  json.dumps(report["config"], indent=2, default=str),
+                  "```", ""]
+    if report["timing"]:
+        lines += ["## Slowest units", "",
+                  "| unit | runs | total s |", "|---|---|---|"]
+        for row in report["timing"]:
+            lines.append(f"| {row['unit']} | {row['runs']} | "
+                         f"{row['total_s']} |")
+        lines.append("")
+    if report["snapshot"]:
+        lines += [f"Best snapshot: `{report['snapshot']}`", ""]
+    if report["plots"]:
+        lines += ["## Plots", ""]
+        lines += [f"![{os.path.basename(p)}]({p})" for p in report["plots"]]
+        lines.append("")
+    return "\n".join(lines)
+
+
+def render_html(report: dict) -> str:
+    """Self-contained HTML: plots embedded base64 inline."""
+    md_body = []
+    md_body.append(f"<h1>Training report: "
+                   f"{html.escape(report['title'])}</h1>")
+    md_body.append(f"<p><em>Generated "
+                   f"{html.escape(report['generated'])}</em></p>")
+    if report["metrics"]:
+        md_body.append("<h2>Results</h2><ul>")
+        for key, value in report["metrics"].items():
+            md_body.append(f"<li><b>{html.escape(str(key))}</b>: "
+                           f"{html.escape(str(value))}</li>")
+        md_body.append("</ul>")
+    if report["layers"]:
+        md_body.append("<h2>Model</h2><table border=1 "
+                       "cellpadding=4><tr><th>layer</th><th>type</th>"
+                       "<th>output shape</th><th>parameters</th></tr>")
+        for row in report["layers"]:
+            md_body.append(
+                f"<tr><td>{html.escape(row['name'])}</td>"
+                f"<td>{html.escape(row['type'])}</td>"
+                f"<td>{html.escape(str(row['output_shape']))}</td>"
+                f"<td>{row['parameters']:,}</td></tr>")
+        md_body.append("</table>")
+    if report["timing"]:
+        md_body.append("<h2>Slowest units</h2><table border=1 "
+                       "cellpadding=4><tr><th>unit</th><th>runs</th>"
+                       "<th>total s</th></tr>")
+        for row in report["timing"]:
+            md_body.append(
+                f"<tr><td>{html.escape(row['unit'])}</td>"
+                f"<td>{row['runs']}</td><td>{row['total_s']}</td></tr>")
+        md_body.append("</table>")
+    for p in report["plots"]:
+        try:
+            with open(p, "rb") as f:
+                data = base64.b64encode(f.read()).decode()
+            md_body.append(
+                f"<h3>{html.escape(os.path.basename(p))}</h3>"
+                f'<img src="data:image/png;base64,{data}" '
+                f'style="max-width:720px">')
+        except OSError:
+            continue
+    body = "\n".join(md_body)
+    return (f"<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(report['title'])}</title></head>"
+            f"<body>{body}</body></html>")
+
+
+class Publisher(Unit):
+    """End-of-training report unit (reference: ``Publisher``).
+
+    Wire after the Decision with ``gate_skip = ~decision.complete`` —
+    it fires exactly once, when training finishes (done by
+    ``StandardWorkflow.link_publisher``)."""
+
+    KNOWN_FORMATS = ("md", "html", "json")
+
+    def __init__(self, workflow, name: str | None = None,
+                 out_dir: str | None = None,
+                 formats: tuple = ("md", "html", "json"),
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.out_dir = out_dir
+        self.formats = tuple(formats)
+        # fail at wiring time, not after hours of training
+        unknown = [f for f in self.formats if f not in self.KNOWN_FORMATS]
+        if unknown:
+            raise ValueError(f"unknown report format(s) {unknown} "
+                             f"(have {self.KNOWN_FORMATS})")
+        self.destinations: list[str] = []
+
+    def run(self) -> None:
+        wf = self.workflow
+        out_dir = self.out_dir or str(root.common.dirs.cache)
+        os.makedirs(out_dir, exist_ok=True)
+        report = gather_report(wf)
+        base = os.path.join(out_dir, f"{wf.name}_report")
+        self.destinations = []
+        for fmt in self.formats:
+            path = f"{base}.{fmt}"
+            if fmt == "md":
+                content = render_markdown(report)
+            elif fmt == "html":
+                content = render_html(report)
+            else:  # "json" — formats validated in __init__
+                content = json.dumps(report, indent=2, default=str)
+            with open(path, "w") as f:
+                f.write(content)
+            self.destinations.append(path)
+        self.info("report → %s", ", ".join(self.destinations))
