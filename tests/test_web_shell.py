@@ -1,0 +1,118 @@
+"""Web status dashboard + interactive Shell tests (reference:
+``veles/web_status.py`` Tornado UI, ``veles/interaction.py`` Shell)."""
+
+import json
+import urllib.request
+
+import numpy as np
+
+from znicz_tpu.backends import NumpyDevice
+from znicz_tpu.models.samples.wine import build
+from znicz_tpu.utils import prng
+from znicz_tpu.web_status import WebStatusServer, gather_status
+
+
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.read()
+
+
+def test_web_status_serves_json_and_html():
+    prng.seed_all(1)
+    wf = build(max_epochs=2)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+
+    server = WebStatusServer(port=0)
+    try:
+        server.register(wf)
+        blob = json.loads(_get(
+            f"http://127.0.0.1:{server.port}/status.json"))
+        assert blob["uptime_s"] >= 0
+        [status] = blob["workflows"]
+        assert status["name"] == "wine"
+        assert status["epoch"] >= 1
+        assert status["complete"] is True
+        assert status["backend"] == "numpy"
+        assert 0 <= status["min_validation_n_err_pt"] <= 100
+        assert status["slowest_units"]
+
+        page = _get(f"http://127.0.0.1:{server.port}/").decode()
+        assert "wine" in page and "uptime" in page
+
+        # 404 for unknown paths
+        try:
+            _get(f"http://127.0.0.1:{server.port}/nope")
+            assert False, "expected 404"
+        except urllib.error.HTTPError as exc:
+            assert exc.code == 404
+    finally:
+        server.stop()
+
+
+def test_web_status_register_unregister():
+    server = WebStatusServer(port=0)
+    try:
+        wf = build(max_epochs=1)
+        server.register(wf)
+        server.register(wf)  # idempotent
+        assert len(server.status()["workflows"]) == 1
+        server.unregister(wf)
+        assert server.status()["workflows"] == []
+    finally:
+        server.stop()
+
+
+def test_gather_status_mid_training():
+    """Status is readable for an uninitialized workflow too."""
+    wf = build(max_epochs=1)
+    status = gather_status(wf)
+    assert status["name"] == "wine" and not status["initialized"]
+
+
+def test_launcher_starts_web_status():
+    from znicz_tpu.launcher import Launcher
+
+    launcher = Launcher(backend="numpy", web_status=0)
+    launcher._load(build, max_epochs=1)
+    launcher._main()
+    assert launcher.web_server is not None
+    try:
+        blob = json.loads(_get(
+            f"http://127.0.0.1:{launcher.web_server.port}/status.json"))
+        assert blob["workflows"][0]["name"] == "wine"
+    finally:
+        launcher.web_server.stop()
+
+
+def test_shell_unit_fires_with_namespace():
+    prng.seed_all(2)
+    wf = build(max_epochs=1)
+    seen = {}
+
+    def fake_interact(banner, local):
+        seen["banner"] = banner
+        seen["local"] = dict(local)
+
+    shell = wf.link_shell(interact_fn=fake_interact)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    assert "workflow" in seen["local"]
+    assert seen["local"]["workflow"] is wf
+    assert "loader" in seen["local"] and "decision" in seen["local"]
+    assert "wine" in seen["banner"]
+
+
+def test_shell_disable_stops_firing():
+    prng.seed_all(3)
+    wf = build(max_epochs=3)
+    calls = {"n": 0}
+
+    def fake_interact(banner, local):
+        calls["n"] += 1
+        local["shell"].enabled = False  # user opts out from inside
+
+    wf.link_shell(interact_fn=fake_interact)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    assert calls["n"] == 1

@@ -122,6 +122,13 @@ def make_parser() -> argparse.ArgumentParser:
                    help="debug-level logging (region compiles, timings)")
     p.add_argument("--no-graphics", action="store_true",
                    help="disable the plotting render thread")
+    p.add_argument("--web-status", type=int, metavar="PORT",
+                   help="serve the live status dashboard on PORT "
+                        "(0 picks a free port)")
+    p.add_argument("--web-status-host", default="127.0.0.1",
+                   metavar="HOST",
+                   help="dashboard bind address (0.0.0.0 to allow "
+                        "remote browsers)")
     p.add_argument("--optimize", metavar="GENSxPOP",
                    help="genetic hyperparameter search over Tune "
                         "leaves in the config tree, e.g. "
@@ -169,7 +176,9 @@ class Main(Logger):
             listen=args.listen, master=args.master,
             n_processes=args.nodes, process_id=args.process_id,
             retries=args.retries,
-            graphics=False if args.no_graphics else None)
+            graphics=False if args.no_graphics else None,
+            web_status=args.web_status,
+            web_status_host=args.web_status_host)
         self.launcher = launcher  # introspection (tests, embedding)
         if args.dump_graph or args.dry_run:
             # build (and initialize) without training

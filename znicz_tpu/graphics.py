@@ -267,13 +267,14 @@ def get_server() -> GraphicsServer:
         return _server
 
 
-def flush_server() -> None:
+def flush_server() -> bool:
     """Flush the global server's render queue IF one exists (never
-    creates one)."""
+    creates one).  False = flush timed out, renders may be mid-write."""
     with _server_lock:
         server = _server
     if server is not None:
-        server.flush()
+        return server.flush()
+    return True
 
 
 def reset_server() -> None:

@@ -59,6 +59,8 @@ class Launcher(Logger):
                  process_id: int | None = None,
                  retries: int = 0,
                  graphics: bool | None = None,
+                 web_status: int | None = None,
+                 web_status_host: str = "127.0.0.1",
                  load_kwargs: dict | None = None,
                  **kwargs) -> None:
         super().__init__(**kwargs)
@@ -69,6 +71,9 @@ class Launcher(Logger):
         #: the channel by which embedding drivers (e.g. --optimize
         #: trials) parameterize a sample's build without editing it
         self.load_kwargs = dict(load_kwargs or {})
+        self.web_status = web_status  # port (0 = auto) or None = off
+        self.web_status_host = web_status_host  # "0.0.0.0" for remote
+        self.web_server = None
         self.workflow: Workflow | None = None
         self.device: Device | None = None
         self._snapshot_state: dict | None = None
@@ -181,6 +186,13 @@ class Launcher(Logger):
             if self._graphics:
                 from znicz_tpu import graphics
                 graphics.get_server()
+        if self.web_status is not None and self.web_server is None \
+                and self.is_master:
+            from znicz_tpu.web_status import WebStatusServer
+            self.web_server = WebStatusServer(
+                port=self.web_status, host=self.web_status_host)
+        if self.web_server is not None:
+            self.web_server.register(workflow)
         device = self.make_device()
         if not workflow.is_initialized:
             workflow.initialize(device=device, **kwargs)

@@ -345,6 +345,15 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.image_saver = s
         return s
 
+    def link_shell(self, **config):
+        """Interactive console once per epoch (reference: ``Shell``
+        from ``veles/interaction.py``)."""
+        from znicz_tpu.interaction import Shell
+        s = Shell(self, name="shell", **config)
+        self._epoch_side_unit(s)
+        self.shell = s
+        return s
+
     def link_publisher(self, **config):
         """Post-training report generation (reference: ``Publisher``
         from ``veles/publishing/``): fires once, when the decision

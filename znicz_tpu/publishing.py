@@ -22,6 +22,7 @@ import numpy as np
 
 from znicz_tpu.units import Unit
 from znicz_tpu.utils.config import root
+from znicz_tpu.utils.introspect import slowest_units, validation_metrics
 
 
 def _layer_rows(workflow) -> list[dict]:
@@ -42,47 +43,41 @@ def _layer_rows(workflow) -> list[dict]:
     return rows
 
 
+_METRIC_LABELS = {
+    "min_validation_n_err_pt": "best validation error %",
+    "min_validation_mse": "best validation MSE",
+}
+
+
 def _metric_rows(workflow) -> dict:
-    from znicz_tpu.loader.base import VALID
-    d = getattr(workflow, "decision", None)
     out: dict = {}
-    if d is None:
-        return out
     loader = getattr(workflow, "loader", None)
-    has_validation = False
     if loader is not None:
         out["epochs"] = int(loader.epoch_number)
-        has_validation = bool(loader.class_lengths[VALID])
-    if not has_validation:
-        return out  # the decision's validation fields are untouched
-        #             initials for train-only runs — not real metrics
-    for attr, label in (
-            ("min_validation_n_err_pt", "best validation error %"),
-            ("min_validation_mse", "best validation MSE")):
-        value = getattr(d, attr, None)
-        if value is not None:
-            out[label] = float(value)
+    for attr, value in validation_metrics(workflow).items():
+        out[_METRIC_LABELS.get(attr, attr)] = value
     return out
 
 
 def gather_report(workflow) -> dict:
     """Everything a report renders, as plain data (also the json
     side-output — scripts consume it)."""
-    timing = sorted(
-        ({"unit": u.name, "runs": u.run_count,
-          "total_s": round(u.run_time_total, 4)}
-         for u in workflow.units if u.run_count),
-        key=lambda r: r["total_s"], reverse=True)
+    timing = slowest_units(workflow, n=10)
     # plots: only THIS workflow's plotter outputs (the plots dir is
-    # shared across runs), and only after the async render thread has
-    # drawn everything submitted
+    # shared across runs and samples), and only after the async render
+    # thread has drawn everything submitted — an unfinished flush
+    # means a PNG could still be mid-write, so embed nothing then
     from znicz_tpu import graphics
-    graphics.flush_server()
-    plots_dir = str(root.common.dirs.plots)
-    unit_names = {u.name for u in workflow.units}
-    plots = sorted(
-        p for p in glob.glob(os.path.join(plots_dir, "*.png"))
-        if os.path.splitext(os.path.basename(p))[0] in unit_names)
+    flushed = graphics.flush_server()
+    plots: list[str] = []
+    if flushed:
+        plots_dir = str(root.common.dirs.plots)
+        unit_names = {u.name for u in workflow.units}
+        started = getattr(workflow, "run_started_at", 0.0)
+        plots = sorted(
+            p for p in glob.glob(os.path.join(plots_dir, "*.png"))
+            if os.path.splitext(os.path.basename(p))[0] in unit_names
+            and os.path.getmtime(p) >= started - 1.0)
     snap = getattr(workflow, "snapshotter", None)
     return {
         "title": workflow.name,
@@ -90,7 +85,7 @@ def gather_report(workflow) -> dict:
             sep=" ", timespec="seconds"),
         "metrics": _metric_rows(workflow),
         "layers": _layer_rows(workflow),
-        "timing": timing[:10],
+        "timing": timing,
         "plots": plots,
         "snapshot": snap.destination if snap is not None else None,
         "config": root.get(workflow.name).as_dict()
@@ -160,6 +155,11 @@ def render_html(report: dict) -> str:
                 f"<td>{html.escape(str(row['output_shape']))}</td>"
                 f"<td>{row['parameters']:,}</td></tr>")
         md_body.append("</table>")
+    if report["config"]:
+        md_body.append(
+            "<h2>Configuration</h2><pre>"
+            + html.escape(json.dumps(report["config"], indent=2,
+                                     default=str)) + "</pre>")
     if report["timing"]:
         md_body.append("<h2>Slowest units</h2><table border=1 "
                        "cellpadding=4><tr><th>unit</th><th>runs</th>"
@@ -169,6 +169,10 @@ def render_html(report: dict) -> str:
                 f"<tr><td>{html.escape(row['unit'])}</td>"
                 f"<td>{row['runs']}</td><td>{row['total_s']}</td></tr>")
         md_body.append("</table>")
+    if report["snapshot"]:
+        md_body.append(
+            f"<p>Best snapshot: <code>"
+            f"{html.escape(str(report['snapshot']))}</code></p>")
     for p in report["plots"]:
         try:
             with open(p, "rb") as f:

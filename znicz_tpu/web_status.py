@@ -1,0 +1,144 @@
+"""Web status: a live dashboard of running workflows.
+
+Rebuilds the reference's ``veles/web_status.py`` + ``veles/web/``
+(a Tornado UI where the master reported running workflows, slaves and
+progress).  TPU-first deltas: there is no master–slave topology to
+display — the cluster is an SPMD mesh — so the dashboard shows the
+process's registered workflows: epoch/minibatch progress, best
+metrics, device, mesh shape, per-unit timing.  Implementation is
+stdlib ``http.server`` in a daemon thread (no tornado in this
+environment): ``/`` is a self-refreshing HTML page, ``/status.json``
+the machine-readable feed.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from znicz_tpu.utils.logger import Logger
+
+
+def gather_status(workflow) -> dict:
+    """One workflow's live status snapshot (scalars only — safe to
+    read from the serving thread while training runs)."""
+    from znicz_tpu.utils.introspect import (slowest_units,
+                                            validation_metrics)
+    out: dict = {"name": workflow.name,
+                 "initialized": workflow.is_initialized,
+                 "stopped": bool(workflow.stopped)}
+    loader = getattr(workflow, "loader", None)
+    if loader is not None and loader.is_initialized:
+        out["epoch"] = int(loader.epoch_number)
+        out["total_samples"] = int(loader.total_samples)
+        schedule_len = len(loader._schedule)
+        if schedule_len:
+            out["epoch_progress_pt"] = round(
+                100.0 * min(loader._cursor, schedule_len) / schedule_len,
+                1)
+    out.update(validation_metrics(workflow))
+    decision = getattr(workflow, "decision", None)
+    if decision is not None:
+        out["complete"] = bool(getattr(decision, "complete", False))
+    device = getattr(workflow, "device", None)
+    if device is not None:
+        out["backend"] = device.backend
+        mesh = getattr(device, "mesh", None)
+        if mesh is not None:
+            out["mesh"] = {ax: int(n) for ax, n
+                           in zip(mesh.axis_names, mesh.devices.shape)}
+    out["slowest_units"] = slowest_units(workflow, n=5)
+    return out
+
+
+class WebStatusServer(Logger):
+    """Serves ``/`` (HTML) and ``/status.json`` for every registered
+    workflow.  ``port=0`` picks a free port (see :attr:`port`)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1") -> None:
+        super().__init__()
+        self._workflows: list = []
+        self._lock = threading.Lock()
+        self._started = time.time()
+        status_server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route into our logger
+                status_server.debug("http: " + fmt, *args)
+
+            def do_GET(self):
+                if self.path.startswith("/status.json"):
+                    body = json.dumps(status_server.status()).encode()
+                    ctype = "application/json"
+                elif self.path == "/" or self.path.startswith("/index"):
+                    body = status_server.render_html().encode()
+                    ctype = "text/html; charset=utf-8"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="web-status",
+            daemon=True)
+        self._thread.start()
+        self.info("web status @ http://%s:%d/", self.host, self.port)
+
+    # ------------------------------------------------------------------
+    def register(self, workflow) -> None:
+        with self._lock:
+            if workflow not in self._workflows:
+                self._workflows.append(workflow)
+
+    def unregister(self, workflow) -> None:
+        with self._lock:
+            if workflow in self._workflows:
+                self._workflows.remove(workflow)
+
+    def status(self) -> dict:
+        with self._lock:
+            workflows = list(self._workflows)
+        return {
+            "uptime_s": round(time.time() - self._started, 1),
+            "workflows": [gather_status(wf) for wf in workflows],
+        }
+
+    # ------------------------------------------------------------------
+    def render_html(self) -> str:
+        status = self.status()
+        rows = []
+        for wf in status["workflows"]:
+            metrics = {k: v for k, v in wf.items()
+                       if k not in ("name", "slowest_units")}
+            timing = "".join(
+                f"<li>{html.escape(t['unit'])}: {t['total_s']}s / "
+                f"{t['runs']}x</li>" for t in wf.get("slowest_units", []))
+            rows.append(
+                f"<div class='wf'><h2>{html.escape(wf['name'])}</h2>"
+                f"<pre>{html.escape(json.dumps(metrics, indent=2))}"
+                f"</pre><ul>{timing}</ul></div>")
+        body = "\n".join(rows) or "<p>No workflows registered.</p>"
+        return (
+            "<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            "<meta http-equiv='refresh' content='2'>"
+            "<title>znicz_tpu status</title>"
+            "<style>body{font-family:monospace;margin:2em}"
+            ".wf{border:1px solid #999;padding:1em;margin:1em 0}"
+            "</style></head><body><h1>znicz_tpu</h1>"
+            f"<p>uptime {status['uptime_s']}s</p>{body}</body></html>")
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10)

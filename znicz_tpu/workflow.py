@@ -85,6 +85,9 @@ class Workflow(Container):
         """
         if not self.is_initialized:
             raise RuntimeError(f"workflow '{self.name}' not initialized")
+        import time as _time
+        self.run_started_at = _time.time()  # consumers (Publisher)
+        #                       use it to tell this run's artifacts apart
         self._finished = False
         self.stopped.value = False
         queue: deque[Unit] = deque([self.start_point])
