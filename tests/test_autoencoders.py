@@ -1,0 +1,123 @@
+"""Autoencoder sample tests: Mnist784 (FC AE), MnistAE (conv AE with
+tied decoder layers), ImagenetAE topology (reference:
+``znicz/samples/Mnist784``, ``MnistAE``, ``ImagenetAE``)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils import prng
+
+
+def tiny_conv_ae(device, max_epochs=6, tied_weights=False):
+    prng.seed_all(5)
+    rng = np.random.default_rng(3)
+    # low-rank structured signal: surely compressible
+    basis = rng.normal(size=(4, 12, 12, 1)).astype(np.float32)
+    coef = rng.normal(size=(60, 4)).astype(np.float32)
+    x = np.einsum("nk,khwc->nhwc", coef, basis) * 0.2
+    gd = {"learning_rate": 0.005, "gradient_moment": 0.9}
+    wf = StandardWorkflow(
+        name="tiny_conv_ae",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=x[:48], valid_data=x[48:], minibatch_size=12),
+        layers=[
+            {"type": "conv_tanh",
+             "->": {"n_kernels": 6, "kx": 3, "ky": 3,
+                    "sliding": (1, 1)}, "<-": gd},                  # 0
+            {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},      # 1
+            {"type": "depooling", "tied_to": 1},                    # 2
+            {"type": "deconv_tanh", "tied_to": 0, "<-": gd,
+             "tied_weights": tied_weights},                         # 3
+        ],
+        loss="mse",
+        decision_config={"max_epochs": max_epochs})
+    wf._max_fires = 10 ** 6
+    return wf
+
+
+def test_conv_ae_shapes_and_learning_xla():
+    wf = tiny_conv_ae(XLADevice())
+    wf.initialize(device=XLADevice())
+    wf.run()
+    # decoder restores the input geometry
+    assert tuple(wf.forwards[-1].output.shape) == \
+        tuple(wf.loader.minibatch_data.shape)
+    history = wf.decision.epoch_mse_history[1]  # validation per epoch
+    assert len(history) >= 2
+    assert history[-1] < history[0] * 0.9  # reconstruction improves
+
+
+def test_conv_ae_numpy_oracle_agrees():
+    """One epoch numpy vs xla: same initial weights → same mse."""
+    mses = {}
+    for name, device in (("np", NumpyDevice()), ("xla", XLADevice())):
+        wf = tiny_conv_ae(device, max_epochs=1)
+        wf.initialize(device=device)
+        wf.run()
+        mses[name] = wf.decision.epoch_mse_history[1][0]
+    np.testing.assert_allclose(mses["np"], mses["xla"],
+                               rtol=2e-3, atol=1e-5)
+
+
+def test_conv_ae_tied_weights_share_vector():
+    wf = tiny_conv_ae(XLADevice(), max_epochs=1, tied_weights=True)
+    wf.initialize(device=XLADevice())
+    conv_unit, deconv_unit = wf.forwards[0], wf.forwards[3]
+    assert deconv_unit.weights is conv_unit.weights
+    wf.run()  # trains through the shared weights without error
+
+
+def test_mnist784_sample_converges():
+    from znicz_tpu.models.samples.mnist784 import build
+
+    prng.seed_all(6)
+    wf = build(n_train_samples=600, max_epochs=4, bottleneck=32,
+               minibatch_size=50)
+    wf.initialize(device=XLADevice())
+    wf.run()
+    history = wf.decision.epoch_mse_history[1]
+    assert history[-1] < history[0]
+    assert wf.decision.min_validation_mse < history[0]
+
+
+def test_mnist_ae_sample_builds_and_trains():
+    from znicz_tpu.models.samples.mnist_ae import build
+
+    prng.seed_all(7)
+    wf = build(n_train_samples=300, max_epochs=2, minibatch_size=30)
+    wf.initialize(device=XLADevice())
+    wf.run()
+    # topology: conv → pool → depool → deconv restoring 28×28×1
+    assert tuple(wf.forwards[-1].output.shape[1:]) == (28, 28, 1)
+    assert wf.decision.min_validation_mse is not None
+
+
+def test_imagenet_ae_sample_builds():
+    from znicz_tpu.models.samples.imagenet_ae import build
+
+    prng.seed_all(8)
+    wf = build(image_size=40, kx=4, ky=4, sliding=(2, 2), n_kernels=4,
+               n_train_samples=32, n_valid_samples=8,
+               minibatch_size=8, max_epochs=1)
+    wf.initialize(device=XLADevice())
+    wf.run()
+    assert tuple(wf.forwards[-1].output.shape[1:]) == (40, 40, 3)
+
+
+def test_tied_to_rejects_bad_layer_type():
+    with pytest.raises(ValueError, match="tied_to"):
+        tiny = StandardWorkflow(
+            name="bad",
+            loader_factory=lambda w: ArrayLoader(
+                w, train_data=np.zeros((8, 4), dtype=np.float32),
+                minibatch_size=4),
+            layers=[
+                {"type": "all2all", "->": {"output_sample_shape": 4}},
+                {"type": "all2all", "->": {"output_sample_shape": 4},
+                 "tied_to": 0},
+            ],
+            loss="mse")
+        del tiny

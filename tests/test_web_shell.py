@@ -2,6 +2,7 @@
 ``veles/web_status.py`` Tornado UI, ``veles/interaction.py`` Shell)."""
 
 import json
+import urllib.error
 import urllib.request
 
 import numpy as np

@@ -1,0 +1,69 @@
+"""ImagenetAE: convolutional autoencoder pretraining at ImageNet
+geometry (reference: ``znicz/samples/ImagenetAE/`` — the conv-AE
+pretraining workflow for the AlexNet family).
+
+ImageNet itself is not downloadable here; synthetic frames of the
+exact geometry stand in (reconstruction loss is content-agnostic for
+the pipeline's correctness; swap the loader factory for
+``FileImageLoader`` over a real tree — see :mod:`.imagenet`)."""
+
+from __future__ import annotations
+
+from znicz_tpu import datasets
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils.config import register_defaults, root
+
+register_defaults("imagenet_ae", {
+    "minibatch_size": 64,
+    "learning_rate": 0.005,
+    "gradient_moment": 0.9,
+    "image_size": 216,         # divisible through conv 8/4 + pool 2
+    "n_kernels": 16,
+    "kx": 8,
+    "ky": 8,
+    "sliding": (4, 4),
+    "max_epochs": 10,
+    "n_train_samples": 512,
+    "n_valid_samples": 64,
+})
+
+
+def build(**overrides) -> StandardWorkflow:
+    cfg = dict(root.imagenet_ae.as_dict())
+    cfg.update(overrides)
+    wf_kwargs = {k: cfg.pop(k) for k in ("snapshotter_config",
+                                         "lr_adjuster_config",
+                                         "evaluator_config")
+                 if k in cfg}
+    size = cfg["image_size"]
+    n_train, n_valid = cfg["n_train_samples"], cfg["n_valid_samples"]
+    x, _ = datasets.synthetic_imagenet(n_train + n_valid, size=size,
+                                       n_classes=2)
+    gd_cfg = {"learning_rate": cfg["learning_rate"],
+              "gradient_moment": cfg["gradient_moment"]}
+    conv_cfg = {"n_kernels": cfg["n_kernels"], "kx": cfg["kx"],
+                "ky": cfg["ky"], "sliding": tuple(cfg["sliding"])}
+    wf = StandardWorkflow(
+        name="imagenet_ae",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=x[:n_train], valid_data=x[n_train:],
+            minibatch_size=cfg["minibatch_size"],
+            normalization_scale=2.0 / 255.0, normalization_bias=-1.0),
+        layers=[
+            {"type": "conv_tanh", "->": conv_cfg, "<-": gd_cfg},   # 0
+            {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},     # 1
+            {"type": "depooling", "tied_to": 1},                   # 2
+            {"type": "deconv_tanh", "tied_to": 0, "<-": gd_cfg},   # 3
+        ],
+        loss="mse",
+        decision_config={"max_epochs": cfg["max_epochs"]},
+        **wf_kwargs)
+    wf._max_fires = 10 ** 9
+    return wf
+
+
+def run(load, main):
+    """Reference sample entry protocol (``veles <sample> <config>``)."""
+    load(build)
+    main()

@@ -1,0 +1,64 @@
+"""Mnist784: the 784→N→784 fully-connected autoencoder (reference:
+``znicz/samples/Mnist784/`` — MSE reconstruction of the input image
+through a tanh bottleneck; north-star config #4 family)."""
+
+from __future__ import annotations
+
+from znicz_tpu import datasets
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils.config import register_defaults, root
+
+register_defaults("mnist784", {
+    "minibatch_size": 100,
+    "learning_rate": 0.003,
+    "gradient_moment": 0.9,
+    "bottleneck": 64,
+    "max_epochs": 20,
+    "validation_fraction": 0.1,
+})
+
+
+def build(**overrides) -> StandardWorkflow:
+    cfg = dict(root.mnist784.as_dict())
+    cfg.update(overrides)
+    wf_kwargs = {k: cfg.pop(k) for k in ("snapshotter_config",
+                                         "lr_adjuster_config",
+                                         "evaluator_config")
+                 if k in cfg}
+    train_x, _, test_x, _ = datasets.load_mnist()
+    limit = cfg.get("n_train_samples")  # tests/CI: cap the dataset
+    if limit:
+        train_x, test_x = train_x[:int(limit)], test_x[:max(
+            1, int(limit) // 6)]
+    n_valid = int(len(train_x) * cfg["validation_fraction"])
+    gd_cfg = {"learning_rate": cfg["learning_rate"],
+              "gradient_moment": cfg["gradient_moment"]}
+    wf = StandardWorkflow(
+        name="mnist784",
+        loader_factory=lambda w: ArrayLoader(
+            w,
+            train_data=train_x[n_valid:].reshape(-1, 784),
+            valid_data=train_x[:n_valid].reshape(-1, 784),
+            test_data=test_x.reshape(-1, 784),
+            minibatch_size=cfg["minibatch_size"],
+            normalization_scale=1.0 / 255.0),
+        layers=[
+            {"type": "all2all_tanh",
+             "->": {"output_sample_shape": cfg["bottleneck"]},
+             "<-": gd_cfg},
+            # linear output layer: MSE against the normalized input
+            {"type": "all2all", "->": {"output_sample_shape": 784},
+             "<-": gd_cfg},
+        ],
+        loss="mse",
+        decision_config={"max_epochs": cfg["max_epochs"]},
+        **wf_kwargs)
+    wf._max_fires = 100_000_000
+    return wf
+
+
+def run(load, main):
+    """Reference sample entry protocol (``veles <sample> <config>``)."""
+    load(build)
+    main()

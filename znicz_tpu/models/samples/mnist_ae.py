@@ -1,0 +1,68 @@
+"""MnistAE: the convolutional autoencoder (reference:
+``znicz/samples/MnistAE/`` — conv → maxpool encoder, depooling →
+deconv decoder, MSE reconstruction; the sample that exercises
+Deconv/GDDeconv/Depooling; north-star config #4)."""
+
+from __future__ import annotations
+
+from znicz_tpu import datasets
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils.config import register_defaults, root
+
+register_defaults("mnist_ae", {
+    "minibatch_size": 100,
+    "learning_rate": 0.0005,
+    "gradient_moment": 0.9,
+    "n_kernels": 9,
+    "kx": 5,
+    "ky": 5,
+    "sliding": (2, 2),
+    "max_epochs": 15,
+    "validation_fraction": 0.1,
+})
+
+
+def build(**overrides) -> StandardWorkflow:
+    cfg = dict(root.mnist_ae.as_dict())
+    cfg.update(overrides)
+    wf_kwargs = {k: cfg.pop(k) for k in ("snapshotter_config",
+                                         "lr_adjuster_config",
+                                         "evaluator_config")
+                 if k in cfg}
+    train_x, _, test_x, _ = datasets.load_mnist()
+    limit = cfg.get("n_train_samples")  # tests/CI: cap the dataset
+    if limit:
+        train_x, test_x = train_x[:int(limit)], test_x[:max(
+            1, int(limit) // 6)]
+    n_valid = int(len(train_x) * cfg["validation_fraction"])
+    gd_cfg = {"learning_rate": cfg["learning_rate"],
+              "gradient_moment": cfg["gradient_moment"]}
+    conv_cfg = {"n_kernels": cfg["n_kernels"], "kx": cfg["kx"],
+                "ky": cfg["ky"], "sliding": tuple(cfg["sliding"])}
+    wf = StandardWorkflow(
+        name="mnist_ae",
+        loader_factory=lambda w: ArrayLoader(
+            w,
+            train_data=train_x[n_valid:, :, :, None],
+            valid_data=train_x[:n_valid, :, :, None],
+            test_data=test_x[:, :, :, None],
+            minibatch_size=cfg["minibatch_size"],
+            normalization_scale=1.0 / 255.0),
+        layers=[
+            {"type": "conv_tanh", "->": conv_cfg, "<-": gd_cfg},   # 0
+            {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},     # 1
+            {"type": "depooling", "tied_to": 1},                   # 2
+            {"type": "deconv_tanh", "tied_to": 0, "<-": gd_cfg},   # 3
+        ],
+        loss="mse",
+        decision_config={"max_epochs": cfg["max_epochs"]},
+        **wf_kwargs)
+    wf._max_fires = 100_000_000
+    return wf
+
+
+def run(load, main):
+    """Reference sample entry protocol (``veles <sample> <config>``)."""
+    load(build)
+    main()

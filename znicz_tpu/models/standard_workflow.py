@@ -33,7 +33,7 @@ from znicz_tpu.backends import NumpyDevice
 from znicz_tpu.loader.base import TRAIN, Loader
 from znicz_tpu.mutable import Bool
 from znicz_tpu.ops import activation, all2all, conv, cutter, dropout, pooling
-from znicz_tpu.ops import normalization
+from znicz_tpu.ops import deconv, depooling, normalization
 from znicz_tpu.ops import gd, gd_conv, gd_pooling  # noqa: F401 (pairs)
 from znicz_tpu.ops.decision import DecisionGD, DecisionMSE
 from znicz_tpu.ops.lr_adjust import LearningRateAdjust
@@ -84,6 +84,11 @@ for _name, _cls in {
     "activation_sigmoid": activation.ForwardSigmoid,
     "activation_log": activation.ForwardLog,
     "activation_mul": activation.ForwardMul,
+    "deconv": deconv.Deconv,
+    "deconv_tanh": deconv.DeconvTanh,
+    "deconv_relu": deconv.DeconvRELU,
+    "deconv_sigmoid": deconv.DeconvSigmoid,
+    "depooling": depooling.Depooling,
 }.items():
     register_layer_type(_name, _cls)
 
@@ -150,7 +155,33 @@ class StandardWorkflow(AcceleratedWorkflow):
         prev = None
         for spec in self.layers_config:
             cls = layer_type(spec["type"])
-            unit = cls(self, **spec.get("->", {}))
+            cfg = dict(spec.get("->", {}))
+            tied = spec.get("tied_to")  # autoencoder decoder layers
+            #                             reference the encoder layer
+            #                             they invert (MnistAE/
+            #                             ImagenetAE topology)
+            tied_unit = None
+            if tied is not None:
+                tied_unit = self.forwards[tied]
+                if issubclass(cls, deconv.Deconv):
+                    # geometry mirrors the tied conv layer
+                    tied_cfg = self.layers_config[tied].get("->", {})
+                    for key in ("n_kernels", "kx", "ky", "sliding",
+                                "padding"):
+                        if key in tied_cfg:
+                            cfg.setdefault(key, tied_cfg[key])
+            unit = cls(self, **cfg)
+            if tied_unit is not None:
+                if issubclass(cls, deconv.Deconv):
+                    unit.output_shape_source = tied_unit.input
+                    if spec.get("tied_weights"):
+                        unit.link_attrs(tied_unit, "weights")
+                elif issubclass(cls, depooling.Depooling):
+                    unit.pooling_unit = tied_unit
+                else:
+                    raise ValueError(
+                        f"layer type '{spec['type']}' does not "
+                        f"support tied_to")
             if prev is None:
                 unit.link_attrs(self.loader, ("input", "minibatch_data"))
             else:

@@ -138,14 +138,17 @@ class DecisionMSE(DecisionBase):
     """Regression/autoencoder decision driven by
     ``EvaluatorMSE.metrics`` (reference: ``DecisionMSE``)."""
 
-    SNAPSHOT_ATTRS = ("epoch_sse", "epoch_mse", "min_validation_mse",
-                      "min_train_mse", "_epochs_without_improvement")
+    SNAPSHOT_ATTRS = ("epoch_sse", "epoch_mse", "epoch_mse_history",
+                      "min_validation_mse", "min_train_mse",
+                      "_epochs_without_improvement")
 
     def __init__(self, workflow, name: str | None = None, **kwargs) -> None:
         super().__init__(workflow, name=name, **kwargs)
         self.evaluator = None
         self.epoch_sse = [0.0, 0.0, 0.0]
         self.epoch_mse = [np.inf, np.inf, np.inf]
+        #: per-class mse trajectory, one entry per finished epoch
+        self.epoch_mse_history: list[list[float]] = [[], [], []]
         self.min_validation_mse = None
         self.min_train_mse = None
 
@@ -163,6 +166,7 @@ class DecisionMSE(DecisionBase):
             length = loader.class_lengths[cls]
             if length:
                 self.epoch_mse[cls] = self.epoch_sse[cls] / length
+                self.epoch_mse_history[cls].append(self.epoch_mse[cls])
         has_valid = loader.class_lengths[VALID] > 0
         mse = self.epoch_mse[VALID if has_valid else TRAIN]
         best = self.min_validation_mse if has_valid else self.min_train_mse

@@ -69,6 +69,11 @@ def gather_report(workflow) -> dict:
     # means a PNG could still be mid-write, so embed nothing then
     from znicz_tpu import graphics
     flushed = graphics.flush_server()
+    if not flushed:
+        import logging
+        logging.getLogger("znicz_tpu.publishing").warning(
+            "graphics flush timed out — report omits plots rather "
+            "than embed mid-write PNGs")
     plots: list[str] = []
     if flushed:
         plots_dir = str(root.common.dirs.plots)
