@@ -21,6 +21,9 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BATCH = int(os.environ.get("BENCH_BATCH", "128"))
+#: bf16 matmul/conv inputs with f32 params+accumulation — the
+#: MXU-native training mode (override: BENCH_PRECISION=float32)
+PRECISION = os.environ.get("BENCH_PRECISION", "bfloat16")
 WARMUP_STEPS = 6
 TIMED_STEPS = 30
 BASELINE_IMG_PER_SEC_PER_CHIP = 250.0  # 8000 img/s ÷ 32 chips (v4-32)
@@ -29,6 +32,9 @@ BASELINE_IMG_PER_SEC_PER_CHIP = 250.0  # 8000 img/s ÷ 32 chips (v4-32)
 def main() -> None:
     from znicz_tpu.backends import XLADevice
     from znicz_tpu.models.samples import alexnet
+    from znicz_tpu.utils.config import root
+
+    root.common.precision_type = PRECISION
 
     wf = alexnet.build(
         minibatch_size=BATCH,

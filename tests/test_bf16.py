@@ -1,0 +1,63 @@
+"""bfloat16 mixed-precision mode (``root.common.precision_type =
+"bfloat16"``): matmul/conv INPUTS cast to the MXU-native dtype while
+parameters and accumulation stay float32 — the TPU analogue of the
+reference's ``precision_type`` knob (``veles/config.py``)."""
+
+import numpy as np
+
+from znicz_tpu.backends import XLADevice
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils import prng
+from znicz_tpu.utils.config import root
+
+from conftest import make_blobs
+
+
+def _build(minibatch=20):
+    data, labels = make_blobs(40, 3, 24)
+    data = data.reshape(-1, 6, 4)[..., None].repeat(3, -1)  # NHWC
+    gd = {"learning_rate": 0.05, "gradient_moment": 0.9}
+    wf = StandardWorkflow(
+        name="bf16",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:90], train_labels=labels[:90],
+            valid_data=data[90:], valid_labels=labels[90:],
+            minibatch_size=minibatch),
+        layers=[
+            {"type": "conv_tanh", "->": {"n_kernels": 4, "kx": 3,
+                                         "ky": 3}, "<-": gd},
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": gd},
+        ],
+        decision_config={"max_epochs": 12})
+    wf._max_fires = 10 ** 6
+    return wf
+
+
+def test_bf16_trains_to_convergence():
+    root.common.precision_type = "bfloat16"
+    prng.seed_all(9)
+    wf = _build()
+    device = XLADevice()
+    assert device.compute_dtype == np.dtype("bfloat16")
+    wf.initialize(device=device)
+    wf.run()
+    # parameters stay f32; quality target is statistical parity
+    assert wf.forwards[0].weights.devmem.dtype == np.float32
+    assert wf.decision.min_validation_n_err_pt <= 10.0
+
+
+def test_bf16_close_to_f32_one_epoch():
+    """bf16 training lands within mixed-precision noise of f32."""
+    errs = {}
+    for precision in ("float32", "bfloat16"):
+        root.common.precision_type = precision
+        prng.seed_all(9)
+        wf = _build()
+        wf.initialize(device=XLADevice())
+        wf.run()
+        errs[precision] = wf.decision.min_validation_n_err_pt
+    assert abs(errs["bfloat16"] - errs["float32"]) <= 10.0

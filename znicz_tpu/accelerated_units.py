@@ -69,6 +69,29 @@ class AcceleratedUnit(Unit):
         assert self.device is not None
         return self.device.compute_dtype
 
+    @property
+    def mxu_dtype(self):
+        """Matmul/conv INPUT dtype for the XLA path, from
+        ``root.common.precision_type``: ``jnp.bfloat16`` in bf16 mode
+        (native MXU dtype — inputs cast down, accumulation and
+        parameters stay float32: standard TPU mixed precision), else
+        None (full-precision math)."""
+        if self.device is not None \
+                and self.device.compute_dtype == np.dtype("bfloat16"):
+            import jax.numpy as jnp
+            return jnp.bfloat16
+        return None
+
+    def mxu_dot(self, xp, a, b):
+        """``a @ b`` routed through the MXU at the configured input
+        precision (f32 accumulation); numpy path untouched (oracle)."""
+        import jax.numpy as jnp
+        dt = self.mxu_dtype
+        if xp is jnp and dt is not None:
+            return jnp.dot(a.astype(dt), b.astype(dt),
+                           preferred_element_type=jnp.float32)
+        return xp.dot(a, b)
+
     def init_vectors(self, *vectors: Vector) -> None:
         """Attach vectors to the device (reference:
         ``AcceleratedUnit.init_vectors``)."""

@@ -62,7 +62,7 @@ class All2All(Forward):
     # -- math (shared shape logic; xp-generic) --------------------------
     def _forward(self, xp, x, w, b):
         batch = x.shape[0]
-        y = xp.dot(x.reshape(batch, -1), w)
+        y = self.mxu_dot(xp, x.reshape(batch, -1), w)
         if b is not None:
             y = y + b
         y = self.activation.fwd(xp, y)
@@ -127,7 +127,7 @@ class All2AllSoftmax(All2All):
         return e / e.sum(axis=1, keepdims=True)
 
     def _logits(self, xp, x, w, b):
-        y = xp.dot(x.reshape(x.shape[0], -1), w)
+        y = self.mxu_dot(xp, x.reshape(x.shape[0], -1), w)
         return y if b is None else y + b
 
     def numpy_run(self) -> None:

@@ -121,10 +121,19 @@ class Conv(Forward):
     # -- pure forward (jnp; also used by the backward unit's vjp) -------
     def xla_forward(self, x, w, b):
         pt, pb, pl, pr = self.padding
+        dt = self.mxu_dtype
+        if dt is not None:
+            # bf16 conv end-to-end, then cast up: keeping the conv
+            # single-dtype means jax.vjp's transposed conv (gd_conv,
+            # deconv) stays single-dtype too — the cast's own
+            # transpose converts the f32 cotangent down to bf16
+            x, w = x.astype(dt), w.astype(dt)
         y = jax.lax.conv_general_dilated(
             x, w, window_strides=self.sliding,
             padding=((pt, pb), (pl, pr)),
             dimension_numbers=DIMNUMS)
+        if dt is not None:
+            y = y.astype(jnp.float32)
         if b is not None:
             y = y + b
         return self.activation.fwd(jnp, y)

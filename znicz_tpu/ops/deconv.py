@@ -96,16 +96,24 @@ class Deconv(Forward):
     def xla_forward(self, x, w, b):
         pt, pb, pl, pr = self.padding
         out_shape = self.output.shape
+        dt = self.mxu_dtype
+        if dt is not None:  # bf16 inputs, f32 accumulation (MXU native)
+            w = w.astype(dt)
 
         def conv_fn(y):
-            return jax.lax.conv_general_dilated(
+            out = jax.lax.conv_general_dilated(
                 y, w, window_strides=self.sliding,
                 padding=((pt, pb), (pl, pr)),
                 dimension_numbers=DIMNUMS)
+            # single-dtype conv + explicit up-cast: the vjp below then
+            # down-casts the f32 cotangent and transposes a pure-bf16
+            # conv (see Conv.xla_forward)
+            return out.astype(jnp.float32) if dt is not None else out
 
-        y0 = jnp.zeros(out_shape, x.dtype)
+        y0 = jnp.zeros(out_shape, dt if dt is not None else x.dtype)
         _, vjp = jax.vjp(conv_fn, y0)
         (out,) = vjp(x)
+        out = out.astype(jnp.float32)
         if b is not None:
             out = out + b
         return self.activation.fwd(jnp, out)

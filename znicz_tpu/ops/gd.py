@@ -94,8 +94,9 @@ class GradientDescent(GradientDescentBase):
         delta = self._delta(jnp, self.err_output.devmem, self.output.devmem,
                             x2d)
         if self.need_err_input:
-            self.err_input.devmem = (delta @ w.T).reshape(x.shape)
-        grad_w = x2d.T @ delta
+            self.err_input.devmem = self.mxu_dot(
+                jnp, delta, w.T).reshape(x.shape)
+        grad_w = self.mxu_dot(jnp, x2d.T, delta)
         self._apply_weights_xla(grad_w)
         if self.bias is not None and self.bias:
             self._apply_bias_xla(delta.sum(axis=0))

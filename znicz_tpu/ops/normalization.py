@@ -75,6 +75,12 @@ class LRNormalizerForward(Forward):
         self.output.mem[...] = self._forward(np, self.input.mem)
 
     def xla_run(self) -> None:
+        from znicz_tpu.ops import pallas_kernels
+        if pallas_kernels.use_pallas(self.device):
+            self.output.devmem = pallas_kernels.lrn_forward(
+                self.input.devmem, self.alpha, self.beta, self.k,
+                self.n)
+            return
         self.output.devmem = self._forward(jnp, self.input.devmem)
 
 
@@ -118,7 +124,13 @@ class LRNormalizerBackward(GradientDescentBase):
             * _window_sum(np, t, fwd.n, half_low=fwd.n - 1 - fwd.n // 2))
 
     def xla_run(self) -> None:
+        from znicz_tpu.ops import pallas_kernels
         fwd = self.forward_unit
+        if pallas_kernels.use_pallas(self.device):
+            self.err_input.devmem = pallas_kernels.lrn_backward(
+                self.input.devmem, self.err_output.devmem,
+                fwd.alpha, fwd.beta, fwd.k, fwd.n)
+            return
         _, vjp = jax.vjp(lambda xx: fwd._forward(jnp, xx),
                          self.input.devmem)
         (self.err_input.devmem,) = vjp(self.err_output.devmem)

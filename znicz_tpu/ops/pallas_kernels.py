@@ -1,0 +1,114 @@
+"""Pallas TPU kernels for irregular hot ops.
+
+SURVEY.md §2.3 maps the reference's hand-written OpenCL/CUDA kernel
+corpus onto XLA ops, with Pallas reserved for the fused/irregular
+cases.  This module holds those kernels; the first resident is the
+**cross-channel LRN** (AlexNet's normalization layer, reference:
+``znicz/ocl|cuda`` normalization kernels):
+
+- the forward fuses square → sliding channel-window sum → pow →
+  multiply into one VMEM pass over the activations (the jnp
+  composition materializes the padded concat + n shifted adds in HBM);
+- the backward fuses the analytic gradient the same way (one pass,
+  two window sums) instead of re-running the forward under ``jax.vjp``.
+
+Both run on a 1-D grid over row tiles with the channel axis resident
+in lanes; ``interpret=True`` runs them on CPU for the test oracle
+comparison (tests force the cpu platform).
+
+Gating: units call :func:`use_pallas` — True only on real TPU devices
+and when ``root.common.engine.use_pallas`` is not disabled, so every
+other platform keeps the plain-XLA path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+# THE window-sum definition (shared with the numpy oracle and the jnp
+# forward — one source of truth for the window/adjoint convention)
+from znicz_tpu.ops.normalization import _window_sum as _window_sum_xp
+
+#: rows per grid step (sublane-aligned; channels ride the lane axis)
+_TILE_ROWS = 512
+
+
+def use_pallas(device) -> bool:
+    """Pallas path gate: TPU platform + config switch."""
+    from znicz_tpu.utils.config import root
+    jax_device = getattr(device, "jax_device", None)
+    if jax_device is None or jax_device.platform != "tpu":
+        return False
+    return bool(root.common.engine.get("use_pallas", True))
+
+
+# ----------------------------------------------------------------------
+# LRN: d_i = k + α·Σ_{j∈win(i)} x_j² ;  y_i = x_i · d_i^{−β}
+# ----------------------------------------------------------------------
+def _window_sum(arr, n: int, half_low: int):
+    """Sliding sum over the last (lane) axis — the shared xp-generic
+    definition traced with jnp inside the kernel."""
+    return _window_sum_xp(jnp, arr, n, half_low=half_low)
+
+
+def _lrn_fwd_kernel(x_ref, o_ref, *, alpha, beta, k, n):
+    x = x_ref[:]
+    d = k + alpha * _window_sum(x * x, n, n // 2)
+    o_ref[:] = x * d ** (-beta)
+
+
+def _lrn_bwd_kernel(x_ref, err_ref, o_ref, *, alpha, beta, k, n):
+    # dy_i/dx_j = δ_ij·d_i^{−β} − 2αβ·x_i·x_j·d_i^{−β−1}·[j∈win(i)];
+    # err_input_j = err_j·d_j^{−β} − 2αβ·x_j·Σ_{i: j∈win(i)} t_i with
+    # t_i = err_i·x_i·d_i^{−β−1} — the second sum is the window
+    # operator's ADJOINT (half_low mirrored; differs for even n)
+    x = x_ref[:]
+    err = err_ref[:]
+    d = k + alpha * _window_sum(x * x, n, n // 2)
+    t = err * x * d ** (-beta - 1.0)
+    o_ref[:] = (err * d ** (-beta)
+                - 2.0 * alpha * beta * x
+                * _window_sum(t, n, n - 1 - n // 2))
+
+
+def _row_tiled_call(kernel, out_like, *inputs, interpret=False):
+    """Run an elementwise-rows kernel over (M, C) arrays on a 1-D row
+    grid."""
+    m, c = out_like.shape
+    tile = min(_TILE_ROWS, m)
+    spec = pl.BlockSpec((tile, c), lambda i: (i, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(pl.cdiv(m, tile),),
+        in_specs=[spec] * len(inputs),
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((m, c), out_like.dtype),
+        interpret=interpret,
+    )(*inputs)
+
+
+def lrn_forward(x, alpha: float, beta: float, k: float, n: int,
+                interpret: bool = False):
+    """Fused LRN forward over an ND array whose LAST axis is channels."""
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    kernel = functools.partial(_lrn_fwd_kernel, alpha=alpha, beta=beta,
+                               k=k, n=n)
+    return _row_tiled_call(kernel, x2d, x2d,
+                           interpret=interpret).reshape(shape)
+
+
+def lrn_backward(x, err_output, alpha: float, beta: float, k: float,
+                 n: int, interpret: bool = False):
+    """Fused LRN analytic gradient (one pass, two window sums)."""
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    err2d = err_output.reshape(-1, shape[-1])
+    kernel = functools.partial(_lrn_bwd_kernel, alpha=alpha, beta=beta,
+                               k=k, n=n)
+    return _row_tiled_call(kernel, x2d, x2d, err2d,
+                           interpret=interpret).reshape(shape)
