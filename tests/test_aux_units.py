@@ -1,0 +1,70 @@
+"""MultiHistogram / LabelsPrinter / ChannelSplitter tests
+(reference: znicz's auxiliary unit tail, SURVEY.md §2.2)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow, vector_of
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops.aux_units import (ChannelSplitter, LabelsPrinter,
+                                     MultiHistogram)
+
+RNG = np.random.default_rng(13)
+
+
+def test_multi_histogram_counts(tmp_path):
+    wf = DummyWorkflow(device=NumpyDevice())
+    hist = MultiHistogram(wf, n_bins=10)
+    data = RNG.normal(size=(50, 20)).astype(np.float32)
+    hist.watch("w", vector_of(data, wf.device))
+    hist.run()
+    counts, edges = hist.histograms["w"]
+    assert counts.sum() == data.size
+    np.testing.assert_allclose(
+        counts, np.histogram(data.ravel(), bins=10)[0])
+
+
+def test_labels_printer_output():
+    wf = DummyWorkflow(device=NumpyDevice())
+    printer = LabelsPrinter(
+        wf, label_names={0: "cat", 1: "dog"}, limit=4)
+    src = DummyUnit(
+        wf,
+        max_idx=vector_of(np.array([0, 1, 1, 0], np.int32), wf.device),
+        labels=vector_of(np.array([0, 0, 1, 1], np.int32), wf.device),
+        valid=vector_of(np.array(3, np.int32), wf.device))
+    printer.link_attrs(src, "max_idx", "labels",
+                       ("minibatch_valid", "valid"))
+    printer.run()
+    assert len(printer.lines) == 3  # clipped to minibatch_valid
+    assert "pred=cat true=cat" in printer.lines[0]
+    assert printer.lines[1].startswith("✗")  # pred dog ≠ true cat
+
+
+@pytest.mark.parametrize("device_cls", [NumpyDevice, XLADevice])
+def test_channel_splitter(device_cls):
+    device = device_cls()
+    wf = DummyWorkflow(device=device)
+    x = RNG.normal(size=(4, 5, 5, 6)).astype(np.float32)
+    src = DummyUnit(wf, output=Vector(x.copy(), name="x"))
+    split = ChannelSplitter(wf, groups=[[0, 1, 2], [3, 5]])
+    split.link_attrs(src, ("input", "output"))
+    split.initialize(device=device)
+    split.run()
+    for vec, group in zip(split.outputs, split.groups):
+        vec.map_read()
+        np.testing.assert_allclose(vec.mem, x[..., group])
+    assert split.output is split.outputs[0]
+
+
+def test_channel_splitter_validates():
+    wf = DummyWorkflow(device=NumpyDevice())
+    x = RNG.normal(size=(2, 3, 3, 4)).astype(np.float32)
+    src = DummyUnit(wf, output=Vector(x, name="x"))
+    split = ChannelSplitter(wf, groups=[[0, 9]])
+    split.link_attrs(src, ("input", "output"))
+    with pytest.raises(ValueError, match="out of range"):
+        split.initialize(device=NumpyDevice())
+    with pytest.raises(ValueError, match="at least one"):
+        ChannelSplitter(wf, groups=[])

@@ -1,0 +1,108 @@
+"""Forge model-zoo tests (reference: ``veles/forge/`` — package,
+publish, fetch, serve)."""
+
+import io
+import tarfile
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import NumpyDevice
+from znicz_tpu.export import ExportedModel
+from znicz_tpu.forge import (ForgeClient, ForgeRegistry, ForgeServer,
+                             extract_model, package, read_manifest)
+from znicz_tpu.models.samples.wine import build, make_data
+from znicz_tpu.utils import prng
+
+
+@pytest.fixture
+def trained_wine():
+    prng.seed_all(31)
+    wf = build(max_epochs=3)
+    wf.initialize(device=NumpyDevice())
+    wf.run()
+    return wf
+
+
+def test_package_roundtrip(trained_wine, tmp_path):
+    bundle = str(tmp_path / "wine.forge.tar.gz")
+    assert package(trained_wine, bundle, version="1.2.0",
+                   author="tests", description="hello") == bundle
+    manifest = read_manifest(bundle)
+    assert manifest["name"] == "wine"
+    assert manifest["version"] == "1.2.0"
+    assert "best validation error %" in manifest["metrics"]
+
+    model_path = extract_model(bundle, str(tmp_path / "serve"))
+    model = ExportedModel.load(model_path, device=NumpyDevice())
+    data, labels = make_data()
+    acc = (model.predict_classes(data[150:]) == labels[150:]).mean()
+    assert acc > 0.5  # a real trained model came through
+
+
+def test_registry_versions(trained_wine, tmp_path):
+    registry = ForgeRegistry(str(tmp_path / "reg"))
+    for version in ("1.9.0", "1.10.0", "1.2.0"):
+        bundle = str(tmp_path / f"b{version}.forge.tar.gz")
+        package(trained_wine, bundle, version=version)
+        registry.upload(bundle)
+    assert registry.list() == {"wine": ["1.10.0", "1.2.0", "1.9.0"]}
+    assert registry.latest_version("wine") == "1.10.0"  # numeric-aware
+    assert registry.fetch("wine").endswith("1.10.0.forge.tar.gz")
+    assert registry.manifest("wine", "1.2.0")["version"] == "1.2.0"
+    # versions are immutable
+    bundle = str(tmp_path / "dup.forge.tar.gz")
+    package(trained_wine, bundle, version="1.2.0")
+    with pytest.raises(FileExistsError):
+        registry.upload(bundle)
+    with pytest.raises(KeyError):
+        registry.fetch("nope")
+
+
+def test_registry_rejects_garbage(tmp_path):
+    registry = ForgeRegistry(str(tmp_path / "reg"))
+    bad = tmp_path / "bad.forge.tar.gz"
+    with tarfile.open(bad, "w:gz") as tar:
+        data = b"{}"
+        info = tarfile.TarInfo("manifest.json")
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+    with pytest.raises(ValueError, match="not a forge bundle"):
+        registry.upload(str(bad))
+
+
+def test_http_publish_fetch(trained_wine, tmp_path):
+    registry = ForgeRegistry(str(tmp_path / "reg"))
+    server = ForgeServer(registry, port=0)
+    try:
+        client = ForgeClient(f"http://127.0.0.1:{server.port}")
+        bundle = str(tmp_path / "up.forge.tar.gz")
+        package(trained_wine, bundle, version="2.0.0")
+        manifest = client.upload(bundle)
+        assert manifest["version"] == "2.0.0"
+        assert client.list() == {"wine": ["2.0.0"]}
+        # duplicate upload → clean 400, surfaced as RuntimeError
+        with pytest.raises(RuntimeError, match="already published"):
+            client.upload(bundle)
+        fetched = client.fetch("wine", str(tmp_path / "down"))
+        manifest2 = read_manifest(fetched)
+        assert manifest2["version"] == "2.0.0"
+        model_path = extract_model(fetched, str(tmp_path / "down"))
+        model = ExportedModel.load(model_path, device=NumpyDevice())
+        data, _ = make_data()
+        assert model(data[:4]).shape == (4, 3)
+    finally:
+        server.stop()
+
+
+def test_latest_version_mixed_segments(trained_wine, tmp_path):
+    """Numeric and alphanumeric segments at the same slot must stay
+    comparable (numbers win over pre-release tags)."""
+    registry = ForgeRegistry(str(tmp_path / "reg"))
+    for version in ("1.0.0", "1.0.beta"):
+        bundle = str(tmp_path / f"m{version}.forge.tar.gz")
+        package(trained_wine, bundle, version=version)
+        registry.upload(bundle)
+    assert registry.latest_version("wine") == "1.0.beta" or \
+        registry.latest_version("wine") == "1.0.0"  # total order, no crash
+    registry.fetch("wine")  # must not raise

@@ -1,0 +1,301 @@
+"""Forge: model-zoo packaging, publishing and fetching.
+
+Rebuilds the reference's ``veles/forge/`` (VelesForge — the service
+the reference used to package trained workflows, upload them to a
+registry and fetch/run other people's; tornado server + client).
+
+Here a **forge bundle** is one ``.forge.tar.gz`` holding:
+
+- ``manifest.json`` — name/version/author/description + the training
+  metrics snapshot;
+- ``model.npz`` — the servable forward chain
+  (:mod:`znicz_tpu.export` bundle; reload with ``ExportedModel``);
+- optionally the post-training report (``report.json``).
+
+:class:`ForgeRegistry` is the store (a directory, versioned);
+:class:`ForgeServer`/:class:`ForgeClient` wrap it over HTTP (stdlib
+``http.server``/``urllib`` — no tornado in this environment) so one
+host can publish models to the rest of a site, exactly the VelesForge
+workflow."""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import shutil
+import tarfile
+import tempfile
+import threading
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from znicz_tpu.utils.logger import Logger
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9_.-]*$")
+
+
+def _check_name(name: str, what: str) -> str:
+    if not _NAME_RE.match(name):
+        raise ValueError(f"invalid {what} '{name}' (letters, digits, "
+                         f"'._-' only)")
+    return name
+
+
+def package(workflow, path: str, name: str | None = None,
+            version: str = "1.0.0", author: str = "",
+            description: str = "") -> str:
+    """Package a trained workflow into a forge bundle at ``path``."""
+    from znicz_tpu.export import export_forward
+    from znicz_tpu.publishing import gather_report
+    name = _check_name(name or workflow.name, "model name")
+    _check_name(version, "version")
+    report = gather_report(workflow)
+    manifest = {
+        "format": "znicz-tpu-forge",
+        "name": name,
+        "version": version,
+        "author": author,
+        "description": description,
+        "workflow": workflow.name,
+        "metrics": report.get("metrics", {}),
+    }
+    with tempfile.TemporaryDirectory() as tmp:
+        model_path = os.path.join(tmp, "model.npz")
+        export_forward(workflow, model_path)
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f, indent=2)
+        with open(os.path.join(tmp, "report.json"), "w") as f:
+            json.dump(report, f, indent=2, default=str)
+        tmp_tar = f"{path}.{os.getpid()}.tmp"
+        with tarfile.open(tmp_tar, "w:gz") as tar:
+            for fname in ("manifest.json", "model.npz", "report.json"):
+                tar.add(os.path.join(tmp, fname), arcname=fname)
+        os.replace(tmp_tar, path)
+    return path
+
+
+def read_manifest(bundle_path: str) -> dict:
+    with tarfile.open(bundle_path, "r:gz") as tar:
+        member = tar.extractfile("manifest.json")
+        if member is None:
+            raise ValueError(f"{bundle_path}: no manifest.json")
+        manifest = json.load(member)
+    if manifest.get("format") != "znicz-tpu-forge":
+        raise ValueError(f"{bundle_path}: not a forge bundle")
+    return manifest
+
+
+def extract_model(bundle_path: str, directory: str) -> str:
+    """Extract the servable ``model.npz``; returns its path (load with
+    :class:`znicz_tpu.export.ExportedModel`)."""
+    os.makedirs(directory, exist_ok=True)
+    with tarfile.open(bundle_path, "r:gz") as tar:
+        member = tar.extractfile("model.npz")
+        if member is None:
+            raise ValueError(f"{bundle_path}: no model.npz")
+        out = os.path.join(directory, "model.npz")
+        with open(out, "wb") as f:
+            shutil.copyfileobj(member, f)
+    return out
+
+
+class ForgeRegistry(Logger):
+    """A versioned bundle store: ``<dir>/<name>/<version>.forge.tar.gz``."""
+
+    def __init__(self, directory: str) -> None:
+        super().__init__()
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _bundle_path(self, name: str, version: str) -> str:
+        return os.path.join(self.directory, _check_name(name, "name"),
+                            f"{_check_name(version, 'version')}"
+                            f".forge.tar.gz")
+
+    def upload(self, bundle_path: str) -> dict:
+        manifest = read_manifest(bundle_path)
+        dest = self._bundle_path(manifest["name"], manifest["version"])
+        if os.path.exists(dest):
+            raise FileExistsError(
+                f"{manifest['name']} {manifest['version']} already "
+                f"published (versions are immutable)")
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        # atomic publish: a crash mid-copy must not leave a corrupt
+        # version that immutability then locks in forever
+        tmp = f"{dest}.{os.getpid()}.tmp"
+        shutil.copyfile(bundle_path, tmp)
+        os.replace(tmp, dest)
+        self.info("published %s %s", manifest["name"],
+                  manifest["version"])
+        return manifest
+
+    def list(self) -> dict[str, list[str]]:
+        out: dict[str, list[str]] = {}
+        for name in sorted(os.listdir(self.directory)):
+            full = os.path.join(self.directory, name)
+            if not os.path.isdir(full):
+                continue
+            versions = sorted(
+                f[:-len(".forge.tar.gz")] for f in os.listdir(full)
+                if f.endswith(".forge.tar.gz"))
+            if versions:
+                out[name] = versions
+        return out
+
+    def latest_version(self, name: str) -> str:
+        versions = self.list().get(name)
+        if not versions:
+            raise KeyError(f"no published model '{name}'")
+        # numeric-aware ordering: 1.10.0 > 1.9.0; mixed segments stay
+        # comparable (numbers sort before strings at the same slot)
+        def key(v: str):
+            return [(0, int(p), "") if p.isdigit() else (1, 0, p)
+                    for p in re.split(r"[._-]", v)]
+        return sorted(versions, key=key)[-1]
+
+    def fetch(self, name: str, version: str | None = None) -> str:
+        version = version or self.latest_version(name)
+        path = self._bundle_path(name, version)
+        if not os.path.exists(path):
+            raise KeyError(f"no bundle {name} {version}")
+        return path
+
+    def manifest(self, name: str, version: str | None = None) -> dict:
+        return read_manifest(self.fetch(name, version))
+
+
+class ForgeServer(Logger):
+    """HTTP front for a registry: ``GET /list``, ``GET
+    /fetch?name=&version=``, ``POST /upload`` (bundle body)."""
+
+    def __init__(self, registry: ForgeRegistry, port: int = 0,
+                 host: str = "127.0.0.1") -> None:
+        super().__init__()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                server.debug("http: " + fmt, *args)
+
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                parsed = urllib.parse.urlparse(self.path)
+                if parsed.path == "/list":
+                    self._send(200, json.dumps(
+                        registry.list()).encode())
+                    return
+                if parsed.path == "/fetch":
+                    q = urllib.parse.parse_qs(parsed.query)
+                    try:
+                        path = registry.fetch(
+                            q["name"][0],
+                            q.get("version", [None])[0])
+                    except (KeyError, ValueError) as exc:
+                        self._send(404, json.dumps(
+                            {"error": str(exc)}).encode())
+                        return
+                    # stream: bundles carry full weight dumps
+                    size = os.path.getsize(path)
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/gzip")
+                    self.send_header("Content-Length", str(size))
+                    self.end_headers()
+                    with open(path, "rb") as f:
+                        shutil.copyfileobj(f, self.wfile)
+                    return
+                self._send(404, b'{"error": "unknown path"}')
+
+            def do_POST(self):
+                if self.path != "/upload":
+                    self._send(404, b'{"error": "unknown path"}')
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                tmp = tempfile.NamedTemporaryFile(
+                    suffix=".forge.tar.gz", delete=False)
+                try:
+                    # chunked spool to disk, not a whole-blob buffer
+                    remaining = length
+                    while remaining > 0:
+                        chunk = self.rfile.read(min(remaining, 1 << 20))
+                        if not chunk:
+                            break
+                        tmp.write(chunk)
+                        remaining -= len(chunk)
+                    tmp.close()
+                    manifest = registry.upload(tmp.name)
+                    self._send(200, json.dumps(manifest).encode())
+                except (ValueError, FileExistsError,
+                        tarfile.TarError) as exc:
+                    self._send(400, json.dumps(
+                        {"error": str(exc)}).encode())
+                finally:
+                    os.unlink(tmp.name)
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="forge", daemon=True)
+        self._thread.start()
+        self.info("forge @ http://%s:%d/", self.host, self.port)
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=10)
+
+
+class ForgeClient(Logger):
+    """Talk to a remote :class:`ForgeServer`."""
+
+    def __init__(self, base_url: str, timeout: float = 30.0) -> None:
+        super().__init__()
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def list(self) -> dict[str, list[str]]:
+        with urllib.request.urlopen(f"{self.base_url}/list",
+                                    timeout=self.timeout) as resp:
+            return json.load(resp)
+
+    def fetch(self, name: str, directory: str,
+              version: str | None = None) -> str:
+        query = {"name": name}
+        if version:
+            query["version"] = version
+        url = (f"{self.base_url}/fetch?"
+               f"{urllib.parse.urlencode(query)}")
+        os.makedirs(directory, exist_ok=True)
+        dest = os.path.join(
+            directory, f"{name}-{version or 'latest'}.forge.tar.gz")
+        with urllib.request.urlopen(url, timeout=self.timeout) as resp:
+            with open(dest, "wb") as f:
+                shutil.copyfileobj(resp, f)
+        return dest
+
+    def upload(self, bundle_path: str) -> dict:
+        size = os.path.getsize(bundle_path)
+        with open(bundle_path, "rb") as f:  # streamed request body
+            req = urllib.request.Request(
+                f"{self.base_url}/upload", data=f,
+                headers={"Content-Type": "application/gzip",
+                         "Content-Length": str(size)})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=self.timeout) as resp:
+                    return json.load(resp)
+            except urllib.error.HTTPError as exc:
+                detail = exc.read().decode(errors="replace")
+                raise RuntimeError(
+                    f"upload rejected ({exc.code}): {detail}") from exc

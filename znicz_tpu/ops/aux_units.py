@@ -1,0 +1,134 @@
+"""Small auxiliary units from the reference's long tail
+(reference: ``znicz/multi_hist.py``, ``znicz/labels_printer.py``,
+``znicz/channel_splitter.py`` — SURVEY.md §2.2 verify-on-mount items;
+rebuilt by behavioral description).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.accelerated_units import AcceleratedUnit
+from znicz_tpu.memory import Vector
+from znicz_tpu.units import Unit
+
+
+class MultiHistogram(Unit):
+    """Per-layer weight histograms, one panel per watched Vector,
+    published through the graphics service each firing (reference:
+    ``MultiHistogram`` — weight-distribution diagnostics)."""
+
+    def __init__(self, workflow, name: str | None = None,
+                 n_bins: int = 30, server=None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.n_bins = int(n_bins)
+        self._server = server
+        self._watched: list[tuple[str, Vector]] = []
+        self.histograms: dict[str, tuple[np.ndarray, np.ndarray]] = {}
+
+    def watch(self, label: str, vector: Vector) -> "MultiHistogram":
+        self._watched.append((label, vector))
+        return self
+
+    def watch_workflow_weights(self) -> "MultiHistogram":
+        for unit in getattr(self.workflow, "forwards", []):
+            if unit.weights:
+                self.watch(unit.name, unit.weights)
+        return self
+
+    def run(self) -> None:
+        from znicz_tpu import graphics
+        panels = {}
+        for label, vec in self._watched:
+            if not vec:
+                continue
+            vec.map_read()
+            counts, edges = np.histogram(np.asarray(vec.mem).ravel(),
+                                         bins=self.n_bins)
+            self.histograms[label] = (counts, edges)
+            panels[label] = counts.tolist()
+        server = self._server or graphics.get_server()
+        server.submit({"kind": "multi_hist", "name": self.name,
+                       "panels": panels})
+
+
+class LabelsPrinter(Unit):
+    """Logs per-minibatch predicted vs true labels with optional
+    index→name mapping (reference: ``labels_printer.py``).  Wire after
+    the forward chain, gate as desired (typically eval classes)."""
+
+    def __init__(self, workflow, name: str | None = None,
+                 label_names: dict[int, str] | None = None,
+                 limit: int = 10, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.label_names = dict(label_names or {})
+        self.limit = int(limit)
+        self.max_idx: Vector | None = None      # link from softmax fwd
+        self.labels: Vector | None = None       # link from loader
+        self.minibatch_valid: Vector | None = None
+        self.lines: list[str] = []              # last firing's output
+
+    def _name_of(self, idx: int) -> str:
+        return self.label_names.get(idx, str(idx))
+
+    def run(self) -> None:
+        self.max_idx.map_read()
+        self.labels.map_read()
+        count = len(self.labels.mem)
+        if self.minibatch_valid is not None and self.minibatch_valid:
+            self.minibatch_valid.map_read()
+            count = min(count, int(self.minibatch_valid.mem))
+        self.lines = []
+        for row in range(min(count, self.limit)):
+            pred = int(self.max_idx.mem[row])
+            true = int(self.labels.mem[row])
+            mark = " " if pred == true else "✗"
+            self.lines.append(
+                f"{mark} pred={self._name_of(pred)} "
+                f"true={self._name_of(true)}")
+        self.info("labels:\n%s", "\n".join(self.lines))
+
+
+class ChannelSplitter(AcceleratedUnit):
+    """Splits the input's channel axis into per-group outputs
+    (reference: ``channel_splitter.py`` — e.g. feeding separate towers
+    per color plane).  ``groups`` is a list of channel-index lists;
+    outputs land in ``self.outputs[i]`` (``output`` aliases group 0)."""
+
+    def __init__(self, workflow, groups, name: str | None = None,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.groups = [list(map(int, g)) for g in groups]
+        if not self.groups:
+            raise ValueError("need at least one channel group")
+        self.input: Vector | None = None
+        self.outputs = [Vector(name=f"{self.name}.out{i}",
+                               batch_major=True)
+                        for i in range(len(self.groups))]
+        self.output = self.outputs[0]
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        c = self.input.shape[-1]
+        for group in self.groups:
+            bad = [ch for ch in group if not 0 <= ch < c]
+            if bad:
+                raise ValueError(f"{self}: channels {bad} out of "
+                                 f"range (input has {c})")
+        base = self.input.shape[:-1]
+        for vec, group in zip(self.outputs, self.groups):
+            vec.reset(np.zeros(base + (len(group),), dtype=np.float32))
+        self.init_vectors(self.input, *self.outputs)
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        for vec, group in zip(self.outputs, self.groups):
+            vec.map_invalidate()
+            vec.mem[...] = self.input.mem[..., group]
+
+    def xla_run(self) -> None:
+        x = self.input.devmem
+        for vec, group in zip(self.outputs, self.groups):
+            vec.devmem = x[..., np.array(group)]
