@@ -95,14 +95,17 @@ def test_http_publish_fetch(trained_wine, tmp_path):
         server.stop()
 
 
-def test_latest_version_mixed_segments(trained_wine, tmp_path):
-    """Numeric and alphanumeric segments at the same slot must stay
-    comparable (numbers win over pre-release tags)."""
+def test_latest_version_semver_ordering(trained_wine, tmp_path):
+    """Numeric-aware AND release-over-pre-release: 2.0.0 beats
+    2.0.0-rc1; longer numeric versions beat shorter."""
     registry = ForgeRegistry(str(tmp_path / "reg"))
-    for version in ("1.0.0", "1.0.beta"):
+    for version in ("2.0.0-rc1", "2.0.0", "1.10.0", "2.0.0.1"):
         bundle = str(tmp_path / f"m{version}.forge.tar.gz")
         package(trained_wine, bundle, version=version)
         registry.upload(bundle)
-    assert registry.latest_version("wine") == "1.0.beta" or \
-        registry.latest_version("wine") == "1.0.0"  # total order, no crash
+    assert registry.latest_version("wine") == "2.0.0.1"
+    # drop the longest: the release must outrank its rc
+    import os
+    os.unlink(registry.fetch("wine", "2.0.0.1"))
+    assert registry.latest_version("wine") == "2.0.0"
     registry.fetch("wine")  # must not raise

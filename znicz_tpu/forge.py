@@ -77,12 +77,21 @@ def package(workflow, path: str, name: str | None = None,
     return path
 
 
+def _tar_member(tar: tarfile.TarFile, name: str, bundle_path: str):
+    try:  # extractfile raises KeyError for a missing member
+        member = tar.extractfile(name)
+    except KeyError:
+        member = None
+    if member is None:
+        raise ValueError(f"{bundle_path}: no {name} "
+                         f"(not a forge bundle)")
+    return member
+
+
 def read_manifest(bundle_path: str) -> dict:
     with tarfile.open(bundle_path, "r:gz") as tar:
-        member = tar.extractfile("manifest.json")
-        if member is None:
-            raise ValueError(f"{bundle_path}: no manifest.json")
-        manifest = json.load(member)
+        manifest = json.load(
+            _tar_member(tar, "manifest.json", bundle_path))
     if manifest.get("format") != "znicz-tpu-forge":
         raise ValueError(f"{bundle_path}: not a forge bundle")
     return manifest
@@ -93,9 +102,7 @@ def extract_model(bundle_path: str, directory: str) -> str:
     :class:`znicz_tpu.export.ExportedModel`)."""
     os.makedirs(directory, exist_ok=True)
     with tarfile.open(bundle_path, "r:gz") as tar:
-        member = tar.extractfile("model.npz")
-        if member is None:
-            raise ValueError(f"{bundle_path}: no model.npz")
+        member = _tar_member(tar, "model.npz", bundle_path)
         out = os.path.join(directory, "model.npz")
         with open(out, "wb") as f:
             shutil.copyfileobj(member, f)
@@ -118,16 +125,20 @@ class ForgeRegistry(Logger):
     def upload(self, bundle_path: str) -> dict:
         manifest = read_manifest(bundle_path)
         dest = self._bundle_path(manifest["name"], manifest["version"])
-        if os.path.exists(dest):
-            raise FileExistsError(
-                f"{manifest['name']} {manifest['version']} already "
-                f"published (versions are immutable)")
         os.makedirs(os.path.dirname(dest), exist_ok=True)
-        # atomic publish: a crash mid-copy must not leave a corrupt
-        # version that immutability then locks in forever
+        # atomic + exclusive publish: copy to tmp, then hard-link into
+        # place — link fails if dest exists, closing the concurrent-
+        # upload race that a check-then-replace would leave open
         tmp = f"{dest}.{os.getpid()}.tmp"
         shutil.copyfile(bundle_path, tmp)
-        os.replace(tmp, dest)
+        try:
+            os.link(tmp, dest)
+        except FileExistsError:
+            raise FileExistsError(
+                f"{manifest['name']} {manifest['version']} already "
+                f"published (versions are immutable)") from None
+        finally:
+            os.unlink(tmp)
         self.info("published %s %s", manifest["name"],
                   manifest["version"])
         return manifest
@@ -149,11 +160,18 @@ class ForgeRegistry(Logger):
         versions = self.list().get(name)
         if not versions:
             raise KeyError(f"no published model '{name}'")
-        # numeric-aware ordering: 1.10.0 > 1.9.0; mixed segments stay
-        # comparable (numbers sort before strings at the same slot)
+        # semver-flavored ordering: 1.10.0 > 1.9.0 (numeric-aware),
+        # 2.0.0 > 2.0.0-rc1 (a release outranks its pre-release tags),
+        # 2.0.1 > 2.0.0.  Segment ranks: string(0) < absent(1) <
+        # numeric(2); versions are padded to equal length with the
+        # 'absent' sentinel.
+        split = {v: re.split(r"[._-]", v) for v in versions}
+        width = max(len(parts) for parts in split.values())
+
         def key(v: str):
-            return [(0, int(p), "") if p.isdigit() else (1, 0, p)
-                    for p in re.split(r"[._-]", v)]
+            parts = [(2, int(p), "") if p.isdigit() else (0, 0, p)
+                     for p in split[v]]
+            return parts + [(1, 0, "")] * (width - len(parts))
         return sorted(versions, key=key)[-1]
 
     def fetch(self, name: str, version: str | None = None) -> str:

@@ -10,19 +10,19 @@ import numpy as np
 
 from znicz_tpu.accelerated_units import AcceleratedUnit
 from znicz_tpu.memory import Vector
+from znicz_tpu.plotting_units import Plotter
 from znicz_tpu.units import Unit
 
 
-class MultiHistogram(Unit):
+class MultiHistogram(Plotter):
     """Per-layer weight histograms, one panel per watched Vector,
     published through the graphics service each firing (reference:
     ``MultiHistogram`` — weight-distribution diagnostics)."""
 
     def __init__(self, workflow, name: str | None = None,
-                 n_bins: int = 30, server=None, **kwargs) -> None:
+                 n_bins: int = 30, **kwargs) -> None:
         super().__init__(workflow, name=name, **kwargs)
         self.n_bins = int(n_bins)
-        self._server = server
         self._watched: list[tuple[str, Vector]] = []
         self.histograms: dict[str, tuple[np.ndarray, np.ndarray]] = {}
 
@@ -36,8 +36,7 @@ class MultiHistogram(Unit):
                 self.watch(unit.name, unit.weights)
         return self
 
-    def run(self) -> None:
-        from znicz_tpu import graphics
+    def make_payload(self) -> dict | None:
         panels = {}
         for label, vec in self._watched:
             if not vec:
@@ -47,9 +46,8 @@ class MultiHistogram(Unit):
                                          bins=self.n_bins)
             self.histograms[label] = (counts, edges)
             panels[label] = counts.tolist()
-        server = self._server or graphics.get_server()
-        server.submit({"kind": "multi_hist", "name": self.name,
-                       "panels": panels})
+        return {"kind": "multi_hist", "panels": panels} \
+            if panels else None
 
 
 class LabelsPrinter(Unit):

@@ -1,0 +1,221 @@
+"""LSTM recurrent layer, forward + backward.
+
+The reference repo's late-2015 tail may carry an ``lstm.py``
+contribution (SURVEY.md §2.2 verify-on-mount item; the mount is empty,
+so this is built to the standard LSTM formulation).  TPU-first design:
+
+- the time recursion is ``jax.lax.scan`` — ONE compiled loop on
+  device, no Python stepping (SURVEY.md "no data-dependent Python
+  control flow inside jit");
+- weights are a single fused ``(F+H, 4H)`` matrix so each step is one
+  MXU GEMM over the concatenated ``[x_t, h_{t-1}]``, gates split
+  i|f|g|o; forget-gate bias initialized to +1 (standard);
+- the backward unit's XLA path is ``jax.vjp`` of the scan (XLA derives
+  BPTT); the numpy oracle implements explicit BPTT independently — the
+  same oracle-vs-transform pattern as ``gd_conv``.
+
+``return_sequence=False`` (default) emits the last hidden state
+``(B, H)`` — the classification-head shape; ``True`` emits the whole
+``(B, T, H)`` sequence for stacking.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.ops.nn_units import Forward, GradientDescentBase
+
+
+def _sigmoid(xp, x):
+    return 1.0 / (1.0 + xp.exp(-x))
+
+
+class LSTM(Forward):
+    """Single-layer LSTM over ``(batch, time, features)`` input."""
+
+    def __init__(self, workflow, output_sample_shape=None,
+                 units: int | None = None, name=None,
+                 return_sequence: bool = False, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        units = units if units is not None else output_sample_shape
+        if units is None:
+            raise ValueError(f"{self}: units (hidden size) required")
+        self.units = int(units)
+        self.return_sequence = bool(return_sequence)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if len(self.input.shape) != 3:
+            raise ValueError(f"{self}: input must be (batch, time, "
+                             f"features), got {self.input.shape}")
+        batch, steps, features = self.input.shape
+        h = self.units
+        if not self.weights:
+            self.weights.reset(self.fill_array(
+                (features + h, 4 * h), self.weights_filling,
+                self.weights_stddev, fan_in=features + h))
+        if self.include_bias and not self.bias:
+            b = np.zeros(4 * h, dtype=np.float32)
+            b[h:2 * h] = 1.0  # forget-gate bias: remember by default
+            self.bias.reset(b)
+        out_shape = (batch, steps, h) if self.return_sequence \
+            else (batch, h)
+        self.output.reset(np.zeros(out_shape, dtype=np.float32))
+        self.init_vectors(self.input, self.output, self.weights,
+                          self.bias)
+
+    # -- one step (xp-generic) ------------------------------------------
+    def _step(self, xp, x_t, h_prev, c_prev, w, b):
+        z = self.mxu_dot(xp, xp.concatenate([x_t, h_prev], axis=1), w)
+        if b is not None:
+            z = z + b
+        hsz = self.units
+        i = _sigmoid(xp, z[:, 0 * hsz:1 * hsz])
+        f = _sigmoid(xp, z[:, 1 * hsz:2 * hsz])
+        g = xp.tanh(z[:, 2 * hsz:3 * hsz])
+        o = _sigmoid(xp, z[:, 3 * hsz:4 * hsz])
+        c = f * c_prev + i * g
+        h = o * xp.tanh(c)
+        return h, c, (i, f, g, o)
+
+    # -- XLA: one lax.scan over time ------------------------------------
+    def xla_forward(self, x, w, b):
+        batch, steps, _ = x.shape
+        h0 = jnp.zeros((batch, self.units), jnp.float32)
+        c0 = jnp.zeros((batch, self.units), jnp.float32)
+
+        def step(carry, x_t):
+            h_prev, c_prev = carry
+            h, c, _ = self._step(jnp, x_t, h_prev, c_prev, w, b)
+            return (h, c), h
+
+        (h_last, _), hs = jax.lax.scan(
+            step, (h0, c0), jnp.swapaxes(x, 0, 1))
+        if self.return_sequence:
+            return jnp.swapaxes(hs, 0, 1)
+        return h_last
+
+    def xla_run(self) -> None:
+        b = self.bias.devmem if self.include_bias else None
+        self.output.devmem = self.xla_forward(
+            self.input.devmem, self.weights.devmem, b)
+
+    # -- numpy oracle: explicit loop ------------------------------------
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.weights.map_read()
+        b = None
+        if self.include_bias:
+            self.bias.map_read()
+            b = self.bias.mem
+        x = self.input.mem.astype(np.float32)
+        w = self.weights.mem
+        batch, steps, _ = x.shape
+        h = np.zeros((batch, self.units), np.float32)
+        c = np.zeros((batch, self.units), np.float32)
+        hs = np.zeros((batch, steps, self.units), np.float32)
+        for t in range(steps):
+            h, c, _ = self._step(np, x[:, t], h, c, w, b)
+            hs[:, t] = h
+        self.output.map_invalidate()
+        self.output.mem[...] = hs if self.return_sequence else h
+
+
+class GDLSTM(GradientDescentBase):
+    """LSTM backward: explicit BPTT oracle vs ``jax.vjp``-of-scan."""
+
+    MATCHES = (LSTM,)
+
+    def __init__(self, workflow, name=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.forward_unit: LSTM | None = None
+
+    def initialize(self, device=None, **kwargs) -> None:
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if self.need_err_input and not self.err_input:
+            self.err_input.reset(np.zeros(self.input.shape,
+                                          dtype=np.float32))
+        super().initialize(device=device, **kwargs)
+        self.init_vectors(self.err_input, self.err_output, self.input,
+                          self.output, self.weights, self.bias)
+
+    # -- XLA path -------------------------------------------------------
+    def xla_run(self) -> None:
+        fwd = self.forward_unit
+        x = self.input.devmem
+        w = self.weights.devmem
+        has_bias = self.bias is not None and self.bias
+        b = self.bias.devmem if has_bias else None
+        _, vjp = jax.vjp(lambda xx, ww, bb: fwd.xla_forward(xx, ww, bb),
+                         x, w, b)
+        grad_x, grad_w, grad_b = vjp(self.err_output.devmem)
+        if self.need_err_input:
+            self.err_input.devmem = grad_x
+        self._apply_weights_xla(grad_w)
+        if has_bias:
+            self._apply_bias_xla(grad_b)
+
+    # -- numpy oracle: explicit BPTT ------------------------------------
+    def numpy_run(self) -> None:
+        fwd = self.forward_unit
+        for vec in (self.err_output, self.input):
+            vec.map_read()
+        self.weights.map_write()
+        has_bias = self.bias is not None and self.bias
+        b = None
+        if has_bias:
+            self.bias.map_write()
+            b = self.bias.mem
+        x = self.input.mem.astype(np.float32)
+        w = self.weights.mem
+        err = self.err_output.mem
+        batch, steps, features = x.shape
+        hsz = fwd.units
+
+        # forward replay caching per-step state (recompute-in-bwd)
+        h = np.zeros((batch, hsz), np.float32)
+        c = np.zeros((batch, hsz), np.float32)
+        cache = []
+        for t in range(steps):
+            h_prev, c_prev = h, c
+            h, c, (i, f, g, o) = fwd._step(np, x[:, t], h_prev, c_prev,
+                                           w, b)
+            cache.append((h_prev, c_prev, c, i, f, g, o))
+
+        grad_w = np.zeros_like(w)
+        grad_b = np.zeros(4 * hsz, np.float32)
+        grad_x = np.zeros_like(x)
+        dh = np.zeros((batch, hsz), np.float32)
+        dc = np.zeros((batch, hsz), np.float32)
+        for t in reversed(range(steps)):
+            h_prev, c_prev, c_t, i, f, g, o = cache[t]
+            dh_t = dh + (err[:, t] if fwd.return_sequence
+                         else (err if t == steps - 1 else 0.0))
+            tc = np.tanh(c_t)
+            do = dh_t * tc
+            dc_t = dc + dh_t * o * (1.0 - tc * tc)
+            di = dc_t * g
+            df = dc_t * c_prev
+            dg = dc_t * i
+            dz = np.concatenate([
+                di * i * (1.0 - i), df * f * (1.0 - f),
+                dg * (1.0 - g * g), do * o * (1.0 - o)], axis=1)
+            xc = np.concatenate([x[:, t], h_prev], axis=1)
+            grad_w += xc.T @ dz
+            grad_b += dz.sum(axis=0)
+            dxc = dz @ w.T
+            grad_x[:, t] = dxc[:, :features]
+            dh = dxc[:, features:]
+            dc = dc_t * f
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = grad_x
+        self._apply_weights_np(grad_w)
+        if has_bias:
+            self._apply_bias_np(grad_b)
