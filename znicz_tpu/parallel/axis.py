@@ -19,6 +19,9 @@ import jax
 #: breaking DP configs (SURVEY.md §2.5: name axes now, build DP only).
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+#: sequence/context parallelism (ring attention) — distinct from
+#: data/model so DP × SP compose
+SEQ_AXIS = "seq"
 
 _active_data_axis: ContextVar[str | None] = ContextVar(
     "znicz_tpu_data_axis", default=None)

@@ -1,0 +1,137 @@
+"""Ring attention: sequence/context parallelism over the device mesh.
+
+The 2015 reference has no attention (SURVEY.md §5.7), but this
+framework treats long-context machinery as first-class: sequences too
+long for one chip's HBM shard over a mesh axis, and attention runs
+**blockwise around the ICI ring** — each device keeps its Q shard and
+passes K/V shards to its neighbor with ``jax.lax.ppermute``, folding
+every incoming block into an **online-softmax accumulator** (running
+max, normalizer and weighted-value sum), the numerically stable
+streaming form.  Communication overlaps compute block by block and no
+device ever materializes the full (T, T) score matrix.
+
+Layout: ``(batch, time, heads, head_dim)``; time is sharded over
+:data:`SEQ_AXIS`.  :func:`sequence_sharded_attention` is the user
+entry — it ``shard_map``'s :func:`ring_attention_block` over the mesh
+and is validated on the virtual CPU mesh against
+:func:`local_attention` (the single-device oracle).  Causal masking
+uses global positions, so it is exact across shard boundaries.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from znicz_tpu.parallel.axis import SEQ_AXIS
+
+_NEG_INF = -1e30
+
+
+def local_attention(q, k, v, causal: bool = False):
+    """Single-device softmax attention — the oracle.
+
+    Shapes: q (B, Tq, H, D), k/v (B, Tk, H, D) → (B, Tq, H, D).
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = (jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :])
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _fold_block(carry, q, k_blk, v_blk, s_mask):
+    """Online-softmax fold of one K/V block into (m, denom, acc)."""
+    m, denom, acc = carry
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) / np.sqrt(d)
+    s = jnp.where(s_mask, s, _NEG_INF)
+    m_blk = s.max(axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    # guard: rows with no visible keys anywhere yet keep m = -inf
+    p = jnp.exp(s - m_new[..., None])
+    p = jnp.where(s_mask, p, 0.0)
+    correction = jnp.exp(m - m_new)
+    acc = acc * correction[..., None] \
+        + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
+    denom = denom * correction + p.sum(axis=-1)
+    return m_new, denom, acc
+
+
+def ring_attention_block(q, k, v, axis_name: str = SEQ_AXIS,
+                         causal: bool = False):
+    """The per-device body (call under ``shard_map``): q/k/v are THIS
+    device's sequence shards; K/V rotate the full ring."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    b, tq, h, dim = q.shape
+    tk = k.shape[1]
+    q_pos = my_idx * tq + jnp.arange(tq)            # global positions
+
+    def block_mask(src):
+        """Visibility of the K block that originated on device ``src``
+        (exact global causal positions across shard boundaries)."""
+        if not causal:
+            return jnp.ones((1, 1, tq, tk), bool)
+        k_pos = src * tk + jnp.arange(tk)
+        return (q_pos[:, None] >= k_pos[None, :])[None, None]
+
+    # accumulators: derived from q so they carry its sharded/varying
+    # type under shard_map, but cast to f32 — attention statistics
+    # accumulate across the whole ring in f32 even with bf16 q/k/v
+    # (the repo-wide bf16-inputs/f32-accumulation convention)
+    zero4 = (jnp.swapaxes(q, 1, 2) * 0.0).astype(jnp.float32)
+    state = (zero4[..., 0] + _NEG_INF, zero4[..., 0], zero4)
+    # fold the local block first, then rotate-then-fold — the final
+    # iteration folds without a trailing (wasted) ppermute
+    state = _fold_block(state, q, k, v, block_mask(my_idx))
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(i, loop_state):
+        m, denom, acc, k_cur, v_cur = loop_state
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        src = (my_idx - i) % axis_size   # origin of the arriving block
+        m, denom, acc = _fold_block((m, denom, acc), q, k_cur, v_cur,
+                                    block_mask(src))
+        return m, denom, acc, k_cur, v_cur
+
+    m, denom, acc, _, _ = jax.lax.fori_loop(
+        1, axis_size, step, (*state, k, v))
+    denom = jnp.where(denom == 0.0, 1.0, denom)  # fully masked rows
+    out = (acc / denom[..., None]).astype(q.dtype)
+    return jnp.transpose(out, (0, 2, 1, 3))      # → (B, Tq, H, D)
+
+
+def sequence_sharded_attention(mesh, q, k, v, causal: bool = False,
+                               axis_name: str = SEQ_AXIS):
+    """Shard the time axis of q/k/v over ``mesh[axis_name]`` and run
+    ring attention; returns the full (replicated-batch) output with
+    the same sharding as q."""
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(ring_attention_block, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)
+
+
+def make_seq_mesh(n_devices: int | None = None):
+    """A 1-D ``seq`` mesh over the local devices (tests use the
+    virtual 8-CPU mesh)."""
+    from jax.sharding import Mesh
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    return Mesh(np.array(devices[:n]), (SEQ_AXIS,))
