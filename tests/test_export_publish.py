@@ -101,6 +101,43 @@ def test_export_conv_chain(tmp_path):
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
 
 
+def test_export_autoencoder_tied_layers(tmp_path):
+    """Deconv/Depooling decoders keep their encoder ties through the
+    bundle (tie indices serialized in the manifest and rewired by
+    ExportedModel._build_chain)."""
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+
+    prng.seed_all(11)
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(24, 8, 8, 1)).astype(np.float32)
+    wf = StandardWorkflow(
+        name="ae_export",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=x[:16], valid_data=x[16:], minibatch_size=8),
+        layers=[
+            {"type": "conv_tanh",
+             "->": {"n_kernels": 3, "kx": 3, "ky": 3,
+                    "sliding": (2, 2)}},                    # 0
+            {"type": "max_pooling", "->": {"kx": 2, "ky": 2}},  # 1
+            {"type": "depooling", "tied_to": 1},                # 2
+            {"type": "deconv_tanh", "tied_to": 0},              # 3
+        ],
+        loss="mse",
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    path = str(tmp_path / "ae.npz")
+    wf.export_forward(path)
+
+    model = ExportedModel.load(path, device=XLADevice())
+    out = model(x[:4])
+    assert out.shape == (4, 8, 8, 1)
+    np_model = ExportedModel.load(path, device=NumpyDevice())
+    np.testing.assert_allclose(out, np_model(x[:4]), atol=1e-4)
+
+
 def test_publisher_writes_reports(tmp_path):
     wf = train_wine(
         NumpyDevice(),

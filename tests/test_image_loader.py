@@ -8,6 +8,7 @@ import pytest
 from PIL import Image as PILImage
 
 from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyWorkflow
 from znicz_tpu.loader.base import TRAIN
 from znicz_tpu.loader.image import (FileImageLoader, FullBatchImageLoader,
                                     scan_directory)
@@ -125,6 +126,29 @@ def test_scan_directory(image_tree):
     assert len(paths) == 24 and len(labels) == 24
     assert label_map == {"class_0": 0, "class_1": 1, "class_2": 2}
     assert sorted(set(labels)) == [0, 1, 2]
+
+
+def test_flat_train_dir_does_not_claim_label_authority(tmp_path):
+    """A flat (no-subdir) train dir must not freeze an empty label
+    map — a valid dir with class subdirs still builds one."""
+    flat = str(tmp_path / "flat")
+    os.makedirs(flat)
+    PILImage.fromarray(
+        np.full((20, 20, 3), 90, dtype=np.uint8)).save(
+        os.path.join(flat, "a.png"))
+    classed = write_dataset(str(tmp_path / "classed"), n_per_class=2)
+
+    paths, labels, label_map = scan_directory(flat)
+    assert labels == [0] and label_map is None
+    vp, vl, vmap = scan_directory(classed, label_map)
+    assert len(vp) == 6 and sorted(set(vl)) == [0, 1, 2]
+
+    loader = FullBatchImageLoader(
+        DummyWorkflow(), train_dir=flat, valid_dir=classed,
+        out_hw=(16, 16), minibatch_size=4)
+    loader.load_data()
+    assert loader.class_lengths[2] == 1  # TRAIN: the flat file
+    assert loader.class_lengths[1] == 6  # VALID: the classed tree
 
 
 @pytest.mark.parametrize("use_native", [True, False])

@@ -210,9 +210,15 @@ class JitRegion(Logger):
         for vec, leaf in zip(vectors, out):
             vec.devmem = leaf
 
-    def _build(self, skips: tuple[bool, ...]):
+    def build_callable(self, skips: tuple[bool, ...]):
+        """The pure (un-jitted) region function ``leaves -> leaves``,
+        wrapping member ``xla_run``s in the Vector tracing harness.
+        Single home of the tracing invariant — external jittable entry
+        points (``__graft_entry__.entry``) reuse it instead of
+        re-threading ``Vector._tracing`` by hand."""
+        if self._vectors is None:
+            self._vectors = self._collect_vectors()
         vectors = self._vectors
-        assert vectors is not None
         units = self.units
         precision = getattr(self.device, "matmul_precision", "default")
 
@@ -230,7 +236,12 @@ class JitRegion(Logger):
                 for vec in vectors:
                     vec._tracing = False
 
-        return jax.jit(fn, donate_argnums=tuple(range(len(vectors))))
+        return fn
+
+    def _build(self, skips: tuple[bool, ...]):
+        assert self._vectors is not None
+        return jax.jit(self.build_callable(skips),
+                       donate_argnums=tuple(range(len(self._vectors))))
 
 
 class RegionUnit(AcceleratedUnit):

@@ -35,13 +35,20 @@ def _manifest_for(workflow) -> dict:
     StandardWorkflow."""
     layers = []
     for spec, unit in zip(workflow.layers_config, workflow.forwards):
-        layers.append({
+        entry = {
             "type": spec["type"],
             "config": spec.get("->", {}),
             "has_weights": bool(unit.weights),
             "has_bias": bool(unit.bias),
             "name": unit.name,
-        })
+        }
+        if spec.get("tied_to") is not None:
+            # autoencoder decoder layers reference the encoder layer
+            # they invert; serialize the tie so _build_chain can rewire
+            # Deconv.output_shape_source / Depooling.pooling_unit
+            entry["tied_to"] = int(spec["tied_to"])
+            entry["tied_weights"] = bool(spec.get("tied_weights"))
+        layers.append(entry)
     return {
         "format": FORMAT_NAME,
         "version": FORMAT_VERSION,
@@ -113,6 +120,7 @@ class ExportedModel:
     # ------------------------------------------------------------------
     def _build_chain(self) -> None:
         from znicz_tpu.models.standard_workflow import layer_type
+        from znicz_tpu.ops import deconv, depooling
         wf = DummyWorkflow(device=self.device)
         self._input_vec = Vector(name="export.input", batch_major=True)
         source = DummyUnit(wf, output=self._input_vec)
@@ -120,7 +128,30 @@ class ExportedModel:
         prev = source
         for i, layer in enumerate(self.manifest["layers"]):
             cls = layer_type(layer["type"])
-            unit = cls(wf, **layer["config"])
+            cfg = dict(layer["config"])
+            tied = layer.get("tied_to")
+            if tied is not None and issubclass(cls, deconv.Deconv):
+                # geometry mirrors the tied conv layer (same defaulting
+                # as StandardWorkflow.link_forwards)
+                tied_cfg = self.manifest["layers"][tied]["config"]
+                for key in ("n_kernels", "kx", "ky", "sliding",
+                            "padding"):
+                    if key in tied_cfg:
+                        cfg.setdefault(key, tied_cfg[key])
+            unit = cls(wf, **cfg)
+            if tied is not None:
+                if issubclass(cls, deconv.Deconv):
+                    unit.output_shape_source = self.forwards[tied].input
+                    if layer.get("tied_weights"):
+                        # restore encoder/decoder weight sharing, not
+                        # just numerically-equal copies
+                        unit.link_attrs(self.forwards[tied], "weights")
+                elif issubclass(cls, depooling.Depooling):
+                    unit.pooling_unit = self.forwards[tied]
+                else:
+                    raise ValueError(
+                        f"layer {i} type '{layer['type']}' does not "
+                        f"support tied_to")
             unit.link_attrs(prev, ("input", "output"))
             if "forward_mode" in unit.__dict__:
                 unit.forward_mode = "eval"  # dropout = identity

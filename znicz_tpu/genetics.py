@@ -148,9 +148,12 @@ class GeneticsOptimizer(Logger):
         """Default fitness: train a fresh workflow, score validation."""
         from znicz_tpu.backends import Device
         from znicz_tpu.utils import prng
+        from znicz_tpu.utils.config import root
         if self.build_fn is None:
             raise ValueError("no build_fn and no fitness_fn given")
-        prng.seed_all(1234)  # same init/shuffle stream per candidate
+        # same init/shuffle stream per candidate, from the documented
+        # config seed (matches the CLI --optimize path)
+        prng.seed_all(root.common.seed)
         kwargs = apply_genome(genome)
         kwargs.update(self.train_kwargs)
         wf = self.build_fn(**kwargs)

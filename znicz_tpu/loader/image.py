@@ -40,7 +40,9 @@ def scan_directory(directory: str,
                    ) -> tuple[list[str], list[int], dict[str, int]]:
     """Class-per-subdirectory scan (reference: FileImageLoader's
     directory walk).  Returns (paths, labels, label_map); flat
-    directories (no subdirs) get label 0."""
+    directories (no subdirs) get label 0 and do NOT claim label-map
+    authority — a later split with class subdirs may still build the
+    map."""
     subdirs = sorted(
         d for d in os.listdir(directory)
         if os.path.isdir(os.path.join(directory, d)))
@@ -53,7 +55,7 @@ def scan_directory(directory: str,
         for f in files:
             paths.append(os.path.join(directory, f))
             labels.append(0)
-        return paths, labels, (label_map or {})
+        return paths, labels, label_map
     if label_map is None:
         label_map = {d: i for i, d in enumerate(subdirs)}
     for d in subdirs:
@@ -310,12 +312,13 @@ class FileImageLoader(ImageLoader):
     def load_data(self) -> None:
         train_paths, train_labels, label_map = \
             scan_directory(self.train_dir)
-        self.label_map = label_map
         splits: dict[int, tuple[list[str], list[int]]] = {
             TRAIN: (train_paths, train_labels), VALID: ([], []),
             TEST: ([], [])}
         if self.valid_dir is not None:
-            vp, vl, _ = scan_directory(self.valid_dir, label_map)
+            # thread the map through: a flat train dir leaves it None
+            # and the first classed split builds the authority
+            vp, vl, label_map = scan_directory(self.valid_dir, label_map)
             splits[VALID] = (vp, vl)
         elif self.validation_fraction > 0:
             n_valid = int(len(train_paths) * self.validation_fraction)
@@ -327,8 +330,9 @@ class FileImageLoader(ImageLoader):
             splits[TRAIN] = ([train_paths[i] for i in t_idx],
                              [train_labels[i] for i in t_idx])
         if self.test_dir is not None:
-            tp, tl, _ = scan_directory(self.test_dir, label_map)
+            tp, tl, label_map = scan_directory(self.test_dir, label_map)
             splits[TEST] = (tp, tl)
+        self.label_map = label_map or {}
         self.file_paths = []
         self.file_labels = []
         for cls in (TEST, VALID, TRAIN):  # global index order

@@ -64,6 +64,8 @@ class LRNormalizerForward(Forward):
             raise AttributeError(f"{self}: input not linked yet")
         self.output.reset(np.zeros(self.input.shape, dtype=np.float32))
         self.init_vectors(self.input, self.output)
+        from znicz_tpu.ops import pallas_kernels
+        self._use_pallas = pallas_kernels.use_pallas(self.device)
 
     def _forward(self, xp, x):
         d = self.k + self.alpha * _window_sum(xp, x * x, self.n)
@@ -75,8 +77,8 @@ class LRNormalizerForward(Forward):
         self.output.mem[...] = self._forward(np, self.input.mem)
 
     def xla_run(self) -> None:
-        from znicz_tpu.ops import pallas_kernels
-        if pallas_kernels.use_pallas(self.device):
+        if self._use_pallas:  # resolved once at initialize
+            from znicz_tpu.ops import pallas_kernels
             self.output.devmem = pallas_kernels.lrn_forward(
                 self.input.devmem, self.alpha, self.beta, self.k,
                 self.n)
@@ -101,6 +103,8 @@ class LRNormalizerBackward(GradientDescentBase):
         super().initialize(device=device, **kwargs)
         self.init_vectors(self.err_input, self.err_output, self.input,
                           self.output)
+        from znicz_tpu.ops import pallas_kernels
+        self._use_pallas = pallas_kernels.use_pallas(self.device)
 
     def numpy_run(self) -> None:
         """Analytic gradient (the oracle/spec):
@@ -124,9 +128,9 @@ class LRNormalizerBackward(GradientDescentBase):
             * _window_sum(np, t, fwd.n, half_low=fwd.n - 1 - fwd.n // 2))
 
     def xla_run(self) -> None:
-        from znicz_tpu.ops import pallas_kernels
         fwd = self.forward_unit
-        if pallas_kernels.use_pallas(self.device):
+        if self._use_pallas:  # resolved once at initialize
+            from znicz_tpu.ops import pallas_kernels
             self.err_input.devmem = pallas_kernels.lrn_backward(
                 self.input.devmem, self.err_output.devmem,
                 fwd.alpha, fwd.beta, fwd.k, fwd.n)

@@ -38,10 +38,24 @@ _TILE_ROWS = 512
 
 
 def use_pallas(device) -> bool:
-    """Pallas path gate: TPU platform + config switch."""
+    """Pallas path gate: TPU platform + config switch.
+
+    **Compile-time flag**: units resolve this ONCE at ``initialize``
+    and bake the result into their traced program — flipping
+    ``root.common.engine.use_pallas`` after a region compiled has no
+    effect for that workflow's lifetime (re-initialize to re-decide).
+
+    The platform check accepts ``axon`` (this environment's TPU tunnel
+    plugin reports its own platform name, not ``tpu``) and anything
+    whose device_kind names a TPU.
+    """
     from znicz_tpu.utils.config import root
     jax_device = getattr(device, "jax_device", None)
-    if jax_device is None or jax_device.platform != "tpu":
+    if jax_device is None:
+        return False
+    if jax_device.platform not in ("tpu", "axon") \
+            and "tpu" not in getattr(jax_device, "device_kind",
+                                     "").lower():
         return False
     return bool(root.common.engine.get("use_pallas", True))
 
