@@ -1,0 +1,113 @@
+"""Worker process for the multi-process distributed bootstrap test.
+
+Each OS process runs this script with a distinct ``process_id``; the
+Launcher's ``--listen`` / ``--master`` path performs the PJRT
+bootstrap (``jax.distributed.initialize``) — the TPU-first equivalent
+of the reference's in-process master+slave localhost test (reference:
+``veles/tests/test_client_server.py``; SURVEY.md §4 "distributed
+tests").  Every process contributes 2 virtual CPU devices, the
+Launcher builds the GLOBAL 4-device mesh, and the sample's workflow
+trains SPMD over it.  On exit each process writes a JSON digest of the
+trained weights; the parent test asserts both digests are identical —
+the modern form of "master and slave agree on the trained model".
+
+Run directly (the test spawns two of these):
+
+    python tests/dist_worker.py <process_id> <n_processes> \
+        <coordinator host:port> <out.json>
+"""
+
+import json
+import sys
+
+
+def build_workflow():
+    """Tiny blob-classification MLP — same geometry as
+    tests/test_parallel.py so results stay comparable."""
+    import numpy as np
+
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+
+    n_classes, dim, per_class = 3, 12, 40
+    rnd = np.random.RandomState(7)
+    centers = rnd.uniform(-4.0, 4.0, size=(n_classes, dim))
+    data = np.concatenate(
+        [centers[c] + rnd.normal(0.0, 1.0, size=(per_class, dim))
+         for c in range(n_classes)]).astype(np.float32)
+    labels = np.repeat(np.arange(n_classes, dtype=np.int32), per_class)
+    order = rnd.permutation(len(data))
+    data, labels = data[order], labels[order]
+    n_train = 96
+    wf = StandardWorkflow(
+        name="dist_mlp",
+        loader_factory=lambda w: ArrayLoader(
+            w,
+            train_data=data[:n_train], train_labels=labels[:n_train],
+            valid_data=data[n_train:], valid_labels=labels[n_train:],
+            minibatch_size=24),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": n_classes},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+        ],
+        decision_config={"max_epochs": 3})
+    wf._max_fires = 100_000
+    return wf
+
+
+def main() -> None:
+    process_id = int(sys.argv[1])
+    n_processes = int(sys.argv[2])
+    coordinator = sys.argv[3]
+    out_path = sys.argv[4]
+
+    # 2 virtual CPU devices per process, configured BEFORE any jax use
+    # (the container's sitecustomize already imported jax, so go
+    # through jax.config like tests/conftest.py does).
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    from znicz_tpu.launcher import Launcher
+    from znicz_tpu.utils import prng
+
+    if process_id == 0:
+        launcher = Launcher(listen=coordinator, n_processes=n_processes)
+    else:
+        launcher = Launcher(master=coordinator, n_processes=n_processes,
+                            process_id=process_id)
+    assert launcher.mode == ("master" if process_id == 0 else "slave")
+    assert jax.process_count() == n_processes
+    assert len(jax.devices()) == 2 * n_processes
+
+    prng.seed_all(1234)
+
+    def run(load, main):  # reference sample protocol
+        load(build_workflow)
+        main()
+
+    wf = launcher.boot(run)
+
+    wf.forwards[0].weights.map_read()
+    wf.forwards[1].weights.map_read()
+    digest = {
+        "process_id": process_id,
+        "mode": launcher.mode,
+        "n_global_devices": len(jax.devices()),
+        "data_shards": launcher.device.n_data_shards,
+        "w0_sum": float(wf.forwards[0].weights.mem.sum()),
+        "w1_sum": float(wf.forwards[1].weights.mem.sum()),
+        "w0_l2": float((wf.forwards[0].weights.mem ** 2).sum()),
+        "w1_l2": float((wf.forwards[1].weights.mem ** 2).sum()),
+        "min_validation_n_err": int(wf.decision.min_validation_n_err),
+    }
+    with open(out_path, "w") as fh:
+        json.dump(digest, fh)
+    print(f"worker {process_id}: OK {digest}", flush=True)
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, __file__.rsplit("/", 2)[0])
+    main()

@@ -151,6 +151,16 @@ class XLADevice(Device):
         return jax.device_put(arr, sharding)
 
     def get(self, devarr) -> np.ndarray:
+        if isinstance(devarr, jax.Array) and not devarr.is_fully_addressable:
+            # Multi-process SPMD: this process holds only its shards.
+            # Replicated arrays (params, scalars) read locally; sharded
+            # ones all-gather — safe because every process runs the
+            # same program and reaches this read in lockstep.
+            if devarr.sharding.is_fully_replicated:
+                return np.asarray(devarr.addressable_data(0))
+            from jax.experimental import multihost_utils
+            return np.asarray(
+                multihost_utils.process_allgather(devarr, tiled=True))
         return np.asarray(jax.device_get(devarr))
 
     def sync(self) -> None:

@@ -121,7 +121,17 @@ class Launcher(Logger):
     # ------------------------------------------------------------------
     def make_device(self) -> Device:
         if self.device is None:
-            self.device = Device.create(self.backend)
+            if self.coordinator and self.backend != "numpy":
+                # Distributed mode: SPMD over the GLOBAL mesh (all
+                # hosts' devices); XLA lays the gradient all-reduce
+                # over ICI/DCN.  This is the whole point of the
+                # bootstrap — a local-only device would silently train
+                # per-host replicas.
+                from znicz_tpu.backends import XLADevice
+                from znicz_tpu.parallel import make_mesh
+                self.device = XLADevice(mesh=make_mesh())
+            else:
+                self.device = Device.create(self.backend)
         return self.device
 
     # ------------------------------------------------------------------
