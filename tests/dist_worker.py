@@ -22,8 +22,11 @@ import sys
 
 
 def build_workflow():
-    """Tiny blob-classification MLP — same geometry as
-    tests/test_parallel.py so results stay comparable."""
+    """Tiny blob-classification MLP, mirroring the layer/optimizer
+    config of ``tests/test_parallel.build``.  The data generator is
+    duplicated here on purpose: importing ``tests.conftest`` (where
+    ``make_blobs`` lives) would pin 8 virtual devices per process at
+    import time, while this worker needs exactly 2."""
     import numpy as np
 
     from znicz_tpu.loader.fullbatch import ArrayLoader
@@ -90,9 +93,19 @@ def main() -> None:
 
     wf = launcher.boot(run)
 
+    snapshot_keys = -1
+    if process_id == 0:
+        # master-only snapshot: must NOT issue collective reads (the
+        # slaves are not in lockstep here) — regression for the
+        # Vector.needs_collective_read skip in Unit.state_dict
+        state = wf.state_dict()
+        snapshot_keys = sum(len(unit_state)
+                            for unit_state in state["__units__"].values())
+
     wf.forwards[0].weights.map_read()
     wf.forwards[1].weights.map_read()
     digest = {
+        "snapshot_keys": snapshot_keys,
         "process_id": process_id,
         "mode": launcher.mode,
         "n_global_devices": len(jax.devices()),

@@ -77,3 +77,14 @@ def test_two_process_bootstrap_agrees_on_weights(tmp_path):
             f"{key}: master {master[key]} != slave {slave[key]}"
     # and the model actually trained: perfect or near-perfect blobs
     assert master["min_validation_n_err"] <= 4
+    # the master-only snapshot completed without a collective deadlock
+    assert master["snapshot_keys"] > 0
+
+
+def test_numpy_backend_rejected_in_distributed():
+    from znicz_tpu.launcher import Launcher
+
+    launcher = Launcher(backend="numpy")  # standalone construct is fine
+    launcher.coordinator = "127.0.0.1:1"  # simulate distributed mode
+    with pytest.raises(ValueError, match="numpy"):
+        launcher.make_device()

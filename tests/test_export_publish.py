@@ -35,7 +35,7 @@ def test_export_reload_matches_workflow(tmp_path):
     data, _ = make_data()
     x = data[150:]  # the validation rows (wine.build split point)
     probs = model(x)
-    assert probs.shape == (27, 3)
+    assert probs.shape == (28, 3)
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
     # global sample order is test, validation, train — wine has no
     # test split, so validation rows are global indices 0..26

@@ -100,7 +100,7 @@ def test_ensemble_votes_better_or_equal():
     ens.train()
     assert len(ens.workflows) == 3
     result = ens.evaluate(VALID)
-    assert result["n_samples"] == 27  # wine validation split
+    assert result["n_samples"] == 28  # real UCI wine: 178 - 150 train
     assert len(result["member_err_pt"]) == 3
     # the averaged vote should not be (much) worse than the best member
     assert result["ensemble_err_pt"] <= min(result["member_err_pt"]) + 8.0
@@ -115,7 +115,7 @@ def test_class_forward_pass_covers_split():
     wf.initialize(device=NumpyDevice())
     wf.run()
     outputs, labels = class_forward_pass(wf, VALID)
-    assert len(outputs) == 27 and len(labels) == 27
+    assert len(outputs) == 28 and len(labels) == 28
     probs = np.stack(list(outputs.values()))
     np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
 
@@ -130,7 +130,7 @@ def test_ensemble_evaluate_xla_region():
                    train_kwargs={"max_epochs": 2})
     ens.train()
     result = ens.evaluate(VALID)
-    assert result["n_samples"] == 27
+    assert result["n_samples"] == 28
     assert 0.0 <= result["ensemble_err_pt"] <= 100.0
 
 

@@ -121,7 +121,13 @@ class Launcher(Logger):
     # ------------------------------------------------------------------
     def make_device(self) -> Device:
         if self.device is None:
-            if self.coordinator and self.backend != "numpy":
+            if self.coordinator and self.backend == "numpy":
+                raise ValueError(
+                    "distributed mode requires an XLA backend — the "
+                    "host-only numpy oracle cannot join a device mesh "
+                    "(each process would silently train an independent "
+                    "replica)")
+            if self.coordinator:
                 # Distributed mode: SPMD over the GLOBAL mesh (all
                 # hosts' devices); XLA lays the gradient all-reduce
                 # over ICI/DCN.  This is the whole point of the

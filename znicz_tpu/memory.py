@@ -87,6 +87,18 @@ class Vector:
             self._devmem = device.put(self._mem, vector=self)
             self._state = _State.SYNCED
 
+    @property
+    def needs_collective_read(self) -> bool:
+        """True when reading this Vector back to the host requires a
+        cross-process collective (multi-process SPMD, batch-sharded
+        buffer).  Such reads are only safe in lockstep — master-only
+        paths (snapshots) must skip these Vectors or they deadlock."""
+        dev = self._devmem
+        return (self._state == _State.DEVICE
+                and hasattr(dev, "is_fully_addressable")
+                and not dev.is_fully_addressable
+                and not dev.sharding.is_fully_replicated)
+
     # ------------------------------------------------------------------
     # the map/unmap protocol
     # ------------------------------------------------------------------

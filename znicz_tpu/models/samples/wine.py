@@ -1,14 +1,15 @@
 """Wine: the "hello world" MLP — fastest functional smoke
 (reference: ``znicz/samples/Wine/`` — a tiny UCI-wine MLP).
 
-No UCI download here; a 13-feature 3-class synthetic stand-in with the
-same shape.  Config leaves mirror the reference's ``root.wine.*``.
+Trains on the REAL UCI Wine dataset (scikit-learn bundles it, so no
+egress is needed; see ``datasets.load_wine``), matching the data the
+reference's functional test asserted golden error counts on.  Config
+leaves mirror the reference's ``root.wine.*``.
 """
 
 from __future__ import annotations
 
-import numpy as np
-
+from znicz_tpu import datasets
 from znicz_tpu.loader.fullbatch import ArrayLoader
 from znicz_tpu.models.standard_workflow import StandardWorkflow
 from znicz_tpu.utils.config import register_defaults, root
@@ -21,15 +22,8 @@ register_defaults("wine", {
 })
 
 
-def make_data(seed: int = 17):
-    rng = np.random.default_rng(seed)
-    centers = rng.normal(0, 1, (3, 13))
-    data = np.concatenate([
-        c + 0.4 * rng.normal(size=(59, 13)) for c in centers
-    ]).astype(np.float32)
-    labels = np.repeat(np.arange(3), 59).astype(np.int32)
-    order = rng.permutation(len(data))
-    return data[order], labels[order]
+def make_data():
+    return datasets.load_wine()
 
 
 def build(**overrides) -> StandardWorkflow:

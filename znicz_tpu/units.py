@@ -172,6 +172,13 @@ class Unit(Logger):
             if name in self.SNAPSHOT_EXCLUDE:
                 continue
             if isinstance(val, Vector) and val:
+                if val.needs_collective_read:
+                    # Multi-process sharded buffers are per-minibatch
+                    # transients (loader/forward/err chains refill them
+                    # before any consumer on resume); reading one here
+                    # would all-gather — a deadlock from master-only
+                    # snapshot paths.
+                    continue
                 val.map_read()
                 out[name] = _np.array(val.mem, copy=True)
         for name in self.SNAPSHOT_ATTRS:
