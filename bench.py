@@ -20,7 +20,11 @@ instead of silence, and (c) fast-fails when no usable backend exists.
 Knobs (env): BENCH_BATCH, BENCH_PRECISION (bfloat16|float32),
 BENCH_TIMEOUT_S (global watchdog), BENCH_PROFILE=<dir> (capture a
 jax.profiler trace of the timed loop), BENCH_PEAK_TFLOPS (override
-chip peak for MFU).
+chip peak for MFU), BENCH_INPUT=stream (feed through the streaming
+FileImageLoader: real JPEG decode via the native C++ pool with
+double-buffered prefetch, instead of the device-resident store —
+measures the END-TO-END fed-at-rate number; synthetic JPEGs are
+generated once under the cache dir).
 """
 
 from __future__ import annotations
@@ -34,6 +38,15 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 BATCH = int(os.environ.get("BENCH_BATCH", "128"))
+INPUT_MODE = os.environ.get("BENCH_INPUT", "resident")  # resident|stream
+#: steps per device dispatch (lax.scan chunk; device-resident schedule).
+#: 1 = per-step dispatch (round-2 behavior).  Streaming input is
+#: host-fed per step, so stream mode forces 1.
+CHUNK = max(1, int(os.environ.get("BENCH_CHUNK", "16")))
+if INPUT_MODE == "stream":
+    CHUNK = 1
+#: canonical AlexNet geometry; smaller for smoke runs on slow backends
+IMAGE_SIZE = int(os.environ.get("BENCH_IMAGE_SIZE", "227"))
 #: bf16 matmul/conv inputs with f32 params+accumulation — the
 #: MXU-native training mode (override: BENCH_PRECISION=float32)
 PRECISION = os.environ.get("BENCH_PRECISION", "bfloat16")
@@ -137,6 +150,33 @@ def train_step_flops(wf) -> float:
     return 3.0 * flops_fwd
 
 
+def make_jpeg_tree(n_images: int, n_classes: int = 8,
+                   hw: tuple = (256, 256)) -> str:
+    """Synthetic class-per-subdir JPEG tree for the streaming mode,
+    generated once under the cache dir (content doesn't matter for
+    throughput; decode cost does)."""
+    import numpy as np
+    from PIL import Image
+
+    from znicz_tpu.utils.config import root
+
+    base = os.path.join(str(root.common.dirs.cache), "bench_jpegs",
+                        f"{n_images}x{hw[0]}")
+    marker = os.path.join(base, ".complete")
+    if os.path.exists(marker):
+        return base
+    rng = np.random.default_rng(0)
+    for i in range(n_images):
+        cls_dir = os.path.join(base, f"class_{i % n_classes:03d}")
+        os.makedirs(cls_dir, exist_ok=True)
+        Image.fromarray(
+            rng.integers(0, 256, size=hw + (3,), dtype=np.uint8)
+        ).save(os.path.join(cls_dir, f"img_{i:05d}.jpg"), quality=90)
+    with open(marker, "w") as fh:
+        fh.write("ok")
+    return base
+
+
 def main() -> None:
     start_watchdog(TIMEOUT_S)
     devices = init_backend()
@@ -152,20 +192,40 @@ def main() -> None:
 
     root.common.precision_type = PRECISION
 
+    # dataset sized a whole number of chunks per epoch so a scanned
+    # chunk never spans the epoch-boundary reshuffle (ceil to a
+    # CHUNK multiple ≥ 8 steps)
+    steps_per_epoch = max(1, -(-8 // CHUNK)) * CHUNK
+    n_train = steps_per_epoch * BATCH
+    streaming_dir = None
+    if INPUT_MODE == "stream":
+        streaming_dir = make_jpeg_tree(n_train)
     wf = alexnet.build(
+        streaming_dir=streaming_dir,
         minibatch_size=BATCH,
-        n_train_samples=8 * BATCH,
+        image_size=IMAGE_SIZE,
+        n_train_samples=n_train,
         n_valid_samples=0,  # pure train steps for steady-state timing
         max_epochs=10 ** 6)
     wf.initialize(device=XLADevice())
     assert wf._region_unit is not None
-    region = wf._region_unit
+    region_unit = wf._region_unit
+    jit_region = region_unit.region  # the JitRegion (owns run_chunk)
 
     def step():
-        wf.loader.run()
-        region.run()
+        """One dispatch: CHUNK scanned steps (device-resident
+        schedule) or a single region step."""
+        if CHUNK > 1:
+            for _ in range(CHUNK):
+                wf.loader.run()   # host bookkeeping only (no uploads)
+            jit_region.run_chunk(CHUNK)
+        else:
+            wf.loader.run()
+            region_unit.run()
 
-    for _ in range(WARMUP_STEPS):
+    warmup_dispatches = max(1, WARMUP_STEPS // CHUNK)
+    timed_dispatches = max(2, TIMED_STEPS // CHUNK)
+    for _ in range(warmup_dispatches):
         step()
     wf.forwards[-1].weights.devmem.block_until_ready()
 
@@ -175,7 +235,7 @@ def main() -> None:
 
         jax.profiler.start_trace(PROFILE_DIR)
     start = time.perf_counter()
-    for _ in range(TIMED_STEPS):
+    for _ in range(timed_dispatches):
         step()
     wf.forwards[-1].weights.devmem.block_until_ready()
     elapsed = time.perf_counter() - start
@@ -184,7 +244,7 @@ def main() -> None:
 
         jax.profiler.stop_trace()
 
-    step_time = elapsed / TIMED_STEPS
+    step_time = elapsed / (timed_dispatches * CHUNK)
     img_per_sec = BATCH / step_time
     mfu = train_step_flops(wf) / step_time / (peak_tflops(devices[0]) * 1e12)
     emit({
@@ -196,6 +256,8 @@ def main() -> None:
         "step_time_ms": round(step_time * 1e3, 3),
         "batch": BATCH,
         "precision": PRECISION,
+        "input": INPUT_MODE,
+        "chunk": CHUNK,
         "platform": platform,
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "profile": PROFILE_DIR if profiling else None,

@@ -1,0 +1,193 @@
+"""XLA-vs-Pallas micro-benchmarks for the SURVEY §2.3 kernel
+candidates, run on the real TPU chip.
+
+Measures, at AlexNet-realistic shapes:
+
+- LRN forward + backward: fused Pallas kernels
+  (``ops/pallas_kernels.py``) vs the plain jnp composition;
+- dropout mask+apply: TPU-core PRNG Pallas kernel vs
+  ``jax.random.bernoulli`` + multiply;
+- softmax+argmax: fused row kernel vs ``jax.nn.softmax`` + ``argmax``;
+- stochastic pooling (train): the XLA stack-windows+cumsum path is
+  timed for the record; no Pallas variant is proposed — the op is a
+  window-gather with per-window normalization and sampling, which XLA
+  already fuses into one kernel per step; a hand kernel would re-derive
+  the same VMEM pass (see PALLAS_BENCH.md).
+
+Writes PALLAS_BENCH.md (the decision table) and prints one JSON line
+per measurement.  Run: ``python benchmarks/pallas_microbench.py``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from znicz_tpu.ops.normalization import _window_sum  # noqa: E402
+from znicz_tpu.ops import pallas_kernels as pk  # noqa: E402
+
+REPS = 50
+LRN = {"alpha": 1e-4, "beta": 0.75, "k": 2.0, "n": 5}
+
+
+def timeit(fn, *args) -> float:
+    """Median wall time (ms) of a jitted call, post-warmup."""
+    out = fn(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(REPS):
+        start = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append((time.perf_counter() - start) * 1e3)
+    return float(np.median(times))
+
+
+def lrn_fwd_xla(x):
+    d = LRN["k"] + LRN["alpha"] * _window_sum(
+        jnp, x * x, LRN["n"], LRN["n"] // 2)
+    return x * d ** (-LRN["beta"])
+
+
+def lrn_bwd_xla(x, err):
+    d = LRN["k"] + LRN["alpha"] * _window_sum(
+        jnp, x * x, LRN["n"], LRN["n"] // 2)
+    t = err * x * d ** (-LRN["beta"] - 1.0)
+    return (err * d ** (-LRN["beta"])
+            - 2.0 * LRN["alpha"] * LRN["beta"] * x
+            * _window_sum(jnp, t, LRN["n"],
+                          LRN["n"] - 1 - LRN["n"] // 2))
+
+
+def dropout_xla(key, x):
+    keep = 0.5
+    mask = jax.random.bernoulli(key, keep, x.shape).astype(x.dtype) / keep
+    return x * mask
+
+
+def softmax_argmax_xla(v):
+    return jax.nn.softmax(v, axis=1), jnp.argmax(v, axis=1)
+
+
+
+
+def main() -> None:
+    devices = jax.devices()
+    device_kind = getattr(devices[0], "device_kind", devices[0].platform)
+    print(f"# device: {device_kind}", flush=True)
+    rng = np.random.default_rng(0)
+    rows = []
+
+    def record(name, xla_ms, pallas_ms, note=""):
+        winner = "pallas" if (pallas_ms is not None
+                              and pallas_ms < xla_ms) else "xla"
+        rows.append((name, xla_ms, pallas_ms, winner, note))
+        print(json.dumps({
+            "op": name, "xla_ms": xla_ms, "pallas_ms": pallas_ms,
+            "winner": winner, "note": note}), flush=True)
+
+    # -- LRN (128, 55, 55, 96) -----------------------------------------
+    x = jnp.asarray(rng.normal(size=(128, 55, 55, 96)).astype(np.float32))
+    err = jnp.asarray(rng.normal(size=x.shape).astype(np.float32))
+    record("lrn_fwd",
+           timeit(jax.jit(lrn_fwd_xla), x),
+           timeit(jax.jit(functools.partial(pk.lrn_forward, **LRN)), x))
+    record("lrn_bwd",
+           timeit(jax.jit(lrn_bwd_xla), x, err),
+           timeit(jax.jit(functools.partial(pk.lrn_backward, **LRN)),
+                  x, err))
+
+    # -- dropout (128, 4096) -------------------------------------------
+    xd = jnp.asarray(rng.normal(size=(128, 4096)).astype(np.float32))
+    key = jax.random.key(0)
+    seed = jnp.asarray(1234, jnp.int32)
+    # sanity: keep fraction ≈ 0.5 on real hardware
+    kept = float((np.asarray(pk.dropout_apply(xd, seed, 0.5)) != 0).mean())
+    assert 0.45 < kept < 0.55, f"pallas dropout keep fraction {kept}"
+    record("dropout_mask_apply",
+           timeit(jax.jit(dropout_xla), key, xd),
+           timeit(jax.jit(functools.partial(
+               pk.dropout_apply, drop_ratio=0.5)), xd, seed),
+           note=f"pallas keep fraction {kept:.3f}")
+
+    # -- softmax+argmax (128, 1000) ------------------------------------
+    v = jnp.asarray(rng.normal(size=(128, 1000)).astype(np.float32))
+    probs_p, idx_p = pk.softmax_argmax(v)
+    probs_x, idx_x = softmax_argmax_xla(v)
+    np.testing.assert_allclose(np.asarray(probs_p), np.asarray(probs_x),
+                               rtol=1e-5, atol=1e-6)
+    assert (np.asarray(idx_p) == np.asarray(idx_x)).all()
+    record("softmax_argmax",
+           timeit(jax.jit(softmax_argmax_xla), v),
+           timeit(jax.jit(pk.softmax_argmax), v))
+
+    # -- stochastic pooling (train), XLA path for the record -----------
+    from znicz_tpu.ops.pooling import StochasticPooling
+    from znicz_tpu.dummy import DummyWorkflow
+
+    unit = StochasticPooling(DummyWorkflow(), kx=3, ky=3, sliding=(2, 2))
+
+    def stoch_pool(key, xin):
+        wins = unit.stack_windows(xin)
+        valid = jnp.isfinite(wins)
+        wins0 = jnp.where(valid, wins, 0.0)
+        pos = jnp.maximum(wins0, 0.0) * valid
+        total = pos.sum(axis=3, keepdims=True)
+        kcnt = valid.sum(axis=3, keepdims=True).astype(xin.dtype)
+        uniform = valid.astype(xin.dtype) / jnp.maximum(kcnt, 1.0)
+        probs = jnp.where(total > 0,
+                          pos / jnp.where(total > 0, total, 1.0), uniform)
+        n, oh, ow = xin.shape[0], *unit.output_spatial(*xin.shape[1:3])
+        r = jax.random.uniform(key, (n, oh, ow, 1, xin.shape[3]),
+                               dtype=xin.dtype)
+        idx = (r > jnp.cumsum(probs, axis=3)).sum(axis=3)
+        return jnp.take_along_axis(
+            wins0, idx[:, :, :, None, :], axis=3)[:, :, :, 0, :]
+
+    record("stochastic_pool_train",
+           timeit(jax.jit(stoch_pool), key, x), None,
+           note="no pallas variant: gather+normalize+sample already "
+                "fuses to one XLA kernel; a hand kernel would re-derive "
+                "the same VMEM pass")
+
+    # -- write the table -----------------------------------------------
+    lines = [
+        "# Pallas vs XLA micro-benchmarks",
+        "",
+        f"Device: **{device_kind}** · median of {REPS} reps, jitted, "
+        "blocked · AlexNet-realistic shapes "
+        "(LRN/pool (128,55,55,96); dropout (128,4096); "
+        "softmax (128,1000))",
+        "",
+        "| op | XLA ms | Pallas ms | winner | note |",
+        "|---|---|---|---|---|",
+    ]
+    for name, xla_ms, pallas_ms, winner, note in rows:
+        pallas_s = "—" if pallas_ms is None else f"{pallas_ms:.3f}"
+        lines.append(f"| {name} | {xla_ms:.3f} | {pallas_s} "
+                     f"| {winner} | {note} |")
+    lines += [
+        "",
+        "Decision rule: units keep the Pallas path (via "
+        "`pallas_kernels.use_pallas`) only for ops where the kernel "
+        "wins above; everything else stays plain XLA.",
+        "",
+    ]
+    with open(os.path.join(REPO, "PALLAS_BENCH.md"), "w") as fh:
+        fh.write("\n".join(lines))
+    print(f"wrote PALLAS_BENCH.md ({len(rows)} rows)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""Debug-mode checkify: NaN/inf/OOB faults inside a jit region raise
+a located error (SURVEY.md §5.2 — the rebuild's equivalent of a debug
+sanitizer for in-program faults; the Vector state machine covers the
+host side)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from znicz_tpu.accelerated_units import AcceleratedUnit, JitRegion
+from znicz_tpu.backends import XLADevice
+from znicz_tpu.dummy import DummyWorkflow
+from znicz_tpu.memory import Vector
+from znicz_tpu.utils.config import root
+
+
+class LogUnit(AcceleratedUnit):
+    """log(input) — NaN for negative inputs."""
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.input = Vector(name="log.in")
+        self.output = Vector(name="log.out")
+
+    def initialize(self, device=None, **kwargs):
+        super().initialize(device=device, **kwargs)
+        self.output.reset(np.zeros_like(self.input.mem))
+        self.init_vectors(self.input, self.output)
+
+    def xla_run(self):
+        self.output.devmem = jnp.log(self.input.devmem)
+
+
+def _make_region(values):
+    wf = DummyWorkflow()
+    device = XLADevice()
+    wf.device = device
+    unit = LogUnit(wf)
+    unit.input.reset(np.asarray(values, dtype=np.float32))
+    unit.initialize(device=device)
+    unit.link_from(wf.start_point)
+    return unit, JitRegion("dbg", [unit], device)
+
+
+def test_nan_raises_located_error():
+    root.common.engine.debug_checks = True
+    unit, region = _make_region([1.0, -1.0])
+    with pytest.raises(Exception, match="nan"):
+        region.run()
+
+
+def test_clean_run_passes_with_checks_on():
+    root.common.engine.debug_checks = True
+    unit, region = _make_region([1.0, 2.0])
+    region.run()
+    unit.output.map_read()
+    np.testing.assert_allclose(unit.output.mem,
+                               np.log([1.0, 2.0]), rtol=1e-6)
+
+
+def test_checks_off_is_silent_default():
+    assert root.common.engine.get("debug_checks", False) is False
+    unit, region = _make_region([1.0, -1.0])
+    region.run()  # no error machinery; NaN flows through
+    unit.output.map_read()
+    assert np.isnan(unit.output.mem[1])

@@ -1,0 +1,76 @@
+"""Filter-similarity diagnostics (reference: ``znicz/diversity.py``)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.ops.diversity import (
+    FilterDiversityReporter,
+    diversity_score,
+    filter_similarity,
+    similar_kernel_groups,
+)
+
+
+def _weights_with_duplicates(seed=0):
+    """FC-style (fan_in, n_filters) weights: filters 0≈3 (copy+noise),
+    1≈4 (negated copy), 2 and 5 independent."""
+    rng = np.random.default_rng(seed)
+    base = rng.normal(size=(3, 20))
+    cols = [base[0], base[1], base[2],
+            base[0] + 0.01 * rng.normal(size=20),
+            -base[1] + 0.01 * rng.normal(size=20),
+            rng.normal(size=20)]
+    return np.stack(cols, axis=1).astype(np.float32)  # (20, 6)
+
+
+def test_similarity_matrix_properties():
+    w = _weights_with_duplicates()
+    sim = filter_similarity(w)
+    assert sim.shape == (6, 6)
+    np.testing.assert_allclose(np.diag(sim), 1.0, atol=1e-5)
+    np.testing.assert_allclose(sim, sim.T, atol=1e-6)
+    assert sim[0, 3] > 0.99       # near-copies correlate
+    assert sim[1, 4] < -0.99      # negated copy anti-correlates
+    assert abs(sim[2, 5]) < 0.7   # independent filters don't
+
+
+def test_jnp_path_matches_numpy():
+    w = _weights_with_duplicates()
+    from znicz_tpu.ops.diversity import _as_filter_rows
+
+    rows = _as_filter_rows(w)
+    sim_np = filter_similarity(w)
+    sim_jnp = np.asarray(filter_similarity(jnp.asarray(rows), xp=jnp))
+    np.testing.assert_allclose(sim_np, sim_jnp, atol=1e-5)
+
+
+def test_groups_and_score():
+    w = _weights_with_duplicates()
+    groups = similar_kernel_groups(w, threshold=0.9)
+    assert sorted(map(sorted, groups)) == [[0, 3], [1, 4]]
+    # 4 of 6 filters are redundant → diversity 1 - 4/6
+    assert abs(diversity_score(w, threshold=0.9) - (1 - 4 / 6)) < 1e-9
+
+
+def test_conv_layout_hwio():
+    """HWIO conv weights: last axis indexes kernels."""
+    rng = np.random.default_rng(1)
+    base = rng.normal(size=(3, 3, 4)).astype(np.float32)
+    w = np.stack([base, base.copy(), rng.normal(size=(3, 3, 4))],
+                 axis=-1).astype(np.float32)   # (3,3,4,3): k0 == k1
+    groups = similar_kernel_groups(w, threshold=0.95)
+    assert groups == [[0, 1]]
+
+
+def test_reporter_unit():
+    from znicz_tpu.dummy import DummyWorkflow
+    from znicz_tpu.memory import Vector
+
+    rep = FilterDiversityReporter(DummyWorkflow(), threshold=0.9)
+    vec = Vector(name="layer0.weights")
+    vec.reset(_weights_with_duplicates())
+    rep.weights_list = [vec]
+    rep.run()
+    score, n_groups = rep.last_report["layer0.weights"]
+    assert n_groups == 2 and abs(score - 1 / 3) < 1e-9

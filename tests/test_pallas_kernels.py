@@ -85,3 +85,21 @@ def test_use_pallas_gate():
     assert pallas_kernels.use_pallas(FakeTPU())
     root.common.engine.use_pallas = False
     assert not pallas_kernels.use_pallas(FakeTPU())
+
+
+def test_softmax_argmax_matches_xla():
+    """Fused softmax+argmax kernel (interpret mode) vs the XLA
+    composition."""
+    import jax
+    import jax.numpy as jnp
+
+    from znicz_tpu.ops.pallas_kernels import softmax_argmax
+
+    rng = np.random.default_rng(5)
+    v = jnp.asarray(rng.normal(size=(96, 13)).astype(np.float32))
+    probs, idx = softmax_argmax(v, interpret=True)
+    np.testing.assert_allclose(np.asarray(probs),
+                               np.asarray(jax.nn.softmax(v, axis=1)),
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(idx),
+                                  np.asarray(jnp.argmax(v, axis=1)))

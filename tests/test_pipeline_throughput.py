@@ -1,0 +1,68 @@
+"""Input-pipeline throughput: the question ``native/pipeline.cpp``
+exists to answer (SURVEY.md §7 "feed AlexNet at 8k img/s") gets a
+measured, asserted number.
+
+Context for the bound: this container exposes a SINGLE host core
+(``nproc`` = 1); measured decode+resize+augment throughput here is
+~1000 img/s (≈1 ms/image for a 256×256 JPEG → 227×227 crop).  The
+north-star host (TPU v4 host with ~120 cores) scales the pool
+linearly, so per-core throughput is the portable metric: the floor
+asserts ≥400 img/s/core — half the measured rate, leaving headroom
+for CI noise — which at ImageNet-host core counts clears the 8k img/s
+target with an order of magnitude to spare.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from znicz_tpu.native import ImagePipeline
+
+pytestmark = pytest.mark.skipif(
+    not ImagePipeline.available(),
+    reason=f"native pipeline unavailable: {ImagePipeline.build_error()}")
+
+
+def _make_jpegs(base, n_files=64, hw=(256, 256)) -> list[str]:
+    from PIL import Image
+
+    rng = np.random.default_rng(0)
+    paths = []
+    os.makedirs(base, exist_ok=True)
+    for i in range(n_files):
+        path = os.path.join(base, f"img_{i}.jpg")
+        Image.fromarray(rng.integers(0, 256, size=hw + (3,),
+                                     dtype=np.uint8)).save(path, quality=90)
+        paths.append(path)
+    return paths
+
+
+@pytest.mark.slow
+def test_decode_throughput_per_core(tmp_path):
+    paths = _make_jpegs(str(tmp_path))
+    pipe = ImagePipeline(n_threads=0)  # auto: one per core
+    batch, reps = 64, 8
+    out = np.zeros((batch,) + (227, 227, 3), np.float32)
+    sel = [paths[i % len(paths)] for i in range(batch)]
+
+    def run_once(seed):
+        pipe.submit(sel, out, out_hw=(227, 227), resize_hw=(256, 256),
+                    random_crop=True, random_flip=True,
+                    scale=1 / 127.5, bias=-1.0, seed=seed)
+        assert pipe.wait() == 0
+
+    run_once(0)  # warm (first-use lib pings, page faults)
+    start = time.perf_counter()
+    for rep in range(reps):
+        run_once(rep + 1)
+    elapsed = time.perf_counter() - start
+
+    n_cores = os.cpu_count() or 1
+    img_per_sec = batch * reps / elapsed
+    per_core = img_per_sec / n_cores
+    print(f"\ndecode throughput: {img_per_sec:.0f} img/s total, "
+          f"{per_core:.0f} img/s/core ({n_cores} cores)")
+    assert per_core >= 400.0, \
+        f"decode pool too slow: {per_core:.0f} img/s/core"

@@ -1,0 +1,56 @@
+"""precision_level plumbing (reference: ``root.common.precision``
+levels gated result-checking strictness; here they map to XLA matmul
+precision — SURVEY.md §2.1 dtype mapping row)."""
+
+import numpy as np
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.utils import prng
+from znicz_tpu.utils.config import root
+
+from tests.test_mlp_training import build
+
+
+def test_level_to_matmul_precision_mapping():
+    for level, want in ((0, "default"), (1, "float32"), (2, "highest")):
+        root.common.precision_level = level
+        assert XLADevice().matmul_precision == want
+    root.common.precision_level = 99   # unknown → safe default
+    assert XLADevice().matmul_precision == "default"
+
+
+def test_level2_training_matches_oracle():
+    """level 2 ('highest': full f32 MXU passes) must track the numpy
+    oracle at least as tightly as the default level-0 run — the
+    whole-path numerics test VERDICT.md asked for."""
+    results = {}
+    for tag, device_fn, level in (
+            ("oracle", NumpyDevice, 0),
+            ("xla_l2", XLADevice, 2)):
+        root.common.precision_level = level
+        prng.seed_all(1234)
+        wf = build(max_epochs=1)
+        wf.initialize(device=device_fn())
+        wf.run()
+        wf.forwards[0].weights.map_read()
+        results[tag] = {
+            "w0": wf.forwards[0].weights.mem.copy(),
+            "err": int(wf.decision.min_validation_n_err),
+        }
+    np.testing.assert_allclose(results["oracle"]["w0"],
+                               results["xla_l2"]["w0"],
+                               rtol=1e-3, atol=1e-4)
+    assert results["oracle"]["err"] == results["xla_l2"]["err"]
+
+
+def test_level2_region_compiles_bf16():
+    """bf16 precision_type + level 2 coexist: inputs cast to bf16 but
+    matmul precision 'highest' — the region must compile and train."""
+    root.common.precision_type = "bfloat16"
+    root.common.precision_level = 2
+    prng.seed_all(7)
+    wf = build(max_epochs=2)
+    wf.initialize(device=XLADevice())
+    assert wf._region_unit is not None
+    wf.run()
+    assert wf.decision.min_validation_n_err_pt <= 20.0

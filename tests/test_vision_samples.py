@@ -1,0 +1,53 @@
+"""Small vision demo samples (reference: ``znicz/samples/YaleFaces``,
+``Hands``, ``Channels`` — SURVEY.md §2.4 model-zoo rows)."""
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import XLADevice
+from znicz_tpu.utils.config import root
+
+
+@pytest.mark.parametrize("module, max_err_pt", [
+    ("yale_faces", 25.0),
+    ("hands", 15.0),
+    ("channels", 30.0),
+])
+def test_sample_converges_synthetic(module, max_err_pt):
+    import importlib
+
+    mod = importlib.import_module(f"znicz_tpu.models.samples.{module}")
+    wf = mod.build(max_epochs=8)
+    wf.initialize(device=XLADevice())
+    wf.run()
+    assert wf.decision.min_validation_n_err_pt <= max_err_pt, \
+        f"{module}: {wf.decision.min_validation_n_err_pt}"
+
+
+def test_yale_faces_real_directory(tmp_path):
+    """With a class-per-subdir tree under datasets/yalefaces the
+    sample loads real files through the image stack (validation carve
+    included)."""
+    from PIL import Image
+
+    rng = np.random.default_rng(3)
+    base = tmp_path / "datasets" / "yalefaces"
+    protos = rng.integers(0, 256, size=(4, 32, 32))
+    for subject in range(4):
+        d = base / f"subject{subject:02d}"
+        d.mkdir(parents=True)
+        for i in range(10):
+            img = np.clip(protos[subject]
+                          + rng.normal(0, 30, (32, 32)), 0, 255)
+            Image.fromarray(img.astype(np.uint8), mode="L").save(
+                d / f"img_{i}.png")
+    root.common.dirs.datasets = str(tmp_path / "datasets")
+    from znicz_tpu.models.samples import yale_faces
+
+    wf = yale_faces.build(max_epochs=6, n_subjects=4, minibatch_size=8)
+    wf.initialize(device=XLADevice())
+    from znicz_tpu.loader.image import FullBatchImageLoader
+    assert isinstance(wf.loader, FullBatchImageLoader)
+    assert wf.loader.class_lengths[2] + wf.loader.class_lengths[1] == 40
+    wf.run()
+    assert wf.decision.min_validation_n_err_pt <= 50.0

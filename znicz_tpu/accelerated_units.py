@@ -191,6 +191,18 @@ class JitRegion(Logger):
                 seen.setdefault(id(vec), vec)
         return list(seen.values())
 
+    @property
+    def debug_checks(self) -> bool:
+        """``root.common.engine.debug_checks``: compile the region
+        through ``checkify`` (NaN / inf / div-by-zero / OOB-index
+        checks on every primitive) and raise a located error from
+        ``run`` — the debug-mode equivalent of the Vector state
+        machine for *inside*-the-program faults (SURVEY.md §5.2).
+        Costs a host sync per step and disables buffer donation; for
+        debugging, not production."""
+        from znicz_tpu.utils.config import root
+        return bool(root.common.engine.get("debug_checks", False))
+
     def run(self) -> None:
         if self._vectors is None:
             self._vectors = self._collect_vectors()
@@ -198,15 +210,22 @@ class JitRegion(Logger):
         for vec in vectors:
             vec.unmap()
         skips = tuple(bool(unit.gate_skip) for unit in self.units)
-        key = tuple(unit.region_key() for unit in self.units) + (skips,)
+        checks = self.debug_checks
+        key = tuple(unit.region_key() for unit in self.units) \
+            + (skips, checks)
         fn = self._cache.get(key)
         if fn is None:
             self.debug("region '%s': compiling for key %s "
                        "(%d units, %d leaves)", self.name, key,
                        len(self.units), len(vectors))
-            fn = self._cache[key] = self._build(skips)
+            fn = self._cache[key] = self._build(skips, checks)
         leaves = [vec._devmem for vec in vectors]
-        out = fn(*leaves)
+        if checks:
+            err, out = fn(*leaves)
+            err.throw()  # located NaN/inf/OOB report, e.g. "nan
+            #              generated by primitive: log" + traceback
+        else:
+            out = fn(*leaves)
         for vec, leaf in zip(vectors, out):
             vec.devmem = leaf
 
@@ -238,9 +257,67 @@ class JitRegion(Logger):
 
         return fn
 
-    def _build(self, skips: tuple[bool, ...]):
+    def run_chunk(self, n_steps: int) -> None:
+        """Execute ``n_steps`` region steps in ONE dispatch:
+        ``lax.scan`` over the region body (the idiomatic JAX training
+        loop).  Amortizes per-step dispatch/RPC cost — the difference
+        between one host round trip per minibatch and one per chunk.
+
+        Caller contract: every per-step input the device program needs
+        must be device-resident and self-advancing across the chunk —
+        i.e. the loader runs a device schedule
+        (``FullBatchLoader.device_schedule``), PRNG chains / LR state /
+        error accumulators are already region leaves — and the static
+        key (gate skips, unit modes) must not change within the chunk.
+        The caller advances host-side bookkeeping (epoch counters)
+        separately; ``StandardWorkflow.run_chunked`` does both.
+        """
+        if n_steps == 1:
+            return self.run()
+        if self._vectors is None:
+            self._vectors = self._collect_vectors()
+        vectors = self._vectors
+        for vec in vectors:
+            vec.unmap()
+        skips = tuple(bool(unit.gate_skip) for unit in self.units)
+        if self.debug_checks:
+            # checkify's error pytree doesn't thread through this scan
+            # harness; debug runs take the per-step path
+            for _ in range(n_steps):
+                self.run()
+            return
+        key = tuple(unit.region_key() for unit in self.units) \
+            + (skips, "chunk", n_steps)
+        fn = self._cache.get(key)
+        if fn is None:
+            self.debug("region '%s': compiling %d-step scan chunk",
+                       self.name, n_steps)
+            body = self.build_callable(skips)
+
+            def chunk_fn(*leaves):
+                def step(carry, _):
+                    return body(*carry), None
+                out, _ = jax.lax.scan(step, tuple(leaves), xs=None,
+                                      length=n_steps)
+                return out
+
+            fn = self._cache[key] = jax.jit(
+                chunk_fn, donate_argnums=tuple(range(len(vectors))))
+        leaves = [vec._devmem for vec in vectors]
+        out = fn(*leaves)
+        for vec, leaf in zip(vectors, out):
+            vec.devmem = leaf
+
+    def _build(self, skips: tuple[bool, ...], checks: bool = False):
         assert self._vectors is not None
-        return jax.jit(self.build_callable(skips),
+        fn = self.build_callable(skips)
+        if checks:
+            from jax.experimental import checkify
+            # no donation: checkify threads an error-state pytree
+            # through the program, which breaks input→output aliasing
+            return jax.jit(checkify.checkify(
+                fn, errors=checkify.all_checks))
+        return jax.jit(fn,
                        donate_argnums=tuple(range(len(self._vectors))))
 
 

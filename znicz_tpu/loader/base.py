@@ -78,6 +78,8 @@ class Loader(AcceleratedUnit):
         self._cursor = 0
         self._shuffled: np.ndarray | None = None
         self._host_indices: np.ndarray | None = None
+        #: device-resident schedule copies need (re)uploading
+        self._sched_dirty = True
 
     # ------------------------------------------------------------------
     @property
@@ -157,6 +159,7 @@ class Loader(AcceleratedUnit):
         if hi > lo:
             seg = self._shuffled[lo:hi]
             self.rnd.shuffle(seg)
+            self._sched_dirty = True  # device-resident copy is stale
 
     # ------------------------------------------------------------------
     # per-step control plane
@@ -179,19 +182,34 @@ class Loader(AcceleratedUnit):
         self.minibatch_offset = lo
         self._host_indices = idx  # host copy (streaming loaders read
         #                           it back without a device round-trip)
-        self.minibatch_indices.map_invalidate()
-        self.minibatch_indices.mem[...] = idx
-        self.minibatch_valid.map_invalidate()
-        self.minibatch_valid.mem[...] = count
         at_end = self._cursor >= len(self._schedule)
         self.last_minibatch.value = (
             at_end or self._schedule[self._cursor][0] != cls)
         self.epoch_ended.value = at_end
         self.train_ended.value = at_end and cls == TRAIN
+        if self._on_device_schedule():
+            # indices/valid are computed ON DEVICE from the resident
+            # schedule (sched_* leaves) — no per-step host→device
+            # uploads, the big per-step cost on remote/tunneled TPUs
+            self._sync_device_schedule()
+            return
+        self.minibatch_indices.map_invalidate()
+        self.minibatch_indices.mem[...] = idx
+        self.minibatch_valid.map_invalidate()
+        self.minibatch_valid.mem[...] = count
         # device path (gather) needs indices on device
         if self.device is not None and not self.device.is_host_only:
             self.minibatch_indices.unmap()
             self.minibatch_valid.unmap()
+
+    # device-resident schedule hooks (implemented by FullBatchLoader;
+    # streaming loaders stage data host-side anyway, so they keep the
+    # host-upload path)
+    def _on_device_schedule(self) -> bool:
+        return False
+
+    def _sync_device_schedule(self) -> None:  # pragma: no cover - hook
+        raise NotImplementedError
 
     @property
     def forward_mode(self) -> str:

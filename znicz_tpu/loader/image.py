@@ -69,6 +69,19 @@ def scan_directory(directory: str,
     return paths, labels, label_map
 
 
+def carve_validation(paths: list[str], labels: list[int],
+                     fraction: float, rnd
+                     ) -> tuple[tuple[list[str], list[int]],
+                                tuple[list[str], list[int]]]:
+    """Split a train file list into (valid, train) via a seeded
+    permutation — the shared carve policy of both image loaders."""
+    n_valid = int(len(paths) * fraction)
+    perm = rnd.permutation(len(paths))
+    v_idx, t_idx = perm[:n_valid], perm[n_valid:]
+    return (([paths[i] for i in v_idx], [labels[i] for i in v_idx]),
+            ([paths[i] for i in t_idx], [labels[i] for i in t_idx]))
+
+
 def _decode_pil(path: str, out_hw: tuple[int, int],
                 resize_hw: tuple[int, int] | None, channels: int,
                 random_crop: bool, random_flip: bool,
@@ -321,14 +334,9 @@ class FileImageLoader(ImageLoader):
             vp, vl, label_map = scan_directory(self.valid_dir, label_map)
             splits[VALID] = (vp, vl)
         elif self.validation_fraction > 0:
-            n_valid = int(len(train_paths) * self.validation_fraction)
-            # spread the carve across classes via a seeded permutation
-            perm = self.rnd.permutation(len(train_paths))
-            v_idx, t_idx = perm[:n_valid], perm[n_valid:]
-            splits[VALID] = ([train_paths[i] for i in v_idx],
-                             [train_labels[i] for i in v_idx])
-            splits[TRAIN] = ([train_paths[i] for i in t_idx],
-                             [train_labels[i] for i in t_idx])
+            splits[VALID], splits[TRAIN] = carve_validation(
+                train_paths, train_labels, self.validation_fraction,
+                self.rnd)
         if self.test_dir is not None:
             tp, tl, label_map = scan_directory(self.test_dir, label_map)
             splits[TEST] = (tp, tl)
@@ -353,6 +361,7 @@ class FullBatchImageLoader(FullBatchLoader):
                  train_dir: str,
                  valid_dir: str | None = None,
                  test_dir: str | None = None,
+                 validation_fraction: float = 0.0,
                  out_hw: tuple[int, int] = (32, 32),
                  resize_hw: tuple[int, int] | None = None,
                  grayscale: bool = False,
@@ -363,6 +372,7 @@ class FullBatchImageLoader(FullBatchLoader):
         self.train_dir = train_dir
         self.valid_dir = valid_dir
         self.test_dir = test_dir
+        self.validation_fraction = float(validation_fraction)
         self.out_hw = tuple(out_hw)
         self.resize_hw = None if resize_hw is None else tuple(resize_hw)
         self.grayscale = bool(grayscale)
@@ -383,6 +393,10 @@ class FullBatchImageLoader(FullBatchLoader):
                 continue
             p, l, label_map = scan_directory(d, label_map)
             splits[cls] = (p, l)
+        if self.valid_dir is None and self.validation_fraction > 0:
+            tp, tl = splits[TRAIN]
+            splits[VALID], splits[TRAIN] = carve_validation(
+                tp, tl, self.validation_fraction, self.rnd)
         paths: list[str] = []
         labels: list[int] = []
         for cls in (TEST, VALID, TRAIN):  # global index order

@@ -83,21 +83,41 @@ def layers(cfg) -> list[dict]:
     ]
 
 
-def build(**overrides) -> StandardWorkflow:
+def build(streaming_dir: str | None = None, **overrides) -> StandardWorkflow:
+    """``streaming_dir``: train from a class-per-subdir JPEG tree via
+    the streaming ``FileImageLoader`` (native C++ decode pool, double
+    -buffered) instead of the device-resident synthetic store — the
+    real-ImageNet consumption mode (reference:
+    ``znicz/samples/imagenet/`` fed from the file system too)."""
     cfg = dict(root.alexnet.as_dict())
     cfg.update(overrides)
     size = cfg["image_size"]
-    n_train, n_valid = cfg["n_train_samples"], cfg["n_valid_samples"]
-    x, y = datasets.synthetic_imagenet(
-        n_train + n_valid, size=size, n_classes=cfg["n_classes"])
+    if streaming_dir is not None:
+        from znicz_tpu.loader.image import FileImageLoader
+
+        def loader_factory(w):
+            return FileImageLoader(
+                w, train_dir=streaming_dir,
+                validation_fraction=(
+                    cfg["n_valid_samples"]
+                    / max(1, cfg["n_train_samples"])),
+                out_hw=(size, size), resize_hw=(256, 256),
+                minibatch_size=cfg["minibatch_size"])
+    else:
+        n_train, n_valid = cfg["n_train_samples"], cfg["n_valid_samples"]
+        x, y = datasets.synthetic_imagenet(
+            n_train + n_valid, size=size, n_classes=cfg["n_classes"])
+
+        def loader_factory(w):
+            return ArrayLoader(
+                w,
+                train_data=x[:n_train], train_labels=y[:n_train],
+                valid_data=x[n_train:], valid_labels=y[n_train:],
+                minibatch_size=cfg["minibatch_size"],
+                normalization_scale=2.0 / 255.0, normalization_bias=-1.0)
     wf = StandardWorkflow(
         name="alexnet",
-        loader_factory=lambda w: ArrayLoader(
-            w,
-            train_data=x[:n_train], train_labels=y[:n_train],
-            valid_data=x[n_train:], valid_labels=y[n_train:],
-            minibatch_size=cfg["minibatch_size"],
-            normalization_scale=2.0 / 255.0, normalization_bias=-1.0),
+        loader_factory=loader_factory,
         layers=layers(cfg),
         decision_config={"max_epochs": cfg["max_epochs"]})
     wf._max_fires = 10 ** 9

@@ -1,0 +1,95 @@
+"""Channels: TV-channel logo classifier (reference:
+``znicz/samples/Channels/`` — color logo crops through a conv net;
+the historical production demo of the reference stack).
+
+Real data: ``root.common.dirs.datasets/channels`` with one
+subdirectory per channel; otherwise synthetic logo-like color images.
+"""
+
+from __future__ import annotations
+
+import os
+
+from znicz_tpu import datasets
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils.config import register_defaults, root
+
+register_defaults("channels", {
+    "minibatch_size": 50,
+    "learning_rate": 0.02,
+    "gradient_moment": 0.9,
+    "weights_decay": 0.0005,
+    "n_channels": 8,
+    "image_size": 32,
+    "max_epochs": 30,
+    "validation_fraction": 0.15,
+})
+
+
+def _data_dir() -> str:
+    return os.path.join(str(root.common.dirs.datasets), "channels")
+
+
+def layers(cfg) -> list[dict]:
+    gd_cfg = {"learning_rate": cfg["learning_rate"],
+              "gradient_moment": cfg["gradient_moment"],
+              "weights_decay": cfg["weights_decay"]}
+    return [
+        {"type": "conv_str",
+         "->": {"n_kernels": 16, "kx": 5, "ky": 5, "padding": 2},
+         "<-": gd_cfg},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2,
+                                       "sliding": (2, 2)}},
+        {"type": "conv_str",
+         "->": {"n_kernels": 32, "kx": 5, "ky": 5, "padding": 2},
+         "<-": gd_cfg},
+        {"type": "max_pooling", "->": {"kx": 2, "ky": 2,
+                                       "sliding": (2, 2)}},
+        {"type": "all2all_tanh", "->": {"output_sample_shape": 64},
+         "<-": gd_cfg},
+        {"type": "softmax",
+         "->": {"output_sample_shape": cfg["n_channels"]},
+         "<-": gd_cfg},
+    ]
+
+
+def build(**overrides) -> StandardWorkflow:
+    cfg = dict(root.channels.as_dict())
+    cfg.update(overrides)
+    size = cfg["image_size"]
+    if os.path.isdir(_data_dir()):
+        from znicz_tpu.loader.image import FullBatchImageLoader
+
+        def loader_factory(w):
+            return FullBatchImageLoader(
+                w, train_dir=_data_dir(),
+                validation_fraction=cfg["validation_fraction"],
+                out_hw=(size, size), resize_hw=None,
+                minibatch_size=cfg["minibatch_size"])
+    else:
+        x, y, _, _ = datasets.synthetic_images(
+            n_train=cfg["n_channels"] * 60, n_test=0, size=size,
+            channels=3, n_classes=cfg["n_channels"], seed=48)
+        n_valid = int(len(x) * cfg["validation_fraction"])
+
+        def loader_factory(w):
+            return ArrayLoader(
+                w, train_data=x[n_valid:], train_labels=y[n_valid:],
+                valid_data=x[:n_valid], valid_labels=y[:n_valid],
+                minibatch_size=cfg["minibatch_size"],
+                normalization_scale=2.0 / 255.0,
+                normalization_bias=-1.0)
+    wf = StandardWorkflow(
+        name="channels",
+        loader_factory=loader_factory,
+        layers=layers(cfg),
+        decision_config={"max_epochs": cfg["max_epochs"]})
+    wf._max_fires = 100_000_000
+    return wf
+
+
+def run(load, main):
+    """Reference sample entry protocol (``veles <sample> <config>``)."""
+    load(build)
+    main()

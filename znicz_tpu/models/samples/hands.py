@@ -1,0 +1,78 @@
+"""Hands: open/closed hand-posture binary classifier (reference:
+``znicz/samples/Hands/`` — small grayscale images, two classes,
+fully-connected net).
+
+Real data: ``root.common.dirs.datasets/hands`` with one subdirectory
+per posture class; otherwise synthetic two-class grayscale images.
+"""
+
+from __future__ import annotations
+
+import os
+
+from znicz_tpu import datasets
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils.config import register_defaults, root
+
+register_defaults("hands", {
+    "minibatch_size": 40,
+    "learning_rate": 0.05,
+    "gradient_moment": 0.9,
+    "hidden": 30,
+    "image_size": 24,
+    "max_epochs": 30,
+    "validation_fraction": 0.15,
+})
+
+
+def _data_dir() -> str:
+    return os.path.join(str(root.common.dirs.datasets), "hands")
+
+
+def build(**overrides) -> StandardWorkflow:
+    cfg = dict(root.hands.as_dict())
+    cfg.update(overrides)
+    size = cfg["image_size"]
+    gd_cfg = {"learning_rate": cfg["learning_rate"],
+              "gradient_moment": cfg["gradient_moment"]}
+    layers = [
+        {"type": "all2all_tanh",
+         "->": {"output_sample_shape": cfg["hidden"]}, "<-": gd_cfg},
+        {"type": "softmax", "->": {"output_sample_shape": 2},
+         "<-": gd_cfg},
+    ]
+    if os.path.isdir(_data_dir()):
+        from znicz_tpu.loader.image import FullBatchImageLoader
+
+        def loader_factory(w):
+            return FullBatchImageLoader(
+                w, train_dir=_data_dir(),
+                validation_fraction=cfg["validation_fraction"],
+                out_hw=(size, size), resize_hw=None, grayscale=True,
+                minibatch_size=cfg["minibatch_size"])
+    else:
+        x, y, _, _ = datasets.synthetic_images(
+            n_train=400, n_test=0, size=size, channels=0,
+            n_classes=2, seed=47)
+        n_valid = int(len(x) * cfg["validation_fraction"])
+        flat = (x.reshape(len(x), -1).astype("float32") / 127.5) - 1.0
+
+        def loader_factory(w):
+            return ArrayLoader(
+                w, train_data=flat[n_valid:], train_labels=y[n_valid:],
+                valid_data=flat[:n_valid], valid_labels=y[:n_valid],
+                minibatch_size=cfg["minibatch_size"])
+    wf = StandardWorkflow(
+        name="hands",
+        loader_factory=loader_factory,
+        layers=layers,
+        decision_config={"max_epochs": cfg["max_epochs"]})
+    wf._max_fires = 10_000_000
+    return wf
+
+
+def run(load, main):
+    """Reference sample entry protocol (``veles <sample> <config>``)."""
+    load(build)
+    main()

@@ -1,0 +1,81 @@
+"""YaleFaces: small face-recognition demo (reference:
+``znicz/samples/YaleFaces/`` — grayscale face images of 15 subjects
+through a fully-connected net).
+
+Real data: a class-per-subdirectory image tree under
+``root.common.dirs.datasets/yalefaces`` (one directory per subject)
+loaded through the streaming image stack; otherwise synthetic
+grayscale "faces" (class-prototype images) with the same geometry.
+"""
+
+from __future__ import annotations
+
+import os
+
+from znicz_tpu import datasets
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils.config import register_defaults, root
+
+register_defaults("yale_faces", {
+    "minibatch_size": 20,
+    "learning_rate": 0.02,
+    "gradient_moment": 0.9,
+    "hidden": 100,
+    "n_subjects": 15,
+    "image_size": 32,
+    "max_epochs": 40,
+    "validation_fraction": 0.15,
+})
+
+
+def _data_dir() -> str:
+    return os.path.join(str(root.common.dirs.datasets), "yalefaces")
+
+
+def build(**overrides) -> StandardWorkflow:
+    cfg = dict(root.yale_faces.as_dict())
+    cfg.update(overrides)
+    size = cfg["image_size"]
+    gd_cfg = {"learning_rate": cfg["learning_rate"],
+              "gradient_moment": cfg["gradient_moment"]}
+    layers = [
+        {"type": "all2all_tanh",
+         "->": {"output_sample_shape": cfg["hidden"]}, "<-": gd_cfg},
+        {"type": "softmax",
+         "->": {"output_sample_shape": cfg["n_subjects"]}, "<-": gd_cfg},
+    ]
+    if os.path.isdir(_data_dir()):
+        from znicz_tpu.loader.image import FullBatchImageLoader
+
+        def loader_factory(w):
+            return FullBatchImageLoader(
+                w, train_dir=_data_dir(),
+                validation_fraction=cfg["validation_fraction"],
+                out_hw=(size, size), resize_hw=None, grayscale=True,
+                minibatch_size=cfg["minibatch_size"])
+    else:
+        x, y, _, _ = datasets.synthetic_images(
+            n_train=cfg["n_subjects"] * 11, n_test=0, size=size,
+            channels=0, n_classes=cfg["n_subjects"], seed=46)
+        n_valid = int(len(x) * cfg["validation_fraction"])
+        flat = (x.reshape(len(x), -1).astype("float32") / 127.5) - 1.0
+
+        def loader_factory(w):
+            return ArrayLoader(
+                w, train_data=flat[n_valid:], train_labels=y[n_valid:],
+                valid_data=flat[:n_valid], valid_labels=y[:n_valid],
+                minibatch_size=cfg["minibatch_size"])
+    wf = StandardWorkflow(
+        name="yale_faces",
+        loader_factory=loader_factory,
+        layers=layers,
+        decision_config={"max_epochs": cfg["max_epochs"]})
+    wf._max_fires = 10_000_000
+    return wf
+
+
+def run(load, main):
+    """Reference sample entry protocol (``veles <sample> <config>``)."""
+    load(build)
+    main()

@@ -398,6 +398,65 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.publisher = p
         return p
 
+    def run_chunked(self, steps_per_dispatch: int = 32) -> None:
+        """Fast-path training driver: amortize dispatch cost by running
+        up to ``steps_per_dispatch`` minibatch steps per device call
+        (``JitRegion.run_chunk`` — one ``lax.scan`` program), the
+        idiomatic JAX training loop.
+
+        Semantics vs :meth:`run`: identical trajectory — the loader's
+        device-resident schedule reproduces the per-step index stream
+        bitwise, stochastic units advance their device PRNG chains per
+        scanned step, and the evaluator's error counters accumulate on
+        device exactly as in per-step mode.  Chunks never cross a
+        class-segment or epoch boundary, so decision bookkeeping and
+        the epoch side chain (snapshotter, plotters, LR adjuster) fire
+        at the same points; an active LR-adjust policy is applied at
+        chunk granularity (piecewise-constant within a chunk) rather
+        than per step.  Requires the XLA backend + a device-schedule
+        loader; falls back to :meth:`run` otherwise.
+        """
+        region_unit = self._region_unit
+        loader = self.loader
+        if (region_unit is None or steps_per_dispatch <= 1
+                or not loader._on_device_schedule()):
+            return self.run()
+        region = region_unit.region
+        assert region is not None
+        decision = self.decision
+        side_units = [u for u in decision.links_to
+                      if u is not self.repeater and u is not self.end_point]
+        import time as _time
+        self.run_started_at = _time.time()
+        self.stopped.value = False
+        chunks = 0
+        while not decision.complete and not self.stopped:
+            loader.run()  # host bookkeeping (+ schedule upload if stale)
+            cls = loader.minibatch_class
+            k = 1
+            while (k < steps_per_dispatch and not loader.epoch_ended
+                   and loader._cursor < len(loader._schedule)
+                   and loader._schedule[loader._cursor][0] == cls):
+                loader.run()
+                k += 1
+            region.run_chunk(k)
+            if self.lr_adjuster is not None and cls == TRAIN:
+                # chunk-granular application of the per-step policy
+                self.lr_adjuster._n_iterations += k - 1
+                self.lr_adjuster.run()
+            decision.run()
+            if decision.epoch_ended or decision.complete:
+                for unit in side_units:
+                    if unit is self.lr_adjuster:
+                        continue  # handled above
+                    if not unit.gate_block and not unit.gate_skip:
+                        unit._fire()
+            chunks += 1
+            if self._max_fires is not None and chunks > self._max_fires:
+                raise RuntimeError(
+                    f"workflow '{self.name}' exceeded max_fires="
+                    f"{self._max_fires} chunks (runaway loop?)")
+
     def export_forward(self, path: str) -> str:
         """Serialize the trained forward chain for serving
         (reference: ``ForwardExporter``; see

@@ -1,0 +1,132 @@
+"""Filter-similarity diagnostics (reference: ``znicz/diversity.py`` —
+helpers measuring how similar a layer's learned kernels are, used to
+spot wasted capacity: near-duplicate filters mean the layer effectively
+has fewer features than weights).
+
+TPU-first shape: the whole pairwise-similarity computation is ONE
+normalized Gram matrix — ``W_n @ W_n.T`` on unit-normalized, centered
+filter rows — so it rides the MXU in a single ``jnp.dot`` instead of
+the reference's per-pair host loops.  Grouping near-duplicates is a
+tiny host-side union-find over the (n_filters × n_filters) matrix,
+which is control-plane work by nature.
+
+Both a functional API (:func:`filter_similarity`,
+:func:`similar_kernel_groups`, :func:`diversity_score`) and a workflow
+unit (:class:`FilterDiversityReporter`) are provided; the unit logs the
+per-layer diversity each validation epoch the way the reference's
+plotters consumed the helpers.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.memory import Vector
+from znicz_tpu.units import Unit
+
+
+def _as_filter_rows(weights: np.ndarray) -> np.ndarray:
+    """(… , n_filters_last) conv kernels or (n_in, n_out) FC weights →
+    (n_filters, fan_in) rows.
+
+    Convention: conv weights are HWIO (ky, kx, c_in, n_kernels) — the
+    layout ``ops/conv.py`` trains; FC weights are (in, out).  In both,
+    the LAST axis indexes filters.
+    """
+    arr = np.asarray(weights, dtype=np.float32)
+    if arr.ndim < 2:
+        raise ValueError(f"weights must be ≥2-D, got {arr.shape}")
+    return arr.reshape(-1, arr.shape[-1]).T
+
+
+def filter_similarity(weights, xp=np) -> np.ndarray:
+    """Pairwise Pearson correlation of a layer's filters.
+
+    Returns an (n_filters, n_filters) symmetric matrix with unit
+    diagonal.  ``xp=jnp`` keeps the Gram product on the accelerator
+    (one MXU matmul); the default runs the numpy oracle.
+    """
+    rows = _as_filter_rows(weights) if xp is np else weights
+    if xp is np:
+        centered = rows - rows.mean(axis=1, keepdims=True)
+        norms = np.sqrt((centered ** 2).sum(axis=1, keepdims=True))
+        unit = centered / np.maximum(norms, 1e-12)
+        return unit @ unit.T
+    # jax path: same math, traced (rows must already be 2-D filters)
+    centered = rows - rows.mean(axis=1, keepdims=True)
+    norms = xp.sqrt((centered ** 2).sum(axis=1, keepdims=True))
+    unit = centered / xp.maximum(norms, 1e-12)
+    return xp.dot(unit, unit.T)
+
+
+def similar_kernel_groups(weights, threshold: float = 0.85
+                          ) -> list[list[int]]:
+    """Groups of near-duplicate filters: connected components of the
+    |correlation| ≥ threshold graph, singletons dropped (reference
+    semantics: report only the redundant clusters)."""
+    sim = filter_similarity(weights)
+    n = sim.shape[0]
+    parent = list(range(n))
+
+    def find(i: int) -> int:
+        while parent[i] != i:
+            parent[i] = parent[parent[i]]
+            i = parent[i]
+        return i
+
+    for i in range(n):
+        for j in range(i + 1, n):
+            if abs(sim[i, j]) >= threshold:
+                parent[find(i)] = find(j)
+    groups: dict[int, list[int]] = {}
+    for i in range(n):
+        groups.setdefault(find(i), []).append(i)
+    return sorted((g for g in groups.values() if len(g) > 1),
+                  key=lambda g: (-len(g), g[0]))
+
+
+def diversity_score(weights, threshold: float = 0.85) -> float:
+    """Fraction of filters NOT in any near-duplicate group — 1.0 means
+    every filter is distinct, 0.0 means total redundancy."""
+    arr = _as_filter_rows(weights)
+    n = arr.shape[0]
+    if n == 0:
+        return 1.0
+    redundant = sum(len(g) for g in similar_kernel_groups(
+        weights, threshold))
+    return 1.0 - redundant / n
+
+
+class FilterDiversityReporter(Unit):
+    """Logs per-layer filter diversity when the decision unit reports
+    an improved validation epoch (the hook the reference's diversity
+    plotters used).
+
+    Link pattern::
+
+        rep = FilterDiversityReporter(wf)
+        rep.weights_list = [fwd.weights for fwd in wf.forwards[:-1]]
+        rep.link_from(wf.decision)
+        rep.gate_skip = ~wf.decision.improved   # only on improvement
+    """
+
+    def __init__(self, workflow, name: str | None = None,
+                 threshold: float = 0.85, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.threshold = float(threshold)
+        self.weights_list: list[Vector] = []
+        #: last computed {layer_name: (score, n_groups)}
+        self.last_report: dict[str, tuple[float, int]] = {}
+
+    def run(self) -> None:
+        self.last_report = {}
+        for vec in self.weights_list:
+            if not isinstance(vec, Vector) or not vec:
+                continue
+            vec.map_read()
+            weights = np.array(vec.mem)
+            groups = similar_kernel_groups(weights, self.threshold)
+            score = diversity_score(weights, self.threshold)
+            self.last_report[vec.name] = (score, len(groups))
+            self.info("%s: diversity %.3f (%d duplicate groups)",
+                      vec.name, score, len(groups))

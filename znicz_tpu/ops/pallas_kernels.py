@@ -105,6 +105,79 @@ def _row_tiled_call(kernel, out_like, *inputs, interpret=False):
     )(*inputs)
 
 
+# ----------------------------------------------------------------------
+# Dropout: PRNG mask + apply in one VMEM pass (candidate; measured
+# against the jax.random path by benchmarks/pallas_microbench.py)
+# ----------------------------------------------------------------------
+def _dropout_kernel(seed_ref, x_ref, o_ref, *, drop_ratio):
+    from jax.experimental.pallas import tpu as pltpu
+
+    pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
+    bits = pltpu.prng_random_bits(x_ref.shape)
+    threshold = jnp.uint32(int(drop_ratio * (2 ** 32 - 1)))
+    keep = bits.astype(jnp.uint32) > threshold
+    scale = 1.0 / (1.0 - drop_ratio)
+    o_ref[:] = jnp.where(keep, x_ref[:] * scale, 0.0)
+
+
+def dropout_apply(x, seed, drop_ratio: float, interpret: bool = False):
+    """Fused mask-generate + apply: TPU-core PRNG bits in VMEM instead
+    of a materialized threefry mask array from ``jax.random``.
+
+    ``seed``: int32 scalar array.  Inverted-dropout scaling matches
+    ``ops/dropout.py`` (keep → ×1/(1−ratio))."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    m, c = x2d.shape
+    tile = min(_TILE_ROWS, m)
+    spec = pl.BlockSpec((tile, c), lambda i: (i, 0))
+    kernel = functools.partial(_dropout_kernel, drop_ratio=drop_ratio)
+    out = pl.pallas_call(
+        kernel,
+        grid=(pl.cdiv(m, tile),),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM), spec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((m, c), x.dtype),
+        interpret=interpret,
+    )(jnp.asarray(seed, jnp.int32).reshape(1), x2d)
+    return out.reshape(shape)
+
+
+# ----------------------------------------------------------------------
+# Softmax (+ argmax): one row pass — max, exp, sum, divide, argmax
+# fused in VMEM (candidate; the XLA composition is 3-4 HBM passes)
+# ----------------------------------------------------------------------
+def _softmax_argmax_kernel(v_ref, y_ref, idx_ref):
+    v = v_ref[:]
+    m = jnp.max(v, axis=1, keepdims=True)
+    e = jnp.exp(v - m)
+    y_ref[:] = e / jnp.sum(e, axis=1, keepdims=True)
+    idx_ref[:] = jnp.argmax(v, axis=1, keepdims=True).astype(jnp.int32)
+
+
+def softmax_argmax(v, interpret: bool = False):
+    """Row softmax + winner index in one pass over (batch, n_classes).
+
+    Returns ``(probs, max_idx)`` matching ``All2AllSoftmax``'s
+    stabilized softmax + ``max_idx`` contract."""
+    m, c = v.shape
+    tile = min(_TILE_ROWS, m)
+    spec = pl.BlockSpec((tile, c), lambda i: (i, 0))
+    idx_spec = pl.BlockSpec((tile, 1), lambda i: (i, 0))
+    probs, idx = pl.pallas_call(
+        _softmax_argmax_kernel,
+        grid=(pl.cdiv(m, tile),),
+        in_specs=[spec],
+        out_specs=(spec, idx_spec),
+        out_shape=(jax.ShapeDtypeStruct((m, c), v.dtype),
+                   jax.ShapeDtypeStruct((m, 1), jnp.int32)),
+        interpret=interpret,
+    )(v)
+    return probs, idx[:, 0]
+
+
 def lrn_forward(x, alpha: float, beta: float, k: float, n: int,
                 interpret: bool = False):
     """Fused LRN forward over an ND array whose LAST axis is channels."""
