@@ -1,0 +1,115 @@
+"""bf16-vs-f32 convergence evidence on the flagship AlexNet geometry,
+run on the real TPU chip.
+
+Trains the full AlexNet layer stack (227×227×3, conv/LRN/pool/FC/
+dropout/softmax) on a LEARNABLE synthetic dataset (class-prototype
+images — ``datasets.synthetic_images``; pure-noise ImageNet stand-ins
+can't produce a falling loss curve) twice with identical seeds:
+once in float32, once in the bf16 mixed-precision mode the headline
+benchmark reports (bf16 matmul/conv inputs, f32 params+accumulation).
+
+Artifacts: BF16_CONVERGENCE.json (both per-epoch mean-CE loss curves
++ error counts) and a pass/fail line asserting the bf16 trajectory
+tracks f32 within a band.
+
+Run: ``python benchmarks/bf16_convergence.py`` (env: BF16_EPOCHS,
+BF16_BATCH, BF16_CLASSES, BF16_IMAGE_SIZE).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+EPOCHS = int(os.environ.get("BF16_EPOCHS", "40"))
+BATCH = int(os.environ.get("BF16_BATCH", "64"))
+N_CLASSES = int(os.environ.get("BF16_CLASSES", "16"))
+IMAGE_SIZE = int(os.environ.get("BF16_IMAGE_SIZE", "227"))
+STEPS_PER_EPOCH = 8
+
+
+def build(precision: str):
+    from znicz_tpu import datasets
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.samples import alexnet
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils.config import root
+
+    root.common.precision_type = precision
+    cfg = dict(root.alexnet.as_dict())
+    cfg.update(n_classes=N_CLASSES, image_size=IMAGE_SIZE,
+               learning_rate=0.005)
+    n_train = STEPS_PER_EPOCH * BATCH
+    x, y, _, _ = datasets.synthetic_images(
+        n_train=n_train, n_test=0, size=IMAGE_SIZE, channels=3,
+        n_classes=N_CLASSES, seed=51)
+    wf = StandardWorkflow(
+        name=f"alexnet_{precision}",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=x, train_labels=y, minibatch_size=BATCH,
+            normalization_scale=2.0 / 255.0, normalization_bias=-1.0),
+        layers=alexnet.layers(cfg),
+        decision_config={"max_epochs": EPOCHS})
+    wf._max_fires = 10 ** 9
+    return wf
+
+
+def train_curve(precision: str) -> dict:
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.utils import prng
+    from znicz_tpu.utils.config import reset_root
+
+    reset_root()
+    prng.seed_all(4242)
+    wf = build(precision)
+    wf.initialize(device=XLADevice())
+
+    losses, errors = [], []
+    orig = wf.decision.on_epoch_ended
+
+    def hooked():
+        orig()
+        losses.append(wf.decision.epoch_loss[2])   # TRAIN mean CE
+        errors.append(wf.decision.epoch_n_err[2])
+
+    wf.decision.on_epoch_ended = hooked
+    wf.run_chunked(steps_per_dispatch=STEPS_PER_EPOCH)
+    return {"precision": precision, "loss": losses, "n_err": errors}
+
+
+def main() -> None:
+    curves = {p: train_curve(p) for p in ("float32", "bfloat16")}
+    f32, bf16 = curves["float32"], curves["bfloat16"]
+    steps = EPOCHS * STEPS_PER_EPOCH
+    initial = f32["loss"][0]
+    final_f32, final_bf16 = f32["loss"][-1], bf16["loss"][-1]
+    drop = max(initial - final_f32, 1e-6)
+    gap = abs(final_bf16 - final_f32)
+    # band: bf16 must recover ≥70% of the f32 loss drop and end within
+    # 30% of the f32 drop of f32's final loss
+    ok = (initial - final_bf16) >= 0.7 * drop and gap <= 0.3 * drop
+    artifact = {
+        "model": "alexnet", "image_size": IMAGE_SIZE, "batch": BATCH,
+        "n_classes": N_CLASSES, "epochs": EPOCHS, "steps": steps,
+        "loss_initial_f32": initial,
+        "loss_final_f32": final_f32, "loss_final_bf16": final_bf16,
+        "gap": gap, "band_ok": bool(ok),
+        "curves": curves,
+    }
+    with open(os.path.join(REPO, "BF16_CONVERGENCE.json"), "w") as fh:
+        json.dump(artifact, fh, indent=1)
+    print(json.dumps({k: artifact[k] for k in (
+        "steps", "loss_initial_f32", "loss_final_f32",
+        "loss_final_bf16", "gap", "band_ok")}), flush=True)
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

@@ -51,6 +51,9 @@ def test_xla_backend_converges_with_region():
     assert wf._region_unit is not None  # hot chain actually compiled
     wf.run()
     assert wf.decision.min_validation_n_err_pt <= 10.0
+    # device-accumulated CE loss curve: populated and decreasing
+    train_loss = wf.decision.epoch_loss[2]
+    assert train_loss is not None and 0.0 < train_loss < 0.7
 
 
 def test_xla_region_matches_numpy_oracle():

@@ -95,12 +95,13 @@ def build(streaming_dir: str | None = None, **overrides) -> StandardWorkflow:
     if streaming_dir is not None:
         from znicz_tpu.loader.image import FileImageLoader
 
+        n_total = cfg["n_train_samples"] + cfg["n_valid_samples"]
+
         def loader_factory(w):
             return FileImageLoader(
                 w, train_dir=streaming_dir,
                 validation_fraction=(
-                    cfg["n_valid_samples"]
-                    / max(1, cfg["n_train_samples"])),
+                    cfg["n_valid_samples"] / max(1, n_total)),
                 out_hw=(size, size), resize_hw=(256, 256),
                 minibatch_size=cfg["minibatch_size"])
     else:

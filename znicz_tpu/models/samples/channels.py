@@ -66,6 +66,8 @@ def build(**overrides) -> StandardWorkflow:
                 w, train_dir=_data_dir(),
                 validation_fraction=cfg["validation_fraction"],
                 out_hw=(size, size), resize_hw=None,
+                normalization_scale=2.0 / 255.0,
+                normalization_bias=-1.0,
                 minibatch_size=cfg["minibatch_size"])
     else:
         x, y, _, _ = datasets.synthetic_images(

@@ -421,6 +421,12 @@ class StandardWorkflow(AcceleratedWorkflow):
         if (region_unit is None or steps_per_dispatch <= 1
                 or not loader._on_device_schedule()):
             return self.run()
+        if self.image_saver is not None:
+            # ImageSaver consumes EVERY minibatch (worst-sample dumps);
+            # inside a scanned chunk only the last step's data survives
+            self.warning("run_chunked: image_saver needs per-step "
+                         "minibatches — falling back to per-step run()")
+            return self.run()
         region = region_unit.region
         assert region is not None
         decision = self.decision

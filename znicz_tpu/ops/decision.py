@@ -79,6 +79,7 @@ class DecisionGD(DecisionBase):
         super().__init__(workflow, name=name, **kwargs)
         self.evaluator = None  # linked: needs .n_err
         self.epoch_n_err = [0, 0, 0]          # running, current epoch
+        self.epoch_loss = [None, None, None]  # mean CE per class, last epoch
         self.epoch_n_err_pt = [100.0, 100.0, 100.0]
         self.min_validation_n_err = None
         self.min_validation_n_err_pt = 100.0
@@ -102,6 +103,15 @@ class DecisionGD(DecisionBase):
         self.epoch_n_err = [int(x) for x in acc.mem]
         acc.map_invalidate()
         acc.mem[...] = 0  # uploaded on the next region fire
+        loss_acc: Vector = getattr(self.evaluator, "epoch_loss", None)
+        if isinstance(loss_acc, Vector) and loss_acc:
+            loss_acc.map_read()
+            # summed −log p(true) → mean per sample (the loss curve)
+            self.epoch_loss = [
+                float(loss_acc.mem[c]) / loader.class_lengths[c]
+                if loader.class_lengths[c] else None for c in range(3)]
+            loss_acc.map_invalidate()
+            loss_acc.mem[...] = 0.0
         cm: Vector = getattr(self.evaluator, "confusion_matrix", None)
         if isinstance(cm, Vector) and cm:
             cm.map_read()

@@ -44,15 +44,10 @@ def filter_similarity(weights, xp=np) -> np.ndarray:
 
     Returns an (n_filters, n_filters) symmetric matrix with unit
     diagonal.  ``xp=jnp`` keeps the Gram product on the accelerator
-    (one MXU matmul); the default runs the numpy oracle.
+    (one MXU matmul) and expects pre-shaped 2-D filter rows; the
+    default runs the numpy oracle on any weights layout.
     """
     rows = _as_filter_rows(weights) if xp is np else weights
-    if xp is np:
-        centered = rows - rows.mean(axis=1, keepdims=True)
-        norms = np.sqrt((centered ** 2).sum(axis=1, keepdims=True))
-        unit = centered / np.maximum(norms, 1e-12)
-        return unit @ unit.T
-    # jax path: same math, traced (rows must already be 2-D filters)
     centered = rows - rows.mean(axis=1, keepdims=True)
     norms = xp.sqrt((centered ** 2).sum(axis=1, keepdims=True))
     unit = centered / xp.maximum(norms, 1e-12)
@@ -126,7 +121,9 @@ class FilterDiversityReporter(Unit):
             vec.map_read()
             weights = np.array(vec.mem)
             groups = similar_kernel_groups(weights, self.threshold)
-            score = diversity_score(weights, self.threshold)
+            n = _as_filter_rows(weights).shape[0]
+            redundant = sum(len(g) for g in groups)
+            score = 1.0 - redundant / n if n else 1.0
             self.last_report[vec.name] = (score, len(groups))
             self.info("%s: diversity %.3f (%d duplicate groups)",
                       vec.name, score, len(groups))

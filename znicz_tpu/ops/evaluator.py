@@ -62,6 +62,10 @@ class EvaluatorSoftmax(EvaluatorBase):
         # scheme (reference: EvaluatorSoftmax confusion matrix)
         self.compute_confusion = compute_confusion
         self.confusion_matrix = Vector(name=f"{self.name}.confusion")
+        # summed cross-entropy −log p(true) per class, accumulated on
+        # device like epoch_n_err (read once per epoch; the loss curve
+        # the bf16-vs-f32 convergence artifact tracks)
+        self.epoch_loss = Vector(name=f"{self.name}.epoch_loss")
 
     def region_key(self) -> tuple:
         # minibatch_class indexes the on-device accumulator statically
@@ -75,11 +79,13 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.n_err.reset(np.zeros((), dtype=np.int32))
         if not self.epoch_n_err:
             self.epoch_n_err.reset(np.zeros(3, dtype=np.int32))
+        if not self.epoch_loss:
+            self.epoch_loss.reset(np.zeros(3, dtype=np.float32))
         if self.compute_confusion and not self.confusion_matrix:
             c = self.n_classes
             self.confusion_matrix.reset(np.zeros((3, c, c), dtype=np.int32))
         self.init_vectors(self.err_output, self.n_err, self.epoch_n_err,
-                          self.confusion_matrix,
+                          self.epoch_loss, self.confusion_matrix,
                           self.output, self.labels, self.max_idx,
                           self.minibatch_valid)
 
@@ -104,6 +110,10 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.n_err.mem[...] = n_err
         self.epoch_n_err.map_write()
         self.epoch_n_err.mem[int(self.minibatch_class)] += n_err
+        self.epoch_loss.map_write()
+        p_true = np.maximum(p[np.arange(p.shape[0]), t], 1e-30)
+        self.epoch_loss.mem[int(self.minibatch_class)] += float(
+            np.sum(mask * -np.log(p_true)))
         if self.compute_confusion:
             self.confusion_matrix.map_write()
             cm = self.confusion_matrix.mem[int(self.minibatch_class)]
@@ -121,6 +131,10 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.n_err.devmem = n_err
         self.epoch_n_err.devmem = self.epoch_n_err.devmem.at[
             int(self.minibatch_class)].add(n_err)
+        p_true = jnp.maximum(p[jnp.arange(p.shape[0]), t], 1e-30)
+        self.epoch_loss.devmem = self.epoch_loss.devmem.at[
+            int(self.minibatch_class)].add(
+                jnp.sum(mask * -jnp.log(p_true)).astype(jnp.float32))
         if self.compute_confusion:
             # masked rows contribute 0; duplicate (t, pred) pairs
             # accumulate via scatter-add
