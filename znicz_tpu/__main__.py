@@ -133,6 +133,11 @@ def make_parser() -> argparse.ArgumentParser:
                    help="genetic hyperparameter search over Tune "
                         "leaves in the config tree, e.g. "
                         "--optimize 5x8 (reference: veles/genetics)")
+    p.add_argument("--chunk", type=int, default=1, metavar="N",
+                   help="train N minibatch steps per device dispatch "
+                        "(lax.scan over the jit region; amortizes "
+                        "dispatch/RPC latency — see "
+                        "StandardWorkflow.run_chunked)")
     p.add_argument("--dump-graph", metavar="FILE",
                    help="write the workflow's Graphviz DOT and exit")
     p.add_argument("--dry-run", action="store_true",
@@ -178,7 +183,8 @@ class Main(Logger):
             retries=args.retries,
             graphics=False if args.no_graphics else None,
             web_status=args.web_status,
-            web_status_host=args.web_status_host)
+            web_status_host=args.web_status_host,
+            chunk=args.chunk)
         self.launcher = launcher  # introspection (tests, embedding)
         if args.dump_graph or args.dry_run:
             # build (and initialize) without training

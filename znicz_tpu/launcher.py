@@ -62,8 +62,11 @@ class Launcher(Logger):
                  web_status: int | None = None,
                  web_status_host: str = "127.0.0.1",
                  load_kwargs: dict | None = None,
+                 chunk: int = 1,
                  **kwargs) -> None:
         super().__init__(**kwargs)
+        #: steps per device dispatch (>1 → StandardWorkflow.run_chunked)
+        self.chunk = int(chunk)
         self.backend = backend
         self.snapshot = snapshot
         self.retries = int(retries)
@@ -217,7 +220,10 @@ class Launcher(Logger):
             self._snapshot_state = None
         self._install_signal_handlers(workflow)
         try:
-            workflow.run()
+            if self.chunk > 1 and hasattr(workflow, "run_chunked"):
+                workflow.run_chunked(self.chunk)
+            else:
+                workflow.run()
         except KeyboardInterrupt:
             self._emergency_snapshot(workflow)
             raise

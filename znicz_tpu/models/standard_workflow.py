@@ -137,6 +137,7 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.link_gds()
         self.link_loop()
         self.snapshotter = None
+        self.image_saver = None
         if snapshotter_config is not None:
             self.link_snapshotter(**snapshotter_config)
         self.lr_adjuster = None
@@ -421,11 +422,15 @@ class StandardWorkflow(AcceleratedWorkflow):
         if (region_unit is None or steps_per_dispatch <= 1
                 or not loader._on_device_schedule()):
             return self.run()
-        if self.image_saver is not None:
-            # ImageSaver consumes EVERY minibatch (worst-sample dumps);
-            # inside a scanned chunk only the last step's data survives
-            self.warning("run_chunked: image_saver needs per-step "
-                         "minibatches — falling back to per-step run()")
+        per_step = [u for u in self.decision.links_to
+                    if getattr(u, "NEEDS_PER_STEP_MINIBATCHES", False)]
+        if per_step:
+            # such units consume EVERY minibatch (e.g. ImageSaver's
+            # worst-sample dumps); inside a scanned chunk only the
+            # last step's data survives
+            self.warning("run_chunked: %s need per-step minibatches — "
+                         "falling back to per-step run()",
+                         [u.name for u in per_step])
             return self.run()
         region = region_unit.region
         assert region is not None

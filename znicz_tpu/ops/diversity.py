@@ -80,15 +80,18 @@ def similar_kernel_groups(weights, threshold: float = 0.85
                   key=lambda g: (-len(g), g[0]))
 
 
-def diversity_score(weights, threshold: float = 0.85) -> float:
+def diversity_score(weights, threshold: float = 0.85,
+                    groups: list[list[int]] | None = None) -> float:
     """Fraction of filters NOT in any near-duplicate group — 1.0 means
-    every filter is distinct, 0.0 means total redundancy."""
+    every filter is distinct, 0.0 means total redundancy.  Pass
+    precomputed ``groups`` to skip recomputing the similarity matrix."""
     arr = _as_filter_rows(weights)
     n = arr.shape[0]
     if n == 0:
         return 1.0
-    redundant = sum(len(g) for g in similar_kernel_groups(
-        weights, threshold))
+    if groups is None:
+        groups = similar_kernel_groups(weights, threshold)
+    redundant = sum(len(g) for g in groups)
     return 1.0 - redundant / n
 
 
@@ -121,9 +124,8 @@ class FilterDiversityReporter(Unit):
             vec.map_read()
             weights = np.array(vec.mem)
             groups = similar_kernel_groups(weights, self.threshold)
-            n = _as_filter_rows(weights).shape[0]
-            redundant = sum(len(g) for g in groups)
-            score = 1.0 - redundant / n if n else 1.0
+            score = diversity_score(weights, self.threshold,
+                                    groups=groups)
             self.last_report[vec.name] = (score, len(groups))
             self.info("%s: diversity %.3f (%d duplicate groups)",
                       vec.name, score, len(groups))

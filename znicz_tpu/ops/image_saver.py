@@ -47,9 +47,15 @@ def to_image_array(sample: np.ndarray) -> np.ndarray:
 class ImageSaver(Unit):
     """Saves misclassified (or all-eval, see ``save_all``) samples.
 
+    ``NEEDS_PER_STEP_MINIBATCHES``: consumes every minibatch's data —
+    drivers that batch steps per dispatch (``run_chunked``) must fall
+    back to per-step stepping when this unit is linked.
+
     File name: ``<n>_t<true>_p<pred>.png`` inside
     ``out_dir/epoch_<epoch>/``; at most ``limit`` files per epoch.
     """
+
+    NEEDS_PER_STEP_MINIBATCHES = True
 
     def __init__(self, workflow, name: str | None = None,
                  out_dir: str | None = None, limit: int = 64,
