@@ -84,20 +84,22 @@ def train_curve(precision: str) -> dict:
 
 
 def main() -> None:
-    curves = {p: train_curve(p) for p in ("float32", "bfloat16")}
-    f32, bf16 = curves["float32"], curves["bfloat16"]
+    f32 = train_curve("float32")
     steps = EPOCHS * STEPS_PER_EPOCH
     initial = f32["loss"][0]
-    final_f32, final_bf16 = f32["loss"][-1], bf16["loss"][-1]
+    final_f32 = f32["loss"][-1]
     drop = initial - final_f32
     if drop <= 0.05 * initial:
         # a non-learning f32 baseline can't certify anything about
-        # bf16 — distinct error, not a bf16 failure (happens with
-        # short smoke overrides like BF16_EPOCHS=2)
+        # bf16 — error out BEFORE paying for the bf16 run (happens
+        # with short smoke overrides like BF16_EPOCHS=2)
         print(json.dumps({"error": "f32 baseline did not learn "
                           f"(drop {drop:.4f} of initial {initial:.4f}); "
                           "run longer (BF16_EPOCHS)"}), flush=True)
         sys.exit(2)
+    bf16 = train_curve("bfloat16")
+    curves = {"float32": f32, "bfloat16": bf16}
+    final_bf16 = bf16["loss"][-1]
     gap = abs(final_bf16 - final_f32)
     # band: bf16 must recover ≥70% of the f32 loss drop and end within
     # 30% of the f32 drop of f32's final loss

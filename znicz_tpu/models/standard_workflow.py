@@ -422,7 +422,7 @@ class StandardWorkflow(AcceleratedWorkflow):
         if (region_unit is None or steps_per_dispatch <= 1
                 or not loader._on_device_schedule()):
             return self.run()
-        per_step = [u for u in self.decision.links_to
+        per_step = [u for u in self.units
                     if getattr(u, "NEEDS_PER_STEP_MINIBATCHES", False)]
         if per_step:
             # such units consume EVERY minibatch (e.g. ImageSaver's

@@ -118,21 +118,24 @@ class Conv(Forward):
             np.zeros((n, oh, ow, self.n_kernels), dtype=np.float32))
         self.init_vectors(self.input, self.output, self.weights, self.bias)
 
-    # -- pure forward (jnp; also used by the backward unit's vjp) -------
-    def xla_forward(self, x, w, b):
+    # -- pure forward (jnp; the backward unit transposes conv_raw) ------
+    def conv_raw(self, x, w):
+        """The bare conv at MXU precision: bf16 in → bf16 out in bf16
+        mode (single-dtype, so ``jax.linear_transpose``'d gradient
+        convs stay single-dtype — the casts' own transposes move the
+        cotangent between f32 and bf16)."""
         pt, pb, pl, pr = self.padding
         dt = self.mxu_dtype
         if dt is not None:
-            # bf16 conv end-to-end, then cast up: keeping the conv
-            # single-dtype means jax.vjp's transposed conv (gd_conv,
-            # deconv) stays single-dtype too — the cast's own
-            # transpose converts the f32 cotangent down to bf16
             x, w = x.astype(dt), w.astype(dt)
-        y = jax.lax.conv_general_dilated(
+        return jax.lax.conv_general_dilated(
             x, w, window_strides=self.sliding,
             padding=((pt, pb), (pl, pr)),
             dimension_numbers=DIMNUMS)
-        if dt is not None:
+
+    def xla_forward(self, x, w, b):
+        y = self.conv_raw(x, w)
+        if y.dtype != jnp.float32:
             y = y.astype(jnp.float32)
         if b is not None:
             y = y + b
