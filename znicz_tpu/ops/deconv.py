@@ -92,28 +92,37 @@ class Deconv(Forward):
         self.output.reset(np.zeros(out_shape, dtype=np.float32))
         self.init_vectors(self.input, self.output, self.weights, self.bias)
 
-    # -- pure forward (jnp; the backward unit vjp's this) ---------------
-    def xla_forward(self, x, w, b):
+    # -- pure forward (jnp) ---------------------------------------------
+    def paired_conv_raw(self, y, w):
+        """The PAIRED forward conv (out_space → in_space) at MXU
+        precision — one home for the geometry/cast recipe shared by
+        :meth:`deconv_raw` and the backward unit's input grad."""
         pt, pb, pl, pr = self.padding
-        out_shape = self.output.shape
         dt = self.mxu_dtype
-        if dt is not None:  # bf16 inputs, f32 accumulation (MXU native)
-            w = w.astype(dt)
+        if dt is not None:  # bf16 inputs, MXU-native (see Conv.conv_raw)
+            y, w = y.astype(dt), w.astype(dt)
+        return jax.lax.conv_general_dilated(
+            y, w, window_strides=self.sliding,
+            padding=((pt, pb), (pl, pr)),
+            dimension_numbers=DIMNUMS)
 
-        def conv_fn(y):
-            out = jax.lax.conv_general_dilated(
-                y, w, window_strides=self.sliding,
-                padding=((pt, pb), (pl, pr)),
-                dimension_numbers=DIMNUMS)
-            # single-dtype conv + explicit up-cast: the vjp below then
-            # down-casts the f32 cotangent and transposes a pure-bf16
-            # conv (see Conv.xla_forward)
-            return out.astype(jnp.float32) if dt is not None else out
+    def deconv_raw(self, x, w):
+        """Bare transposed conv at MXU precision: the
+        ``jax.linear_transpose`` (no primal evaluation) of the paired
+        conv's data argument — exactly XLA's conv transpose rule."""
+        dt = self.mxu_dtype
+        if dt is not None:
+            x = x.astype(dt)
+        transpose = jax.linear_transpose(
+            lambda y: self.paired_conv_raw(y, w),
+            jax.ShapeDtypeStruct(self.output.shape, x.dtype))
+        (out,) = transpose(x)
+        return out
 
-        y0 = jnp.zeros(out_shape, dt if dt is not None else x.dtype)
-        _, vjp = jax.vjp(conv_fn, y0)
-        (out,) = vjp(x)
-        out = out.astype(jnp.float32)
+    def xla_forward(self, x, w, b):
+        out = self.deconv_raw(x, w)
+        if out.dtype != jnp.float32:
+            out = out.astype(jnp.float32)
         if b is not None:
             out = out + b
         return self.activation.fwd(jnp, out)

@@ -1,6 +1,9 @@
 """Gradient unit for Deconv (reference: ``znicz/gd_deconv.py``).
 
-XLA path: ``jax.vjp`` of :meth:`Deconv.xla_forward` — for a transposed
+XLA path: explicit transposed gradients (``jax.linear_transpose`` of
+``Deconv.deconv_raw`` for the weight grad, the paired forward conv for
+the input grad, activation derivative from the saved output — no
+forward re-evaluation; same design as ``gd_conv``).  For a transposed
 conv that is again a plain conv, lowered natively by XLA.  Numpy
 oracle: the explicit transpose math (im2col of the incoming error),
 independently implemented.
@@ -11,6 +14,7 @@ from __future__ import annotations
 import numpy as np
 
 import jax
+import jax.numpy as jnp
 
 from znicz_tpu.ops.conv import im2col
 from znicz_tpu.ops.deconv import Deconv
@@ -58,16 +62,27 @@ class GDDeconv(GradientDescentBase):
             self._apply_bias_np(delta.sum(axis=(0, 1, 2)))
 
     def xla_run(self) -> None:
+        """Explicit gradients, no forward re-evaluation (same design
+        as ``GradientDescentConv.xla_run``): activation derivative from
+        the saved output; grad wrt x is the PAIRED FORWARD conv applied
+        to delta (the transpose of a transposed conv); grad wrt w via
+        ``jax.linear_transpose`` of ``deconv_raw`` in its weight
+        argument."""
         fwd = self.forward_unit
         x = self.input.devmem
         w = self.weights.devmem
-        has_bias = self.bias is not None and self.bias
-        b = self.bias.devmem if has_bias else None
-        _, vjp = jax.vjp(lambda xx, ww, bb: fwd.xla_forward(xx, ww, bb),
-                         x, w, b)
-        grad_x, grad_w, grad_b = vjp(self.err_output.devmem)
+        y = self.output.devmem
+        delta = self.err_output.devmem * fwd.activation.derivative(
+            jnp, y, None)
+        dt = fwd.mxu_dtype
+        cotangent = delta if dt is None else delta.astype(dt)
         if self.need_err_input:
-            self.err_input.devmem = grad_x
-        self._apply_weights_xla(grad_w)
-        if has_bias:
-            self._apply_bias_xla(grad_b)
+            grad_x = fwd.paired_conv_raw(cotangent, w)
+            self.err_input.devmem = grad_x.astype(jnp.float32)
+        t_w = jax.linear_transpose(
+            lambda ww: fwd.deconv_raw(x, ww),
+            jax.ShapeDtypeStruct(w.shape, w.dtype))
+        (grad_w,) = t_w(cotangent)
+        self._apply_weights_xla(grad_w.astype(jnp.float32))
+        if self.bias is not None and self.bias:
+            self._apply_bias_xla(delta.sum(axis=(0, 1, 2)))
