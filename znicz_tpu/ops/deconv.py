@@ -7,8 +7,9 @@ half of convolutional autoencoders (MnistAE / ImagenetAE samples).  A
 channels — and may *share* the conv's weight Vector (tied-weight AE).
 
 The reference lowered this as a hand-written col2im scatter kernel.
-TPU-first, the XLA path is the **vjp of the paired conv's pure
-forward** — XLA's native transposed-conv lowering onto the MXU; the
+TPU-first, the XLA path is the **``jax.linear_transpose`` of the
+paired conv's data argument** (no primal evaluation, unlike
+``jax.vjp``) — XLA's native transposed-conv lowering onto the MXU; the
 numpy oracle is the explicit ``x @ Wᵀ`` + ``col2im`` math (an
 independent implementation doubling as the spec, same pattern as
 ``gd_conv.py``).
