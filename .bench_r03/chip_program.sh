@@ -1,0 +1,41 @@
+#!/bin/bash
+# Sequential chip program: waits for tunnel recovery, then runs every
+# chip-gated measurement. One TPU client at a time throughout.
+cd /root/repo
+OUT=.bench_r03
+log() { echo "[$(date +%H:%M:%S)] $*" >> $OUT/progress.log; }
+
+log "waiting for tunnel..."
+while :; do
+  if timeout 90 python .spike/tpu_probe.py > $OUT/probe.log 2>&1 && grep -q matmul $OUT/probe.log; then
+    log "tunnel recovered: $(cat $OUT/probe.log | tail -1)"
+    break
+  fi
+  sleep 120
+done
+
+run_bench() {  # name, env...
+  name=$1; shift
+  log "bench $name start"
+  env "$@" BENCH_TIMEOUT_S=600 timeout 700 python bench.py > $OUT/$name.json 2> $OUT/$name.err
+  log "bench $name done rc=$? : $(tail -c 300 $OUT/$name.json)"
+}
+
+run_bench chunk16_b128 BENCH_CHUNK=16 BENCH_BATCH=128
+run_bench chunk1_b128  BENCH_CHUNK=1  BENCH_BATCH=128
+run_bench chunk16_b256 BENCH_CHUNK=16 BENCH_BATCH=256
+run_bench chunk16_b512 BENCH_CHUNK=16 BENCH_BATCH=512
+run_bench stream_b128  BENCH_INPUT=stream BENCH_BATCH=128
+
+log "microbench start"
+timeout 900 python benchmarks/pallas_microbench.py > $OUT/microbench.log 2>&1
+log "microbench done rc=$?"
+
+log "bf16 convergence start"
+timeout 1800 python benchmarks/bf16_convergence.py > $OUT/bf16.log 2>&1
+log "bf16 done rc=$?"
+
+log "profile run start"
+BENCH_CHUNK=16 BENCH_BATCH=128 BENCH_PROFILE=$OUT/profile BENCH_TIMEOUT_S=600 timeout 700 python bench.py > $OUT/profile_run.json 2> $OUT/profile_run.err
+log "profile run done rc=$? : $(tail -c 300 $OUT/profile_run.json)"
+log "ALL DONE"
