@@ -51,3 +51,30 @@ def test_yale_faces_real_directory(tmp_path):
     assert wf.loader.class_lengths[2] + wf.loader.class_lengths[1] == 40
     wf.run()
     assert wf.decision.min_validation_n_err_pt <= 50.0
+
+
+def test_imagenet_sample_streams_from_tree(tmp_path):
+    """The imagenet sample builds over a class-per-subdir JPEG tree
+    and trains a step through the streaming pipeline."""
+    from PIL import Image
+
+    rng = np.random.default_rng(9)
+    base = tmp_path / "train"
+    for cls in range(3):
+        d = base / f"class{cls}"
+        d.mkdir(parents=True)
+        for i in range(8):
+            Image.fromarray(rng.integers(0, 256, (64, 64, 3),
+                                         dtype=np.uint8)
+                            ).save(d / f"i{i}.jpg")
+    from znicz_tpu.models.samples import imagenet
+
+    wf = imagenet.build(train_dir=str(base), minibatch_size=4,
+                        n_classes=3, image_size=35, resize_size=40,
+                        max_epochs=1)
+    wf.initialize(device=XLADevice())
+    from znicz_tpu.loader.image import FileImageLoader
+    assert isinstance(wf.loader, FileImageLoader)
+    wf.loader.run()
+    wf._region_unit.run()
+    wf.forwards[-1].weights.devmem.block_until_ready()
