@@ -50,6 +50,9 @@ IMAGE_SIZE = int(os.environ.get("BENCH_IMAGE_SIZE", "227"))
 #: bf16 matmul/conv inputs with f32 params+accumulation — the
 #: MXU-native training mode (override: BENCH_PRECISION=float32)
 PRECISION = os.environ.get("BENCH_PRECISION", "bfloat16")
+#: BENCH_PALLAS=1 opts into the Pallas variants (A/B lever; plain XLA
+#: is the measured in-graph winner — see PALLAS_BENCH.md)
+PALLAS = os.environ.get("BENCH_PALLAS", "0") != "0"
 TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT_S", "900"))
 PROFILE_DIR = os.environ.get("BENCH_PROFILE", "")
 WARMUP_STEPS = 6
@@ -191,6 +194,7 @@ def main() -> None:
     from znicz_tpu.utils.config import root
 
     root.common.precision_type = PRECISION
+    root.common.engine.use_pallas = PALLAS
 
     # dataset sized a whole number of chunks per epoch so a scanned
     # chunk never spans the epoch-boundary reshuffle (ceil to a

@@ -179,9 +179,16 @@ def main() -> None:
                      f"| {winner} | {note} |")
     lines += [
         "",
-        "Decision rule: units keep the Pallas path (via "
-        "`pallas_kernels.use_pallas`) only for ops where the kernel "
-        "wins above; everything else stays plain XLA.",
+        "Decision rule: standalone wins above are necessary but NOT "
+        "sufficient — the call has to win **in-graph** too. "
+        "`pallas_call` pins operands to a 2-D row-major layout, so "
+        "inside the AlexNet training region XLA brackets each LRN "
+        "call with layout copies + reshapes of the (n,55,55,96) "
+        "activations: profiled at ~40% of the step "
+        "(profiles/r03_b256), chip A/B 7795 img/s (plain XLA) vs "
+        "6263 img/s (Pallas LRN) at batch 256. Units therefore "
+        "default to plain XLA (`root.common.engine.use_pallas` "
+        "opts back in).",
         "",
     ]
     with open(os.path.join(REPO, "PALLAS_BENCH.md"), "w") as fh:

@@ -78,10 +78,14 @@ def test_use_pallas_gate():
     dev = XLADevice()  # cpu platform under tests
     assert not pallas_kernels.use_pallas(dev)
 
-    class FakeTPU:  # the positive branch + the config kill-switch
+    class FakeTPU:  # platform check + the opt-in config switch
         class jax_device:
             platform = "tpu"
 
+    # default is OFF even on TPU (in-graph layout copies lose to
+    # fused XLA — see PALLAS_BENCH.md); config opts in
+    assert not pallas_kernels.use_pallas(FakeTPU())
+    root.common.engine.use_pallas = True
     assert pallas_kernels.use_pallas(FakeTPU())
     root.common.engine.use_pallas = False
     assert not pallas_kernels.use_pallas(FakeTPU())

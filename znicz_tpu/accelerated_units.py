@@ -82,6 +82,33 @@ class AcceleratedUnit(Unit):
             return jnp.bfloat16
         return None
 
+    @property
+    def act_store_dtype(self) -> np.dtype:
+        """STORAGE dtype for activation / error tensors (the big
+        batch-major intermediates): ``bfloat16`` in bf16 mode on XLA
+        devices, else ``float32``.
+
+        Profiling the AlexNet step (profiles/r03_b256_xla_lrn) showed
+        ~60% of device time in bandwidth-bound work over f32
+        activations; storing them bf16 halves that traffic.  Math
+        still runs in f32 where it matters (GEMM/conv accumulation via
+        ``preferred_element_type``, LRN denominators, evaluator loss)
+        — this is storage precision, not compute precision.  Params,
+        weight gradients, and loss accumulators stay f32.  Opt out:
+        ``root.common.engine.bf16_activations = False``.  The numpy
+        oracle path (host-only devices) always stores f32.
+        """
+        from znicz_tpu.utils.config import root
+        assert self.device is not None, \
+            f"{self}: act_store_dtype before initialize resolved a device"
+        if (not self.device.is_host_only
+                and self.device.compute_dtype == np.dtype("bfloat16")
+                and bool(root.common.engine.get("bf16_activations",
+                                                True))):
+            import jax.numpy as jnp
+            return np.dtype(jnp.bfloat16)
+        return np.dtype(np.float32)
+
     def mxu_dot(self, xp, a, b):
         """``a @ b`` routed through the MXU at the configured input
         precision (f32 accumulation); numpy path untouched (oracle)."""

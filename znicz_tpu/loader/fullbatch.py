@@ -105,7 +105,7 @@ class FullBatchLoader(Loader):
         sample_shape = self.original_data.shape[1:]
         self.minibatch_data.reset(np.zeros(
             (self.max_minibatch_size,) + tuple(sample_shape),
-            dtype=np.float32))
+            dtype=self.act_store_dtype))
         if self.has_labels:
             self.minibatch_labels.reset(np.zeros(
                 self.max_minibatch_size, dtype=np.int32))

@@ -29,6 +29,18 @@ if TYPE_CHECKING:  # pragma: no cover
     from znicz_tpu.backends import Device
 
 
+def _is_float_dtype(dt: np.dtype) -> bool:
+    """True for any float dtype incl. the ml_dtypes ones (bfloat16
+    reports numpy kind 'V', so ``np.issubdtype`` can't be used)."""
+    if np.issubdtype(dt, np.floating):
+        return True
+    try:
+        np.finfo(dt)
+        return True
+    except ValueError:
+        return False
+
+
 class _State(enum.Enum):
     EMPTY = 0     #: no storage yet
     HOST = 1      #: host copy authoritative; device copy stale/absent
@@ -183,7 +195,24 @@ class Vector:
     @devmem.setter
     def devmem(self, value) -> None:
         """Functional update from device compute (eager xla_run or the
-        region builder writing traced results back)."""
+        region builder writing traced results back).
+
+        FLOAT writes are cast to the DECLARED dtype (the host
+        mirror's, set at allocation) when they disagree — the
+        storage-precision contract: a bf16-declared activation vector
+        stores bf16 no matter what precision the producing math ran
+        in, and scan carries (``JitRegion.run_chunk``) stay
+        dtype-stable across steps.  Matching writes are untouched, and
+        non-float mismatches (e.g. an int64 write into an int32 index
+        vector) are NOT silently coerced — those are unit bugs that
+        should stay visible.
+        """
+        if (self._mem is not None and hasattr(value, "dtype")
+                and value.dtype != self._mem.dtype
+                and hasattr(value, "astype")
+                and _is_float_dtype(np.dtype(value.dtype))
+                and _is_float_dtype(self._mem.dtype)):
+            value = value.astype(self._mem.dtype)
         self._devmem = value
         if not self._tracing:
             self._state = _State.DEVICE

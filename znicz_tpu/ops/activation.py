@@ -30,7 +30,8 @@ class ActivationForward(Forward):
         super().initialize(device=device, **kwargs)
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        self.output.reset(np.zeros(self.input.shape, dtype=np.float32))
+        self.output.reset(np.zeros(self.input.shape,
+                                   dtype=self.output_store_dtype))
         self.init_vectors(self.input, self.output)
 
     def numpy_run(self) -> None:
@@ -56,9 +57,6 @@ class ActivationBackward(GradientDescentBase):
     def initialize(self, device=None, **kwargs) -> None:
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        if self.need_err_input and not self.err_input:
-            self.err_input.reset(np.zeros(self.input.shape,
-                                          dtype=np.float32))
         super().initialize(device=device, **kwargs)
         self.init_vectors(self.err_input, self.err_output, self.input,
                           self.output)
@@ -155,9 +153,6 @@ class BackwardMul(GradientDescentBase):
     def initialize(self, device=None, **kwargs) -> None:
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        if self.need_err_input and not self.err_input:
-            self.err_input.reset(np.zeros(self.input.shape,
-                                          dtype=np.float32))
         super().initialize(device=device, **kwargs)
         self.init_vectors(self.err_input, self.err_output)
 

@@ -56,7 +56,7 @@ class All2All(Forward):
                 (n_out,), self.bias_filling, self.bias_stddev, fan_in=n_in))
         batch = self.input.shape[0]
         self.output.reset(np.zeros((batch,) + self.output_sample_shape,
-                                   dtype=np.float32))
+                                   dtype=self.output_store_dtype))
         self.init_vectors(self.input, self.output, self.weights, self.bias)
 
     # -- math (shared shape logic; xp-generic) --------------------------
@@ -111,6 +111,10 @@ class All2AllSoftmax(All2All):
     (reference: ``All2AllSoftmax`` with its ``max_idx`` kernel)."""
 
     ACTIVATION = "linear"  # softmax applied over the linear output
+
+    #: probabilities stay f32 — they feed the evaluator's CE/log and
+    #: are tiny (batch × n_classes) next to the conv activations
+    output_store_dtype = np.dtype(np.float32)
 
     def __init__(self, workflow, output_sample_shape, name=None, **kwargs):
         super().__init__(workflow, output_sample_shape, name=name, **kwargs)

@@ -114,8 +114,8 @@ class Conv(Forward):
                 (self.n_kernels,), self.bias_filling, self.bias_stddev,
                 fan_in=fan_in))
         oh, ow = self.output_spatial(h, w)
-        self.output.reset(
-            np.zeros((n, oh, ow, self.n_kernels), dtype=np.float32))
+        self.output.reset(np.zeros((n, oh, ow, self.n_kernels),
+                                   dtype=self.output_store_dtype))
         self.init_vectors(self.input, self.output, self.weights, self.bias)
 
     # -- pure forward (jnp; the backward unit transposes conv_raw) ------

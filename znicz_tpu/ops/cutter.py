@@ -40,7 +40,8 @@ class Cutter(Forward):
         if oh <= 0 or ow <= 0:
             raise ValueError(f"{self}: crop {self.padding} leaves "
                              f"nothing of {h}x{w}")
-        self.output.reset(np.zeros((n, oh, ow, c), dtype=np.float32))
+        self.output.reset(np.zeros((n, oh, ow, c),
+                                   dtype=self.output_store_dtype))
         self.init_vectors(self.input, self.output)
 
     def _crop(self, x):

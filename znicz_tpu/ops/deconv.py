@@ -90,7 +90,8 @@ class Deconv(Forward):
         if self.include_bias and not self.bias:
             self.bias.reset(self.fill_array(
                 (c,), self.bias_filling, self.bias_stddev, fan_in=fan_in))
-        self.output.reset(np.zeros(out_shape, dtype=np.float32))
+        self.output.reset(np.zeros(out_shape,
+                                   dtype=self.output_store_dtype))
         self.init_vectors(self.input, self.output, self.weights, self.bias)
 
     # -- pure forward (jnp) ---------------------------------------------

@@ -50,8 +50,8 @@ class Depooling(Forward):
             raise ValueError(
                 f"{self}: input shape {self.input.shape} != paired "
                 f"pooling output {self.pooling_unit.output.shape}")
-        self.output.reset(
-            np.zeros(self.pooling_input.shape, dtype=np.float32))
+        self.output.reset(np.zeros(self.pooling_input.shape,
+                                   dtype=self.output_store_dtype))
         self.init_vectors(self.input, self.output, self.pooling_input)
 
     # winner scatter, shared with the backward's gather ----------------

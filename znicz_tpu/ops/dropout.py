@@ -41,8 +41,10 @@ class DropoutForward(Forward):
         super().initialize(device=device, **kwargs)
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        self.output.reset(np.zeros(self.input.shape, dtype=np.float32))
-        self.mask.reset(np.ones(self.input.shape, dtype=np.float32))
+        self.output.reset(np.zeros(self.input.shape,
+                                   dtype=self.output_store_dtype))
+        self.mask.reset(np.ones(self.input.shape,
+                                dtype=self.act_store_dtype))
         self.init_vectors(self.input, self.output, self.mask)
         self.init_rng()
 

@@ -41,9 +41,6 @@ class GradientDescentConv(GradientDescentBase):
     def initialize(self, device=None, **kwargs) -> None:
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        if self.need_err_input and not self.err_input:
-            self.err_input.reset(np.zeros(self.input.shape,
-                                          dtype=np.float32))
         super().initialize(device=device, **kwargs)
         self.init_vectors(self.err_input, self.err_output, self.input,
                           self.output, self.weights, self.bias)
@@ -105,7 +102,8 @@ class GradientDescentConv(GradientDescentBase):
         (grad_w,) = t_w(cotangent)
         self._apply_weights_xla(grad_w.astype(jnp.float32))
         if self.bias is not None and self.bias:
-            self._apply_bias_xla(delta.sum(axis=(0, 1, 2)))
+            self._apply_bias_xla(
+                delta.astype(jnp.float32).sum(axis=(0, 1, 2)))
 
 
 class GDTanhConv(GradientDescentConv):

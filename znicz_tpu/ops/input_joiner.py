@@ -88,12 +88,13 @@ class GDInputJoiner(WeightlessGradientUnit):
         fwd = self.forward_unit
         if fwd is not None and not fwd.inputs:
             raise AttributeError(f"{self}: forward_unit has no inputs yet")
+        super().initialize(device=device, **kwargs)
         if fwd is not None and not self.err_inputs:
+            # post-super: dtype follows the activation storage policy
             self.err_inputs = [
-                Vector(np.zeros(vec.shape, dtype=np.float32),
+                Vector(np.zeros(vec.shape, dtype=self.act_store_dtype),
                        name=f"{self.name}.err_input{i}", batch_major=True)
                 for i, vec in enumerate(fwd.inputs)]
-        super().initialize(device=device, **kwargs)
         self.init_vectors(*self.err_inputs)
 
     def region_vectors(self) -> list[Vector]:

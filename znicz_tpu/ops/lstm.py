@@ -138,9 +138,6 @@ class GDLSTM(GradientDescentBase):
     def initialize(self, device=None, **kwargs) -> None:
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        if self.need_err_input and not self.err_input:
-            self.err_input.reset(np.zeros(self.input.shape,
-                                          dtype=np.float32))
         super().initialize(device=device, **kwargs)
         self.init_vectors(self.err_input, self.err_output, self.input,
                           self.output, self.weights, self.bias)

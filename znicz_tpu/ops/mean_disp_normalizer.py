@@ -32,7 +32,8 @@ class MeanDispNormalizer(Forward):
             raise AttributeError(f"{self}: mean not linked/set")
         if self.rdisp is None or not self.rdisp:
             raise AttributeError(f"{self}: rdisp not linked/set")
-        self.output.reset(np.zeros(self.input.shape, dtype=np.float32))
+        self.output.reset(np.zeros(self.input.shape,
+                                   dtype=self.output_store_dtype))
         self.init_vectors(self.input, self.output, self.mean, self.rdisp)
 
     def numpy_run(self) -> None:

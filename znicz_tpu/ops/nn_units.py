@@ -115,6 +115,14 @@ class Forward(AcceleratedUnit):
     def current_batch(self) -> int:
         return self.input.shape[0]
 
+    @property
+    def output_store_dtype(self) -> np.dtype:
+        """Storage dtype for this unit's ``output`` — the activation
+        policy (:attr:`AcceleratedUnit.act_store_dtype`) unless a
+        subclass pins f32 (e.g. softmax probabilities feeding the
+        evaluator)."""
+        return self.act_store_dtype
+
 
 # ----------------------------------------------------------------------
 # GradientDescent base
@@ -181,6 +189,12 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
+        # err_input allocation lives here (post-super, device resolved)
+        # so its dtype can follow the activation storage policy
+        if (self.need_err_input and self.input is not None
+                and self.input and not self.err_input):
+            self.err_input.reset(np.zeros(self.input.shape,
+                                          dtype=self.act_store_dtype))
         if not self.need_err_input and (self.weights is None
                                         or not self.weights):
             # weightless AND nothing upstream wants the error: the unit
@@ -310,9 +324,6 @@ class WeightlessGradientUnit(GradientDescentBase):
         if self.REQUIRES_INPUT:
             if self.input is None or not self.input:
                 raise AttributeError(f"{self}: input not linked yet")
-            if self.need_err_input and not self.err_input:
-                self.err_input.reset(np.zeros(self.input.shape,
-                                              dtype=np.float32))
         super().initialize(device=device, **kwargs)
         self.init_vectors(self.err_input, self.err_output, self.input,
                           self.output)

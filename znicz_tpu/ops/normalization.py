@@ -9,18 +9,18 @@
 
 Defaults match the reference/AlexNet: α=1e-4, β=0.75, k=2, n=5.
 
-The backward unit uses the exact analytic gradient (implemented for
-the numpy oracle) and ``jax.vjp`` of the forward for the XLA path —
-XLA fuses the whole thing into the jit region, which benchmarking in
-the reference survey flags as the right first choice before reaching
-for a Pallas kernel (SURVEY.md §2.3).
+The backward unit uses the exact analytic gradient on both paths
+(numpy oracle and XLA) — XLA fuses the elementwise/window-sum chain
+into the jit region, which benchmarking in the reference survey flags
+as the right first choice before reaching for a Pallas kernel
+(SURVEY.md §2.3; PALLAS_BENCH.md records the in-graph measurement
+that made plain XLA the default here).
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-import jax
 import jax.numpy as jnp
 
 from znicz_tpu.ops.nn_units import Forward, GradientDescentBase
@@ -62,7 +62,8 @@ class LRNormalizerForward(Forward):
         super().initialize(device=device, **kwargs)
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        self.output.reset(np.zeros(self.input.shape, dtype=np.float32))
+        self.output.reset(np.zeros(self.input.shape,
+                                   dtype=self.output_store_dtype))
         self.init_vectors(self.input, self.output)
         from znicz_tpu.ops import pallas_kernels
         self._use_pallas = pallas_kernels.use_pallas(self.device)
@@ -77,13 +78,17 @@ class LRNormalizerForward(Forward):
         self.output.mem[...] = self._forward(np, self.input.mem)
 
     def xla_run(self) -> None:
+        # math in f32 even when activations are stored bf16: d is
+        # k + tiny·Σx², all resolution is in low-order bits.  The
+        # upcast fuses (in-register), costs no HBM traffic; the
+        # devmem setter casts the result back to the storage dtype.
+        x = self.input.devmem.astype(jnp.float32)
         if self._use_pallas:  # resolved once at initialize
             from znicz_tpu.ops import pallas_kernels
             self.output.devmem = pallas_kernels.lrn_forward(
-                self.input.devmem, self.alpha, self.beta, self.k,
-                self.n)
+                x, self.alpha, self.beta, self.k, self.n)
             return
-        self.output.devmem = self._forward(jnp, self.input.devmem)
+        self.output.devmem = self._forward(jnp, x)
 
 
 class LRNormalizerBackward(GradientDescentBase):
@@ -97,9 +102,6 @@ class LRNormalizerBackward(GradientDescentBase):
     def initialize(self, device=None, **kwargs) -> None:
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
-        if self.need_err_input and not self.err_input:
-            self.err_input.reset(np.zeros(self.input.shape,
-                                          dtype=np.float32))
         super().initialize(device=device, **kwargs)
         self.init_vectors(self.err_input, self.err_output, self.input,
                           self.output)
@@ -129,12 +131,16 @@ class LRNormalizerBackward(GradientDescentBase):
 
     def xla_run(self) -> None:
         fwd = self.forward_unit
+        # f32 math on bf16-stored operands — see the forward's note
+        x = self.input.devmem.astype(jnp.float32)
+        err = self.err_output.devmem.astype(jnp.float32)
         if self._use_pallas:  # resolved once at initialize
             from znicz_tpu.ops import pallas_kernels
             self.err_input.devmem = pallas_kernels.lrn_backward(
-                self.input.devmem, self.err_output.devmem,
-                fwd.alpha, fwd.beta, fwd.k, fwd.n)
+                x, err, fwd.alpha, fwd.beta, fwd.k, fwd.n)
             return
-        _, vjp = jax.vjp(lambda xx: fwd._forward(jnp, xx),
-                         self.input.devmem)
-        (self.err_input.devmem,) = vjp(self.err_output.devmem)
+        d = fwd.k + fwd.alpha * _window_sum(jnp, x * x, fwd.n)
+        t = err * x * d ** (-fwd.beta - 1.0)
+        self.err_input.devmem = (
+            err * d ** (-fwd.beta) - 2.0 * fwd.alpha * fwd.beta * x
+            * _window_sum(jnp, t, fwd.n, half_low=fwd.n - 1 - fwd.n // 2))

@@ -40,6 +40,16 @@ _TILE_ROWS = 512
 def use_pallas(device) -> bool:
     """Pallas path gate: TPU platform + config switch.
 
+    **Default OFF** (``root.common.engine.use_pallas = True`` opts
+    in).  The standalone microbenchmark (PALLAS_BENCH.md) has the
+    Pallas LRN ahead of the jnp composition, but IN-GRAPH the picture
+    inverts: `pallas_call` pins its operand to a 2-D row-major layout,
+    so XLA brackets every call with layout copies + reshapes of the
+    (n,55,55,96) activations — profiled at ~40% of the AlexNet step
+    (profiles/r03_b256), and the chip A/B measured plain XLA 24%
+    faster end-to-end (7795 vs 6263 img/s, batch 256).  The fused-XLA
+    LRN fuses into its conv/pool neighbors with no layout constraint.
+
     **Compile-time flag**: units resolve this ONCE at ``initialize``
     and bake the result into their traced program — flipping
     ``root.common.engine.use_pallas`` after a region compiled has no
@@ -57,7 +67,7 @@ def use_pallas(device) -> bool:
             and "tpu" not in getattr(jax_device, "device_kind",
                                      "").lower():
         return False
-    return bool(root.common.engine.get("use_pallas", True))
+    return bool(root.common.engine.get("use_pallas", False))
 
 
 # ----------------------------------------------------------------------

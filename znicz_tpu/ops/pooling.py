@@ -60,7 +60,8 @@ class Pooling(Forward):
             raise AttributeError(f"{self}: input not linked yet")
         n, h, w, c = self.input.shape
         oh, ow = self.output_spatial(h, w)
-        self.output.reset(np.zeros((n, oh, ow, c), dtype=np.float32))
+        self.output.reset(np.zeros((n, oh, ow, c),
+                                   dtype=self.output_store_dtype))
         self.init_vectors(self.input, self.output)
         self._setup()
 
