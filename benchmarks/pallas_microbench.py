@@ -41,17 +41,38 @@ REPS = 50
 LRN = {"alpha": 1e-4, "beta": 0.75, "k": 2.0, "n": 5}
 
 
-def timeit(fn, *args) -> float:
-    """Median wall time (ms) of a jitted call, post-warmup."""
-    out = fn(*args)
-    jax.block_until_ready(out)
-    times = []
-    for _ in range(REPS):
+def timeit(step, x0) -> float:
+    """Per-application device time (ms) of ``step`` (same-shape
+    array→array), measured as ONE jitted ``lax.scan`` chaining each
+    output into the next input, REPS applications per dispatch.
+
+    Why this shape: per-call host blocking through the PJRT tunnel
+    costs a tens-of-ms RPC round-trip that swamps sub-ms kernels, and
+    re-dispatching the same (fn, args) lets the runtime overlap or
+    elide work — both produced nonsense numbers here (a 148 MB LRN
+    "measured" at 0.015 ms ≈ 20 TB/s).  The scan's carry dependency
+    defeats loop-invariant hoisting and dead-code elimination, so the
+    total is genuinely REPS sequential applications; one dispatch
+    amortizes the tunnel to noise.  Best of 3 passes."""
+    @jax.jit
+    def run(x):
+        def body(carry, _):
+            return step(carry).astype(x0.dtype), None
+        y, _ = jax.lax.scan(body, x, xs=None, length=REPS)
+        return y
+    # every pass gets a DISTINCT input: repeated identical
+    # (executable, args) dispatches were observed returning at
+    # dispatch cost through the tunnel (148 MB LRN "in" 0.4 µs),
+    # consistent with result-handle caching somewhere below us
+    variants = [jnp.asarray(np.asarray(x0) * (1.0 + i * 1e-6))
+                for i in range(4)]
+    jax.block_until_ready(run(variants[-1]))  # compile + warm
+    per_call = []
+    for i in range(3):
         start = time.perf_counter()
-        out = fn(*args)
-        jax.block_until_ready(out)
-        times.append((time.perf_counter() - start) * 1e3)
-    return float(np.median(times))
+        jax.block_until_ready(run(variants[i]))
+        per_call.append((time.perf_counter() - start) * 1e3 / REPS)
+    return float(min(per_call))
 
 
 def lrn_fwd_xla(x):
@@ -98,15 +119,21 @@ def main() -> None:
             "winner": winner, "note": note}), flush=True)
 
     # -- LRN (128, 55, 55, 96) -----------------------------------------
+    # chained steps: LRN output is same-shape and contraction keeps
+    # the carry bounded; the backward chains the error cotangent
     x = jnp.asarray(rng.normal(size=(128, 55, 55, 96)).astype(np.float32))
     err = jnp.asarray(rng.normal(size=x.shape).astype(np.float32))
     record("lrn_fwd",
-           timeit(jax.jit(lrn_fwd_xla), x),
-           timeit(jax.jit(functools.partial(pk.lrn_forward, **LRN)), x))
+           timeit(lrn_fwd_xla, x),
+           timeit(functools.partial(pk.lrn_forward, **LRN), x))
+    # perturb x by the carried error so the d = k + α·Σx² chain can't
+    # be hoisted out of the scan as loop-invariant (it would only
+    # depend on the captured constant x otherwise); both variants get
+    # the identical perturbed operand
     record("lrn_bwd",
-           timeit(jax.jit(lrn_bwd_xla), x, err),
-           timeit(jax.jit(functools.partial(pk.lrn_backward, **LRN)),
-                  x, err))
+           timeit(lambda e: lrn_bwd_xla(x + 1e-6 * e, e), err),
+           timeit(lambda e: pk.lrn_backward(x + 1e-6 * e, e, **LRN),
+                  err))
 
     # -- dropout (128, 4096) -------------------------------------------
     xd = jnp.asarray(rng.normal(size=(128, 4096)).astype(np.float32))
@@ -115,10 +142,18 @@ def main() -> None:
     # sanity: keep fraction ≈ 0.5 on real hardware
     kept = float((np.asarray(pk.dropout_apply(xd, seed, 0.5)) != 0).mean())
     assert 0.45 < kept < 0.55, f"pallas dropout keep fraction {kept}"
+    # derive the PRNG seed/key from the carry: with the captured
+    # constant key the whole bernoulli mask is loop-invariant and XLA
+    # hoists it out of the scan, timing only the multiply
+    def _carry_salt(c):
+        return c[0, 0].view(jnp.int32) if c.dtype == jnp.float32 \
+            else c[0, 0].astype(jnp.int32)
+
     record("dropout_mask_apply",
-           timeit(jax.jit(dropout_xla), key, xd),
-           timeit(jax.jit(functools.partial(
-               pk.dropout_apply, drop_ratio=0.5)), xd, seed),
+           timeit(lambda c: dropout_xla(
+               jax.random.fold_in(key, _carry_salt(c)), c), xd),
+           timeit(lambda c: pk.dropout_apply(
+               c, seed + _carry_salt(c), 0.5), xd),
            note=f"pallas keep fraction {kept:.3f}")
 
     # -- softmax+argmax (128, 1000) ------------------------------------
@@ -128,9 +163,18 @@ def main() -> None:
     np.testing.assert_allclose(np.asarray(probs_p), np.asarray(probs_x),
                                rtol=1e-5, atol=1e-6)
     assert (np.asarray(idx_p) == np.asarray(idx_x)).all()
+    # chain the probabilities; fold argmax into the carry at 1e-12
+    # scale so neither output is dead code (×0.0 would be folded away
+    # by the algebraic simplifier)
+    def _sm_step(fn):
+        def step(c):
+            probs, idx = fn(c)
+            return probs + idx[:, None].astype(probs.dtype) * 1e-12
+        return step
+
     record("softmax_argmax",
-           timeit(jax.jit(softmax_argmax_xla), v),
-           timeit(jax.jit(pk.softmax_argmax), v))
+           timeit(_sm_step(softmax_argmax_xla), v),
+           timeit(_sm_step(pk.softmax_argmax), v))
 
     # -- stochastic pooling (train), XLA path for the record -----------
     from znicz_tpu.ops.pooling import StochasticPooling
@@ -155,8 +199,18 @@ def main() -> None:
         return jnp.take_along_axis(
             wins0, idx[:, :, :, None, :], axis=3)[:, :, :, 0, :]
 
+    def pool_step(c):
+        # chain the (n,27,27,96) pool output back into the (n,55,55,96)
+        # carry: zero-pad + average keeps the carry bounded and the
+        # dependency real; the pad/add is noise next to the pool
+        out = stoch_pool(jax.random.fold_in(key, c[0, 0, 0, 0].view(
+            jnp.int32)), c)
+        padded = jnp.pad(out, ((0, 0), (0, c.shape[1] - out.shape[1]),
+                               (0, c.shape[2] - out.shape[2]), (0, 0)))
+        return 0.5 * c + 0.5 * padded
+
     record("stochastic_pool_train",
-           timeit(jax.jit(stoch_pool), key, x), None,
+           timeit(pool_step, x), None,
            note="no pallas variant: gather+normalize+sample already "
                 "fuses to one XLA kernel; a hand kernel would re-derive "
                 "the same VMEM pass")

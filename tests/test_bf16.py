@@ -50,6 +50,31 @@ def test_bf16_trains_to_convergence():
     assert wf.decision.min_validation_n_err_pt <= 10.0
 
 
+def test_bf16_activation_storage_and_chunked_scan():
+    """bf16 mode stores activations/error tensors in bfloat16 (the
+    bandwidth half of mixed precision) and the dtype contract holds
+    through the scanned chunk path: scan carries must be dtype-stable,
+    which regressed once when the devmem setter's float-dtype probe
+    rejected ml_dtypes bfloat16 (np.finfo raises on it)."""
+    import jax.numpy as jnp
+
+    root.common.precision_type = "bfloat16"
+    prng.seed_all(9)
+    wf = _build()
+    wf.initialize(device=XLADevice())
+    bf16 = np.dtype(jnp.bfloat16)
+    conv = wf.forwards[0]
+    assert conv.output.dtype == bf16
+    assert wf.forwards[-1].output.dtype == np.float32  # softmax stays
+    allocated_errs = [gd.err_input for gd in wf.gds if gd.err_input]
+    assert allocated_errs and all(v.dtype == bf16 for v in allocated_errs)
+    # scanned chunks: would raise a scan carry-type mismatch if any
+    # unit wrote f32 into a bf16-declared vector
+    wf.run_chunked(steps_per_dispatch=2)
+    assert conv.output.devmem.dtype == bf16
+    assert wf.decision.min_validation_n_err_pt <= 10.0
+
+
 def test_bf16_close_to_f32_one_epoch():
     """bf16 training lands within mixed-precision noise of f32."""
     errs = {}

@@ -127,7 +127,9 @@ class ImageLoader(Loader):
     Subclasses (or callers of :class:`FileImageLoader`) provide
     ``file_paths`` (global-index-aligned: test, validation, train) and
     ``file_labels``.  Each step decodes the scheduled files into
-    ``minibatch_data`` (float32 NHWC, or NHW when ``grayscale``);
+    ``minibatch_data`` (NHWC, or NHW when ``grayscale``; raw uint8
+    pixels upload and the affine normalize runs on-device into the
+    activation-storage dtype);
     train minibatches optionally get random-crop/flip augmentation
     (reference's scale/crop options) while eval gets center crops.
 
@@ -160,6 +162,11 @@ class ImageLoader(Loader):
         self.use_native = use_native
         self.file_paths: list[str] = []
         self.file_labels: list[int] = []
+        #: raw uint8 host staging buffer — decoded pixels upload
+        #: un-normalized (4× smaller host→device transfer); the affine
+        #: normalize runs on-device in xla_run
+        self.minibatch_raw = Vector(name=f"{self.name}.minibatch_raw",
+                                    batch_major=True)
         self._pipe = None
         self._spare: np.ndarray | None = None   # prefetch target
         self._pending: tuple[int, int] | None = None  # (epoch, cursor)
@@ -181,14 +188,20 @@ class ImageLoader(Loader):
         h, w = self.out_hw
         return (h, w) if self.grayscale else (h, w, 3)
 
+    # minibatch_raw is a transient staging buffer like the rest
+    SNAPSHOT_EXCLUDE = Loader.SNAPSHOT_EXCLUDE + ("minibatch_raw",)
+
     def create_minibatch_data(self) -> None:
         shape = (self.max_minibatch_size,) + self.sample_shape
-        self.minibatch_data.reset(np.zeros(shape, dtype=np.float32))
+        self.minibatch_raw.reset(np.zeros(shape, dtype=np.uint8))
+        self.minibatch_data.reset(np.zeros(shape,
+                                           dtype=self.act_store_dtype))
         self.minibatch_labels.reset(
             np.zeros(self.max_minibatch_size, dtype=np.int32))
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
+        self.init_vectors(self.minibatch_raw)
         use_native = self.use_native
         if use_native is None:
             from znicz_tpu.native import ImagePipeline
@@ -199,7 +212,7 @@ class ImageLoader(Loader):
             from znicz_tpu.native import ImagePipeline
             self._pipe = ImagePipeline(self.n_threads)
             if self.prefetch:
-                self._spare = np.zeros_like(self.minibatch_data.mem)
+                self._spare = np.zeros_like(self.minibatch_raw.mem)
         else:
             self._pipe = None
         self._pil_rng = np.random.default_rng(
@@ -223,8 +236,7 @@ class ImageLoader(Loader):
         self._pipe.submit(
             paths, out, out_hw=self.out_hw, resize_hw=self.resize_hw,
             channels=self.channels, random_crop=crop, random_flip=flip,
-            scale=self.normalization_scale,
-            bias=self.normalization_bias, seed=seed)
+            seed=seed)  # raw uint8 out; normalize runs on-device
 
     def _decode_sync(self, idx: np.ndarray, minibatch_class: int,
                      out: np.ndarray, seed: int) -> None:
@@ -236,10 +248,10 @@ class ImageLoader(Loader):
             return
         crop, flip = self._augment_flags(minibatch_class)
         for row, i in enumerate(idx):
-            out[row] = _decode_pil(
+            out[row] = np.rint(_decode_pil(
                 self.file_paths[i], self.out_hw, self.resize_hw,
-                self.channels, crop, flip, self.normalization_scale,
-                self.normalization_bias, self._pil_rng)
+                self.channels, crop, flip, 1.0, 0.0,
+                self._pil_rng)).astype(np.uint8)
 
     def _peek_next(self) -> tuple[np.ndarray, int] | None:
         """Indices + class of the NEXT schedule entry, or None at the
@@ -265,8 +277,8 @@ class ImageLoader(Loader):
         super().host_run()  # picks indices, epoch bookkeeping
         idx = self._host_indices
         cur = (self.epoch_number, self._cursor - 1)
-        self.minibatch_data.map_invalidate()
-        out = self.minibatch_data.mem
+        self.minibatch_raw.map_invalidate()
+        out = self.minibatch_raw.mem
         if self._pipe is not None and self.prefetch \
                 and self._pending == cur:
             n_failed = self._pipe.wait()
@@ -291,16 +303,25 @@ class ImageLoader(Loader):
                                                self._cursor))
                 self._pending = (self.epoch_number, self._cursor)
         if self.device is not None and not self.device.is_host_only:
-            self.minibatch_data.unmap()
+            self.minibatch_raw.unmap()
             self.minibatch_labels.unmap()
 
-    # data is staged host-side; the device path is just the upload that
-    # host_run's unmap already queued
+    # raw uint8 pixels are staged host-side and uploaded by host_run's
+    # unmap; the device path applies the affine normalize (fused into
+    # the jit region, writing the activation-storage dtype)
     def numpy_run(self) -> None:
-        pass
+        self.minibatch_raw.map_read()
+        self.minibatch_data.map_invalidate()
+        self.minibatch_data.mem[...] = (
+            self.minibatch_raw.mem.astype(np.float32)
+            * np.float32(self.normalization_scale)
+            + np.float32(self.normalization_bias))
 
     def xla_run(self) -> None:
-        pass
+        import jax.numpy as jnp
+        self.minibatch_data.devmem = (
+            self.minibatch_raw.devmem.astype(jnp.float32)
+            * self.normalization_scale + self.normalization_bias)
 
 
 class FileImageLoader(ImageLoader):

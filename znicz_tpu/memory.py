@@ -31,11 +31,13 @@ if TYPE_CHECKING:  # pragma: no cover
 
 def _is_float_dtype(dt: np.dtype) -> bool:
     """True for any float dtype incl. the ml_dtypes ones (bfloat16
-    reports numpy kind 'V', so ``np.issubdtype`` can't be used)."""
+    reports numpy kind 'V' and ``np.finfo`` rejects it, so probe with
+    ``ml_dtypes.finfo``, which covers the numpy floats too)."""
     if np.issubdtype(dt, np.floating):
         return True
     try:
-        np.finfo(dt)
+        import ml_dtypes
+        ml_dtypes.finfo(dt)
         return True
     except ValueError:
         return False

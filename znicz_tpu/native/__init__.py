@@ -81,7 +81,7 @@ def _build() -> ctypes.CDLL:
         ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_int, ctypes.c_int, ctypes.c_int,
         ctypes.c_float, ctypes.c_float, ctypes.c_uint64,
-        ctypes.POINTER(ctypes.c_float)]
+        ctypes.c_void_p, ctypes.c_int]
     lib.zp_wait.restype = ctypes.c_int
     lib.zp_wait.argtypes = [ctypes.c_void_p]
     return lib
@@ -141,8 +141,13 @@ class ImagePipeline:
         out_h, out_w = out_hw
         expected = (n, out_h, out_w, channels) if channels == 3 \
             else (n, out_h, out_w)
-        if out.dtype != np.float32 or not out.flags.c_contiguous:
-            raise ValueError("out must be C-contiguous float32")
+        if out.dtype not in (np.float32, np.uint8) \
+                or not out.flags.c_contiguous:
+            raise ValueError("out must be C-contiguous float32 or uint8")
+        out_u8 = out.dtype == np.uint8
+        if out_u8 and (scale != 1.0 or bias != 0.0):
+            raise ValueError("uint8 output is raw pixels — normalize "
+                             "on-device (scale/bias must be 1/0)")
         if out.size != n * out_h * out_w * channels:
             raise ValueError(f"out size {out.shape} != {expected}")
         arr = (ctypes.c_char_p * n)(
@@ -153,7 +158,7 @@ class ImagePipeline:
             int(random_crop), int(random_flip),
             ctypes.c_float(scale), ctypes.c_float(bias),
             ctypes.c_uint64(seed & (2 ** 64 - 1)),
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+            ctypes.c_void_p(out.ctypes.data), ctypes.c_int(out_u8))
         if rc != 0:
             raise RuntimeError(f"zp_submit failed (rc={rc})")
         # paths array and out buffer must outlive the async batch

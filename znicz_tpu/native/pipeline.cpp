@@ -182,7 +182,9 @@ struct Job {
   int random_flip = 0; // 1 = coin-flip horizontal mirror (train aug)
   float scale = 1.0f, bias = 0.0f;
   uint64_t seed = 0;
-  float *out = nullptr;
+  void *out = nullptr;
+  int out_u8 = 0;  // 1 → raw uint8 output, scale/bias ignored
+                   // (device-side normalize: 4× smaller upload)
 };
 
 struct Pool {
@@ -219,10 +221,14 @@ struct Pool {
     const Job &j = job;
     const size_t sample_sz =
         static_cast<size_t>(j.out_h) * j.out_w * j.channels;
-    float *dst = j.out + sample_sz * i;
+    const size_t elem = j.out_u8 ? sizeof(uint8_t) : sizeof(float);
+    uint8_t *dst_raw = static_cast<uint8_t *>(j.out) +
+                       sample_sz * elem * i;
+    float *dst = reinterpret_cast<float *>(dst_raw);
+    uint8_t *dst8 = dst_raw;
     Image img;
     if (!decode_any(j.paths[i], img) || img.w < 1 || img.h < 1) {
-      std::memset(dst, 0, sample_sz * sizeof(float));
+      std::memset(dst_raw, 0, sample_sz * elem);
       failed.fetch_add(1);
       return;
     }
@@ -244,7 +250,7 @@ struct Pool {
     // crop window
     int max_dx = bw - j.out_w, max_dy = bh - j.out_h;
     if (max_dx < 0 || max_dy < 0) {  // undersized source: refuse
-      std::memset(dst, 0, sample_sz * sizeof(float));
+      std::memset(dst_raw, 0, sample_sz * elem);
       failed.fetch_add(1);
       return;
     }
@@ -259,17 +265,28 @@ struct Pool {
       dy = max_dy / 2;
     }
     if (j.random_flip) flip = (splitmix64(rng) & 1) != 0;
-    // crop + (flip) + normalize into float32 NHWC
+    // crop + (flip) + store: normalized float32 NHWC, or raw uint8
+    // NHWC when out_u8 (the normalize then happens on-device)
     for (int y = 0; y < j.out_h; ++y) {
       const uint8_t *row =
           base + (static_cast<size_t>(dy + y) * bw + dx) * 3;
-      float *drow = dst + static_cast<size_t>(y) * j.out_w * j.channels;
+      const size_t row_off = static_cast<size_t>(y) * j.out_w * j.channels;
+      float *drow = dst + row_off;
+      uint8_t *drow8 = dst8 + row_off;
       for (int x = 0; x < j.out_w; ++x) {
         int sxp = flip ? (j.out_w - 1 - x) : x;
         const uint8_t *p = row + static_cast<size_t>(sxp) * 3;
         if (j.channels == 1) {
           float luma = 0.299f * p[0] + 0.587f * p[1] + 0.114f * p[2];
-          drow[x] = luma * j.scale + j.bias;
+          if (j.out_u8)
+            drow8[x] = static_cast<uint8_t>(luma + 0.5f);
+          else
+            drow[x] = luma * j.scale + j.bias;
+        } else if (j.out_u8) {
+          uint8_t *d = drow8 + static_cast<size_t>(x) * 3;
+          d[0] = p[0];
+          d[1] = p[1];
+          d[2] = p[2];
         } else {
           float *d = drow + static_cast<size_t>(x) * 3;
           d[0] = p[0] * j.scale + j.bias;
@@ -344,7 +361,7 @@ void zp_destroy(void *pool) { delete static_cast<Pool *>(pool); }
 int zp_submit(void *pool, const char *const *paths, int n, int resize_h,
               int resize_w, int out_h, int out_w, int channels,
               int random_crop, int random_flip, float scale, float bias,
-              uint64_t seed, float *out) {
+              uint64_t seed, void *out, int out_u8) {
   if (!pool || n < 0 || out_h <= 0 || out_w <= 0 ||
       (channels != 1 && channels != 3))
     return -1;
@@ -362,6 +379,7 @@ int zp_submit(void *pool, const char *const *paths, int n, int resize_h,
   j.bias = bias;
   j.seed = seed;
   j.out = out;
+  j.out_u8 = out_u8;
   return static_cast<Pool *>(pool)->submit(j);
 }
 

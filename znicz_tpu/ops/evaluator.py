@@ -188,9 +188,12 @@ class EvaluatorMSE(EvaluatorBase):
         self.epoch_sse.mem[int(self.minibatch_class)] += sse
 
     def xla_run(self) -> None:
-        y = self.output.devmem
+        # f32 math regardless of the activation storage dtype: the SSE
+        # reduction over the whole minibatch would swamp small terms in
+        # bf16, and the decision unit selects models on this number
+        y = self.output.devmem.astype(jnp.float32)
         batch = y.shape[0]
-        t = self.target.devmem.reshape(batch, -1).astype(y.dtype)
+        t = self.target.devmem.reshape(batch, -1).astype(jnp.float32)
         y2 = y.reshape(batch, -1)
         mask, valid = self._valid_mask(jnp, batch)
         diff = mask[:, None] * (y2 - t)

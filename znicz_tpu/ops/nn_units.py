@@ -109,6 +109,18 @@ class Forward(AcceleratedUnit):
             return gen.fill_normal(arr_shape, 0.0, stddev, dtype=np.float32)
         if filling == "constant":
             return np.full(arr_shape, stddev, dtype=np.float32)
+        # variance-preserving fillings (stddev argument ignored):
+        # the reference's fixed-stddev fillings assume shallow nets or
+        # ImageNet-scale horizons; deep ReLU stacks need fan-scaled
+        # init to keep forward/backward variance O(1)
+        if filling == "he":  # ReLU family
+            return gen.fill_normal(arr_shape, 0.0,
+                                   float(np.sqrt(2.0 / max(1, fan_in))),
+                                   dtype=np.float32)
+        if filling == "xavier":  # tanh/sigmoid/linear family
+            return gen.fill_normal(arr_shape, 0.0,
+                                   float(np.sqrt(1.0 / max(1, fan_in))),
+                                   dtype=np.float32)
         raise ValueError(f"unknown filling '{filling}'")
 
     @property
