@@ -132,10 +132,25 @@ class XLADevice(Device):
     def sharding_for(self, vector) -> "jax.sharding.Sharding | None":
         if self.mesh is None:
             return None
-        from znicz_tpu.parallel import batch_sharding, replicated_sharding
-        if vector is not None and vector.batch_major:
-            return batch_sharding(self.mesh)
-        return replicated_sharding(self.mesh)
+        from jax.sharding import NamedSharding, PartitionSpec
+        from znicz_tpu.parallel import replicated_sharding
+        from znicz_tpu.parallel.axis import DATA_AXIS, MODEL_AXIS
+        if vector is None:
+            return replicated_sharding(self.mesh)
+        model_dim = getattr(vector, "model_shard_dim", None)
+        if not vector.batch_major and model_dim is None:
+            return replicated_sharding(self.mesh)
+        ndim = len(vector.shape)
+        spec: list = [None] * ndim
+        if vector.batch_major and ndim:
+            spec[0] = DATA_AXIS
+        if model_dim is not None:
+            if model_dim == 0 and vector.batch_major:
+                raise ValueError(
+                    f"Vector '{vector.name}': dim 0 is the batch (data"
+                    f"-sharded) — it cannot also carry the model axis")
+            spec[model_dim] = MODEL_AXIS
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
 
     def put(self, arr: np.ndarray, vector=None):
         if self.jax_device.platform == "cpu":

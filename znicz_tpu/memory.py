@@ -54,10 +54,11 @@ class Vector:
     """Host-mirrored device buffer with explicit sync points."""
 
     __slots__ = ("_mem", "_devmem", "_state", "_device", "_tracing", "name",
-                 "batch_major")
+                 "batch_major", "model_shard_dim")
 
     def __init__(self, mem: np.ndarray | None = None,
-                 name: str = "", batch_major: bool = False) -> None:
+                 name: str = "", batch_major: bool = False,
+                 model_shard_dim: int | None = None) -> None:
         self._mem: np.ndarray | None = None
         self._devmem = None
         self._state = _State.EMPTY
@@ -67,6 +68,11 @@ class Vector:
         #: first dim is the minibatch — shard it over the mesh's data
         #: axis when the device carries one (SPMD data parallelism)
         self.batch_major = batch_major
+        #: dim sharded over the mesh's MODEL axis (tensor parallelism:
+        #: column/row-parallel weights and feature-sharded activations);
+        #: None = replicated over model.  Set before ``initialize`` —
+        #: the device reads it when placing the buffer
+        self.model_shard_dim = model_shard_dim
         if mem is not None:
             self.reset(mem)
 

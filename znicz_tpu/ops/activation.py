@@ -32,6 +32,7 @@ class ActivationForward(Forward):
             raise AttributeError(f"{self}: input not linked yet")
         self.output.reset(np.zeros(self.input.shape,
                                    dtype=self.output_store_dtype))
+        self.inherit_model_shard(self.output)
         self.init_vectors(self.input, self.output)
 
     def numpy_run(self) -> None:

@@ -26,20 +26,76 @@ class All2All(Forward):
 
     ``output_sample_shape`` is the per-sample output shape (an int or
     tuple), mirroring the reference's constructor.
+
+    ``model_parallel`` (Megatron-style tensor parallelism over the
+    mesh's MODEL axis — beyond the reference, which only scaled via
+    data parallelism):
+
+    - ``"column"``: weights shard (n_in, n_out/m); output features
+      shard over model.  Bias shards with the features.
+    - ``"row"``: weights shard (n_in/m, n_out); expects a feature-
+      sharded input (a preceding column layer) and produces a
+      replicated-over-model output — GSPMD inserts the psum.
+    - ``None`` (default): replicated weights, pure data parallelism.
+
+    Annotation-only: the GEMMs are unchanged, ``sharding_for`` places
+    the buffers, and XLA's partitioner derives the collectives
+    (all-gather/reduce-scatter over ICI).  On a mesh with model=1 or
+    no mesh at all the annotations are no-ops.
     """
 
     ACTIVATION = "linear"
 
-    def __init__(self, workflow, output_sample_shape, name=None, **kwargs):
+    def __init__(self, workflow, output_sample_shape, name=None,
+                 model_parallel: str | None = None, **kwargs):
         super().__init__(workflow, name=name, **kwargs)
         if isinstance(output_sample_shape, (int, np.integer)):
             output_sample_shape = (int(output_sample_shape),)
         self.output_sample_shape = tuple(output_sample_shape)
         self.activation = activations_math.get(self.ACTIVATION)
+        if model_parallel not in (None, "column", "row"):
+            raise ValueError(f"{self}: model_parallel must be None, "
+                             f"'column' or 'row', got {model_parallel!r}")
+        if model_parallel is not None \
+                and len(self.output_sample_shape) != 1:
+            # the column split partitions the FLATTENED n_out; a
+            # multi-dim sample shape would shard the wrong physical dim
+            raise ValueError(
+                f"{self}: model_parallel requires a 1-D "
+                f"output_sample_shape, got {self.output_sample_shape}")
+        self.model_parallel = model_parallel
 
     @property
     def neurons(self) -> int:
         return int(np.prod(self.output_sample_shape))
+
+    def _apply_model_parallel(self, n_in: int, n_out: int) -> None:
+        """Set model-axis sharding dims on weights/bias/output before
+        the device places them (no-op without a model axis)."""
+        if self.model_parallel is None:
+            return
+        n_model = 1
+        mesh = getattr(self.device, "mesh", None)
+        if mesh is not None:
+            from znicz_tpu.parallel.axis import MODEL_AXIS
+            n_model = mesh.shape.get(MODEL_AXIS, 1)
+        if self.model_parallel == "column":
+            if n_out % n_model:
+                raise ValueError(
+                    f"{self}: column-parallel n_out {n_out} not "
+                    f"divisible by model axis size {n_model}")
+            self.weights.model_shard_dim = 1
+            if self.include_bias:
+                self.bias.model_shard_dim = 0
+            # output features ride the model axis: (batch, n_out/m)
+            self.output.model_shard_dim = 1  # 1-D sample shape enforced
+        else:  # row
+            if n_in % n_model:
+                raise ValueError(
+                    f"{self}: row-parallel n_in {n_in} not divisible "
+                    f"by model axis size {n_model}")
+            self.weights.model_shard_dim = 0
+            # bias replicated: added after the psum; output replicated
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
@@ -57,6 +113,7 @@ class All2All(Forward):
         batch = self.input.shape[0]
         self.output.reset(np.zeros((batch,) + self.output_sample_shape,
                                    dtype=self.output_store_dtype))
+        self._apply_model_parallel(n_in, n_out)
         self.init_vectors(self.input, self.output, self.weights, self.bias)
 
     # -- math (shared shape logic; xp-generic) --------------------------

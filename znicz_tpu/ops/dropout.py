@@ -45,6 +45,7 @@ class DropoutForward(Forward):
                                    dtype=self.output_store_dtype))
         self.mask.reset(np.ones(self.input.shape,
                                 dtype=self.act_store_dtype))
+        self.inherit_model_shard(self.output, self.mask)
         self.init_vectors(self.input, self.output, self.mask)
         self.init_rng()
 

@@ -34,6 +34,7 @@ class MeanDispNormalizer(Forward):
             raise AttributeError(f"{self}: rdisp not linked/set")
         self.output.reset(np.zeros(self.input.shape,
                                    dtype=self.output_store_dtype))
+        self.inherit_model_shard(self.output)
         self.init_vectors(self.input, self.output, self.mean, self.rdisp)
 
     def numpy_run(self) -> None:

@@ -135,6 +135,17 @@ class Forward(AcceleratedUnit):
         evaluator)."""
         return self.act_store_dtype
 
+    def inherit_model_shard(self, *vectors) -> None:
+        """Copy the input's model-axis sharding to same-shaped output
+        vectors.  Every shape-preserving (elementwise) forward should
+        call this after allocating its outputs so tensor-parallel
+        feature sharding passes through instead of silently degrading
+        to replicated (which would make GSPMD all-gather the
+        activations between a column and row layer every step)."""
+        model_dim = getattr(self.input, "model_shard_dim", None)
+        for vec in vectors:
+            vec.model_shard_dim = model_dim
+
 
 # ----------------------------------------------------------------------
 # GradientDescent base
@@ -207,6 +218,11 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
                 and self.input and not self.err_input):
             self.err_input.reset(np.zeros(self.input.shape,
                                           dtype=self.act_store_dtype))
+            # the error cotangent shards like the tensor it's the
+            # gradient of (tensor parallelism: feature-sharded
+            # activations get feature-sharded errors)
+            self.err_input.model_shard_dim = getattr(
+                self.input, "model_shard_dim", None)
         if not self.need_err_input and (self.weights is None
                                         or not self.weights):
             # weightless AND nothing upstream wants the error: the unit
@@ -218,10 +234,14 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             if self.weights is not None and self.weights:
                 self.accumulated_gradient_weights.reset(
                     np.zeros(self.weights.shape, dtype=np.float32))
+                self.accumulated_gradient_weights.model_shard_dim = \
+                    getattr(self.weights, "model_shard_dim", None)
             if (self.bias is not None and self.bias
                     and self.gradient_moment_bias):
                 self.accumulated_gradient_bias.reset(
                     np.zeros(self.bias.shape, dtype=np.float32))
+                self.accumulated_gradient_bias.model_shard_dim = \
+                    getattr(self.bias, "model_shard_dim", None)
             self.init_vectors(self.accumulated_gradient_weights,
                               self.accumulated_gradient_bias)
 

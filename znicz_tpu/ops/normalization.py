@@ -64,6 +64,7 @@ class LRNormalizerForward(Forward):
             raise AttributeError(f"{self}: input not linked yet")
         self.output.reset(np.zeros(self.input.shape,
                                    dtype=self.output_store_dtype))
+        self.inherit_model_shard(self.output)
         self.init_vectors(self.input, self.output)
         from znicz_tpu.ops import pallas_kernels
         self._use_pallas = pallas_kernels.use_pallas(self.device)

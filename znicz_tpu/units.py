@@ -173,7 +173,20 @@ class Unit(Logger):
                 continue
             if isinstance(val, Vector) and val:
                 if val.needs_collective_read:
-                    # Multi-process sharded buffers are per-minibatch
+                    if not val.batch_major:
+                        # Persistent sharded state (tensor-parallel
+                        # weights/momentum) CANNOT be silently skipped
+                        # — resuming would restore fresh random init
+                        # for just these layers.  Reading it would
+                        # all-gather, which deadlocks on master-only
+                        # snapshot paths, so fail loudly instead.
+                        raise NotImplementedError(
+                            f"{self}: snapshotting model-sharded "
+                            f"Vector '{val.name}' in a multi-process "
+                            f"run is not supported yet — snapshots "
+                            f"must run from every process in lockstep "
+                            f"for tensor-parallel state")
+                    # Batch-sharded buffers are per-minibatch
                     # transients (loader/forward/err chains refill them
                     # before any consumer on resume); reading one here
                     # would all-gather — a deadlock from master-only
