@@ -37,9 +37,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-#: batch 256 is the measured sweet spot on v5e (sweep in PERF.md:
-#: 128→0.194 MFU, 256→0.31, 512→0.31-at-2× step time)
-BATCH = int(os.environ.get("BENCH_BATCH", "256"))
+#: batch 384 is the measured sweet spot on v5e with bf16 activation
+#: storage (sweep in PERF.md: 256→0.327, 384→0.331, 512→0.320)
+BATCH = int(os.environ.get("BENCH_BATCH", "384"))
 INPUT_MODE = os.environ.get("BENCH_INPUT", "resident")  # resident|stream
 #: steps per device dispatch (lax.scan chunk; device-resident schedule).
 #: 1 = per-step dispatch (round-2 behavior).  Streaming input is

@@ -155,3 +155,24 @@ def test_need_err_input_false_skips_allocation():
     fwd.run()
     bwd.run()
     assert not bwd.err_input
+
+
+def test_variance_preserving_fillings():
+    """he / xavier fillings scale with fan-in (added beyond the
+    reference's fixed-stddev uniform/gaussian/constant set; used by
+    benchmarks/bf16_convergence.py for short-horizon training)."""
+    from znicz_tpu.dummy import DummyWorkflow
+    from znicz_tpu.ops.all2all import All2All
+    from znicz_tpu.utils import prng
+
+    prng.seed_all(3)
+    unit = All2All(DummyWorkflow(), output_sample_shape=8)
+    fan_in = 4096
+    he = unit.fill_array((fan_in, 64), "he", None, fan_in=fan_in)
+    xavier = unit.fill_array((fan_in, 64), "xavier", None, fan_in=fan_in)
+    np.testing.assert_allclose(he.std(), np.sqrt(2.0 / fan_in), rtol=0.05)
+    np.testing.assert_allclose(xavier.std(), np.sqrt(1.0 / fan_in),
+                               rtol=0.05)
+    assert abs(he.mean()) < 3 * he.std() / np.sqrt(he.size)
+    with pytest.raises(ValueError, match="unknown filling"):
+        unit.fill_array((4, 4), "nope", None, fan_in=4)

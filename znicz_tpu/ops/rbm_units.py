@@ -48,6 +48,7 @@ class Binarization(Forward):
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
         self.output.reset(np.zeros(self.input.shape, dtype=np.float32))
+        self.inherit_model_shard(self.output)
         self.init_vectors(self.input, self.output)
         self.init_rng()
 
