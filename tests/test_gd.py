@@ -161,12 +161,10 @@ def test_variance_preserving_fillings():
     """he / xavier fillings scale with fan-in (added beyond the
     reference's fixed-stddev uniform/gaussian/constant set; used by
     benchmarks/bf16_convergence.py for short-horizon training)."""
-    from znicz_tpu.dummy import DummyWorkflow
-    from znicz_tpu.ops.all2all import All2All
     from znicz_tpu.utils import prng
 
     prng.seed_all(3)
-    unit = All2All(DummyWorkflow(), output_sample_shape=8)
+    unit = all2all.All2All(DummyWorkflow(), output_sample_shape=8)
     fan_in = 4096
     he = unit.fill_array((fan_in, 64), "he", None, fan_in=fan_in)
     xavier = unit.fill_array((fan_in, 64), "xavier", None, fan_in=fan_in)
