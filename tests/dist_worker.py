@@ -21,12 +21,17 @@ import json
 import sys
 
 
-def build_workflow():
+def build_workflow(tp_dir: "str | None" = None):
     """Tiny blob-classification MLP, mirroring the layer/optimizer
     config of ``tests/test_parallel.build``.  The data generator is
     duplicated here on purpose: importing ``tests.conftest`` (where
     ``make_blobs`` lives) would pin 8 virtual devices per process at
-    import time, while this worker needs exactly 2."""
+    import time, while this worker needs exactly 2.
+
+    ``tp_dir``: tensor-parallel variant — the hidden FC pair goes
+    column+row over the global mesh's model axis and a Snapshotter
+    writes into this directory (the lockstep collective-read snapshot
+    path for model-sharded state)."""
     import numpy as np
 
     from znicz_tpu.loader.fullbatch import ArrayLoader
@@ -50,12 +55,21 @@ def build_workflow():
             valid_data=data[n_train:], valid_labels=labels[n_train:],
             minibatch_size=24),
         layers=[
-            {"type": "all2all_tanh", "->": {"output_sample_shape": 16},
+            {"type": "all2all_tanh",
+             "->": {"output_sample_shape": 16,
+                    "model_parallel": "column" if tp_dir else None},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+            {"type": "all2all_tanh",
+             "->": {"output_sample_shape": 12,
+                    "model_parallel": "row" if tp_dir else None},
              "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
             {"type": "softmax", "->": {"output_sample_shape": n_classes},
              "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
         ],
-        decision_config={"max_epochs": 3})
+        decision_config={"max_epochs": 3},
+        snapshotter_config=(
+            None if tp_dir is None
+            else {"prefix": "dist_tp", "directory": tp_dir}))
     wf._max_fires = 100_000
     return wf
 
@@ -65,6 +79,7 @@ def main() -> None:
     n_processes = int(sys.argv[2])
     coordinator = sys.argv[3]
     out_path = sys.argv[4]
+    tp_dir = sys.argv[5] if len(sys.argv) > 5 else None
 
     # 2 virtual CPU devices per process, configured BEFORE any jax use
     # (the container's sitecustomize already imported jax, so go
@@ -76,11 +91,13 @@ def main() -> None:
     from znicz_tpu.launcher import Launcher
     from znicz_tpu.utils import prng
 
+    n_model = 2 if tp_dir else 1
     if process_id == 0:
-        launcher = Launcher(listen=coordinator, n_processes=n_processes)
+        launcher = Launcher(listen=coordinator, n_processes=n_processes,
+                            n_model=n_model)
     else:
         launcher = Launcher(master=coordinator, n_processes=n_processes,
-                            process_id=process_id)
+                            process_id=process_id, n_model=n_model)
     assert launcher.mode == ("master" if process_id == 0 else "slave")
     assert jax.process_count() == n_processes
     assert len(jax.devices()) == 2 * n_processes
@@ -88,24 +105,38 @@ def main() -> None:
     prng.seed_all(1234)
 
     def run(load, main):  # reference sample protocol
-        load(build_workflow)
+        load(build_workflow, tp_dir=tp_dir)
         main()
 
     wf = launcher.boot(run)
 
     snapshot_keys = -1
-    if process_id == 0:
+    if process_id == 0 and tp_dir is None:
         # master-only snapshot: must NOT issue collective reads (the
         # slaves are not in lockstep here) — regression for the
         # Vector.needs_collective_read skip in Unit.state_dict
         state = wf.state_dict()
         snapshot_keys = sum(len(unit_state)
                             for unit_state in state["__units__"].values())
+    tp_snapshot_full_shapes = None
+    if tp_dir is not None:
+        # the Snapshotter unit ran in lockstep on every process — its
+        # file must hold the FULL (gathered) model-sharded weights
+        import glob as _glob
+
+        from znicz_tpu.utils.snapshotter import Snapshotter
+        files = sorted(_glob.glob(tp_dir + "/dist_tp_*.pickle.gz"))
+        assert files, "lockstep TP snapshot was not written"
+        state = Snapshotter.load(files[-1])
+        col = state["__units__"]["All2AllTanh"]["weights"]
+        row = state["__units__"]["All2AllTanh_2"]["weights"]
+        tp_snapshot_full_shapes = [list(col.shape), list(row.shape)]
 
     wf.forwards[0].weights.map_read()
     wf.forwards[1].weights.map_read()
     digest = {
         "snapshot_keys": snapshot_keys,
+        "tp_snapshot_full_shapes": tp_snapshot_full_shapes,
         "process_id": process_id,
         "mode": launcher.mode,
         "n_global_devices": len(jax.devices()),

@@ -88,3 +88,46 @@ def test_numpy_backend_rejected_in_distributed():
     launcher.coordinator = "127.0.0.1:1"  # simulate distributed mode
     with pytest.raises(ValueError, match="numpy"):
         launcher.make_device()
+
+
+@pytest.mark.slow
+def test_two_process_tp_lockstep_snapshot(tmp_path):
+    """Tensor parallelism across processes: 2 procs × 2 devices form a
+    (data=2, model=2) grid; column+row FCs shard over the model axis
+    and the in-graph Snapshotter (lockstep on every process) gathers
+    the model-sharded weights via the collective read.  Both processes
+    must agree on weights AND the snapshot must hold FULL shapes."""
+    coordinator = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    tp_dir = tmp_path / "snapshots"
+    tp_dir.mkdir()
+
+    procs, outs = [], []
+    for pid in range(N_PROCESSES):
+        out = tmp_path / f"digest_{pid}.json"
+        outs.append(out)
+        procs.append(subprocess.Popen(
+            [sys.executable, WORKER, str(pid), str(N_PROCESSES),
+             coordinator, str(out), str(tp_dir)],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    logs = []
+    try:
+        for proc in procs:
+            stdout, _ = proc.communicate(timeout=TIMEOUT_S)
+            logs.append(stdout)
+    except subprocess.TimeoutExpired:
+        for proc in procs:
+            proc.kill()
+        raise
+    for proc, log in zip(procs, logs):
+        assert proc.returncode == 0, f"worker failed:\n{log}"
+    digests = [json.loads(out.read_text()) for out in outs]
+    assert digests[0]["tp_snapshot_full_shapes"] == [[12, 16], [16, 12]]
+    assert digests[1]["tp_snapshot_full_shapes"] == [[12, 16], [16, 12]]
+    for key in ("w0_sum", "w1_sum", "w0_l2", "w1_l2",
+                "min_validation_n_err"):
+        assert digests[0][key] == digests[1][key], (key, digests)

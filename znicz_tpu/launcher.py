@@ -63,8 +63,12 @@ class Launcher(Logger):
                  web_status_host: str = "127.0.0.1",
                  load_kwargs: dict | None = None,
                  chunk: int = 1,
+                 n_model: int = 1,
                  **kwargs) -> None:
         super().__init__(**kwargs)
+        #: model-axis size for the global mesh (tensor parallelism over
+        #: the distributed device grid; 1 = pure DP)
+        self.n_model = int(n_model)
         #: steps per device dispatch (>1 → StandardWorkflow.run_chunked)
         self.chunk = int(chunk)
         self.backend = backend
@@ -130,6 +134,11 @@ class Launcher(Logger):
                     "host-only numpy oracle cannot join a device mesh "
                     "(each process would silently train an independent "
                     "replica)")
+            if not self.coordinator and self.n_model > 1:
+                raise ValueError(
+                    f"n_model={self.n_model} requires distributed mode "
+                    f"(--listen/--master builds the global mesh); a "
+                    f"standalone run would silently ignore it")
             if self.coordinator:
                 # Distributed mode: SPMD over the GLOBAL mesh (all
                 # hosts' devices); XLA lays the gradient all-reduce
@@ -138,7 +147,8 @@ class Launcher(Logger):
                 # per-host replicas.
                 from znicz_tpu.backends import XLADevice
                 from znicz_tpu.parallel import make_mesh
-                self.device = XLADevice(mesh=make_mesh())
+                self.device = XLADevice(
+                    mesh=make_mesh(n_model=self.n_model))
             else:
                 self.device = Device.create(self.backend)
         return self.device

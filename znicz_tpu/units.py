@@ -164,7 +164,12 @@ class Unit(Logger):
     #: device-resident dataset — large, immutable, rebuilt on resume)
     SNAPSHOT_EXCLUDE: tuple = ()
 
-    def state_dict(self) -> dict:
+    def state_dict(self, allow_collective: bool = False) -> dict:
+        """``allow_collective=True`` when EVERY process reaches this
+        call in lockstep (the in-graph Snapshotter unit: SPMD runs it
+        on all processes) — model-sharded persistent state is then
+        gathered via the collective read.  Solo callers (the master's
+        emergency snapshot) must leave it False."""
         from znicz_tpu.memory import Vector  # local: avoid import cycle
         import numpy as _np
         out: dict = {}
@@ -173,25 +178,27 @@ class Unit(Logger):
                 continue
             if isinstance(val, Vector) and val:
                 if val.needs_collective_read:
-                    if not val.batch_major:
+                    if val.batch_major:
+                        # Batch-sharded buffers are per-minibatch
+                        # transients (loader/forward/err chains refill
+                        # them before any consumer on resume); never
+                        # worth a cross-process all-gather.
+                        continue
+                    if not allow_collective:
                         # Persistent sharded state (tensor-parallel
-                        # weights/momentum) CANNOT be silently skipped
+                        # weights/momentum) cannot be silently skipped
                         # — resuming would restore fresh random init
-                        # for just these layers.  Reading it would
-                        # all-gather, which deadlocks on master-only
-                        # snapshot paths, so fail loudly instead.
+                        # for just these layers.  Reading it here
+                        # would all-gather, which deadlocks on a solo
+                        # snapshot path, so fail loudly instead.
                         raise NotImplementedError(
                             f"{self}: snapshotting model-sharded "
-                            f"Vector '{val.name}' in a multi-process "
-                            f"run is not supported yet — snapshots "
-                            f"must run from every process in lockstep "
-                            f"for tensor-parallel state")
-                    # Batch-sharded buffers are per-minibatch
-                    # transients (loader/forward/err chains refill them
-                    # before any consumer on resume); reading one here
-                    # would all-gather — a deadlock from master-only
-                    # snapshot paths.
-                    continue
+                            f"Vector '{val.name}' outside a lockstep "
+                            f"snapshot point — use the Snapshotter "
+                            f"unit (all processes) for tensor-"
+                            f"parallel state")
+                    # lockstep: map_read → device.get →
+                    # process_allgather reassembles the full array
                 val.map_read()
                 out[name] = _np.array(val.mem, copy=True)
         for name in self.SNAPSHOT_ATTRS:

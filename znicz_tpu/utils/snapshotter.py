@@ -61,7 +61,16 @@ class Snapshotter(Unit):
         self._fire_count += 1
         if self._fire_count % self.interval:
             return
-        path = self.write(self.workflow.state_dict(), self.directory,
+        # all processes execute this unit in lockstep under SPMD, so
+        # collective reads of model-sharded state are safe here; the
+        # gather must run on EVERY process (it's a collective), but
+        # only process 0 writes the file (a shared snapshot directory
+        # must not see concurrent writers)
+        import jax
+        state = self.workflow.state_dict(allow_collective=True)
+        if jax.process_index() != 0:
+            return
+        path = self.write(state, self.directory,
                           self.prefix, self.snapshot_suffix())
         self.destination = path
         self.info("snapshot → %s", path)
@@ -74,7 +83,10 @@ class Snapshotter(Unit):
         periodic unit both use it)."""
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, f"{prefix}_{suffix}.pickle.gz")
-        tmp = path + ".tmp"
+        # per-process tmp: concurrent writers on a shared fs (defense
+        # in depth — run() already single-writes) must not truncate
+        # each other's in-progress stream before the atomic replace
+        tmp = f"{path}.{os.getpid()}.tmp"
         with gzip.open(tmp, "wb") as f:
             pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
         os.replace(tmp, path)

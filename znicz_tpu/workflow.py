@@ -134,13 +134,16 @@ class Workflow(Container):
     # ------------------------------------------------------------------
     # snapshot protocol
     # ------------------------------------------------------------------
-    def state_dict(self) -> dict:
+    def state_dict(self, allow_collective: bool = False) -> dict:
         """Pure-data state tree: per-unit Vectors + declared scalars +
-        the PRNG streams (so resume continues the exact trajectory)."""
+        the PRNG streams (so resume continues the exact trajectory).
+
+        ``allow_collective``: see :meth:`Unit.state_dict` — True only
+        from lockstep snapshot points (the Snapshotter unit)."""
         from znicz_tpu.utils import prng
         state: dict = {"__units__": {}, "__prng__": prng.get().get_state()}
         for unit in self.units:
-            unit_state = unit.state_dict()
+            unit_state = unit.state_dict(allow_collective=allow_collective)
             if unit_state:
                 state["__units__"][unit.name] = unit_state
         return state
