@@ -138,6 +138,11 @@ def make_parser() -> argparse.ArgumentParser:
                         "(lax.scan over the jit region; amortizes "
                         "dispatch/RPC latency — see "
                         "StandardWorkflow.run_chunked)")
+    p.add_argument("--n-model", type=int, default=1, metavar="M",
+                   help="model-axis size of the distributed device "
+                        "grid (tensor parallelism: layers with "
+                        "model_parallel='column'/'row' shard over it; "
+                        "requires --listen/--master)")
     p.add_argument("--dump-graph", metavar="FILE",
                    help="write the workflow's Graphviz DOT and exit")
     p.add_argument("--dry-run", action="store_true",
@@ -184,7 +189,7 @@ class Main(Logger):
             graphics=False if args.no_graphics else None,
             web_status=args.web_status,
             web_status_host=args.web_status_host,
-            chunk=args.chunk)
+            chunk=args.chunk, n_model=args.n_model)
         self.launcher = launcher  # introspection (tests, embedding)
         if args.dump_graph or args.dry_run:
             # build (and initialize) without training

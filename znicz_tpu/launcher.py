@@ -290,14 +290,19 @@ class Launcher(Logger):
         snap = getattr(workflow, "snapshotter", None)
         if snap is not None and snap.destination:
             return snap.destination
+        # glob fallback: search the workflow's own snapshot directory
+        # (it may differ from the global default) plus the default
         directory = str(root.common.dirs.snapshots)
+        directories = {directory}
         prefixes = {workflow.name}
         if snap is not None:
             prefixes.add(snap.prefix)
+            directories.add(snap.directory)
         files: list[str] = []
-        for prefix in prefixes:
-            files += glob.glob(
-                os.path.join(directory, f"{prefix}_*.pickle.gz"))
+        for d in directories:
+            for prefix in prefixes:
+                files += glob.glob(
+                    os.path.join(d, f"{prefix}_*.pickle.gz"))
         files.sort(key=os.path.getmtime)
         return files[-1] if files else None
 

@@ -65,15 +65,21 @@ class Snapshotter(Unit):
         # collective reads of model-sharded state are safe here; the
         # gather must run on EVERY process (it's a collective), but
         # only process 0 writes the file (a shared snapshot directory
-        # must not see concurrent writers)
+        # must not see concurrent writers).  The path is deterministic
+        # (lockstep decision state), so every process records the SAME
+        # destination — crash auto-resume must load one snapshot on
+        # all processes, not master-only.
         import jax
         state = self.workflow.state_dict(allow_collective=True)
-        if jax.process_index() != 0:
-            return
-        path = self.write(state, self.directory,
-                          self.prefix, self.snapshot_suffix())
+        suffix = self.snapshot_suffix()
+        path = os.path.join(self.directory,
+                            f"{self.prefix}_{suffix}.pickle.gz")
+        if jax.process_index() == 0:
+            written = self.write(state, self.directory, self.prefix,
+                                 suffix)
+            assert written == path
+            self.info("snapshot → %s", path)
         self.destination = path
-        self.info("snapshot → %s", path)
 
     @staticmethod
     def write(state: dict, directory: str, prefix: str,
