@@ -33,7 +33,7 @@ from znicz_tpu.backends import NumpyDevice
 from znicz_tpu.loader.base import TRAIN, Loader
 from znicz_tpu.mutable import Bool
 from znicz_tpu.ops import activation, all2all, conv, cutter, dropout, pooling
-from znicz_tpu.ops import deconv, depooling, lstm, normalization
+from znicz_tpu.ops import attention, deconv, depooling, lstm, normalization
 from znicz_tpu.ops import gd, gd_conv, gd_pooling  # noqa: F401 (pairs)
 from znicz_tpu.ops.decision import DecisionGD, DecisionMSE
 from znicz_tpu.ops.lr_adjust import LearningRateAdjust
@@ -90,6 +90,7 @@ for _name, _cls in {
     "deconv_sigmoid": deconv.DeconvSigmoid,
     "depooling": depooling.Depooling,
     "lstm": lstm.LSTM,
+    "attention": attention.MultiHeadAttention,
 }.items():
     register_layer_type(_name, _cls)
 

@@ -1,0 +1,302 @@
+"""Multi-head attention units — the long-context op family.
+
+The 2015 reference has no attention (SURVEY.md §5.7), but this
+framework treats long-context machinery as first-class: this module
+makes the :mod:`znicz_tpu.parallel.ring_attention` primitive
+consumable from the unit graph.
+
+``MultiHeadAttention`` maps (B, T, D) → (B, T, D):
+
+.. code-block:: text
+
+    qkv  = x @ W_qkv + b_qkv          (D, 3·D) packed projection
+    q,k,v split → (B, T, H, D/H)
+    o    = softmax(q·kᵀ/√dₕ [+causal]) · v
+    y    = concat(o) @ W_out + b_out   (D, D)
+
+``seq_parallel=True`` runs the attention core **blockwise around the
+ICI ring** over the device mesh's ``model`` axis (K/V shards rotate
+via ``ppermute``, online-softmax accumulation; no device materializes
+the (T, T) score matrix) — sequences longer than one chip's HBM shard
+over the mesh exactly like the scaling-book recipe.  The unit's
+output Vector carries ``model_shard_dim=1`` (the time axis) so the
+sharding annotation flows through the graph.
+
+Backward (``GDMultiHeadAttention``): ``jax.vjp`` of the forward on
+the XLA path — this differentiates THROUGH the shard_map/ppermute
+ring, so sequence-parallel training needs no hand-written collective
+gradients — validated against the explicit analytic numpy oracle.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops.nn_units import Forward, GradientDescentBase
+from znicz_tpu.parallel.axis import MODEL_AXIS
+
+
+def _split_heads(xp, qkv, n_heads: int):
+    """(B, T, 3D) → three (B, T, H, D/H)."""
+    b, t, d3 = qkv.shape
+    d = d3 // 3
+    dh = d // n_heads
+    q, k, v = qkv[..., :d], qkv[..., d:2 * d], qkv[..., 2 * d:]
+    reshape = (b, t, n_heads, dh)
+    return q.reshape(reshape), k.reshape(reshape), v.reshape(reshape)
+
+
+def _local_attention_np(q, k, v, causal: bool):
+    """Numpy oracle core (mirrors parallel.ring_attention's
+    local_attention)."""
+    d = q.shape[-1]
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = np.arange(tq)[:, None] >= np.arange(tk)[None, :]
+        s = np.where(mask[None, None], s, -1e30)
+    s = s - s.max(axis=-1, keepdims=True)
+    p = np.exp(s)
+    p = p / p.sum(axis=-1, keepdims=True)
+    o = np.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, p
+
+
+class MultiHeadAttention(Forward):
+    """Weighted multi-head self-attention layer."""
+
+    def __init__(self, workflow, n_heads: int, causal: bool = False,
+                 seq_parallel: bool = False, name=None, **kwargs) -> None:
+        # attention defaults to fan-scaled init (the reference's
+        # fixed-stddev fillings predate attention entirely)
+        kwargs.setdefault("weights_filling", "xavier")
+        super().__init__(workflow, name=name, **kwargs)
+        self.n_heads = int(n_heads)
+        self.causal = bool(causal)
+        #: ring attention over the mesh's model axis (time-sharded)
+        self.seq_parallel = bool(seq_parallel)
+        self.weights_out = Vector(name=f"{self.name}.weights_out")
+        self.bias_out = Vector(name=f"{self.name}.bias_out")
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if len(self.input.shape) != 3:
+            raise ValueError(f"{self}: expected (batch, time, features) "
+                             f"input, got {self.input.shape}")
+        b, t, d = self.input.shape
+        if d % self.n_heads:
+            raise ValueError(f"{self}: features {d} not divisible by "
+                             f"{self.n_heads} heads")
+        if not self.weights:
+            self.weights.reset(self.fill_array(
+                (d, 3 * d), self.weights_filling,
+                self.weights_stddev, fan_in=d))
+        if not self.weights_out:
+            self.weights_out.reset(self.fill_array(
+                (d, d), self.weights_filling,
+                self.weights_stddev, fan_in=d))
+        if self.include_bias:
+            if not self.bias:
+                self.bias.reset(np.zeros(3 * d, np.float32))
+            if not self.bias_out:
+                self.bias_out.reset(np.zeros(d, np.float32))
+        self.output.reset(np.zeros((b, t, d),
+                                   dtype=self.output_store_dtype))
+        mesh = getattr(self.device, "mesh", None)
+        if self.seq_parallel:
+            if mesh is None or mesh.shape.get(MODEL_AXIS, 1) < 2:
+                # no ring to ride — fall back to local attention (the
+                # math is identical; seq_parallel is a layout choice)
+                self.seq_parallel = False
+            else:
+                if t % mesh.shape[MODEL_AXIS]:
+                    raise ValueError(
+                        f"{self}: time axis {t} not divisible by the "
+                        f"model-axis size {mesh.shape[MODEL_AXIS]}")
+                self.output.model_shard_dim = 1  # time rides the ring
+        self.init_vectors(self.input, self.output, self.weights,
+                          self.bias, self.weights_out, self.bias_out)
+
+    # -- pure forward (jnp; the backward vjp's this) --------------------
+    def xla_forward(self, x, w_qkv, b_qkv, w_out, b_out):
+        b, t, d = x.shape
+        x32 = x.astype(jnp.float32)
+        qkv = self.mxu_dot(jnp, x32.reshape(b * t, d), w_qkv)
+        if b_qkv is not None:
+            qkv = qkv + b_qkv
+        q, k, v = _split_heads(jnp, qkv.reshape(b, t, 3 * d),
+                               self.n_heads)
+        if self.seq_parallel:
+            from znicz_tpu.parallel.ring_attention import \
+                sequence_sharded_attention
+            o = sequence_sharded_attention(
+                self.device.mesh, q, k, v, causal=self.causal,
+                axis_name=MODEL_AXIS)
+        else:
+            from znicz_tpu.parallel.ring_attention import local_attention
+            o = local_attention(q, k, v, causal=self.causal)
+        y = self.mxu_dot(jnp, o.reshape(b * t, d), w_out)
+        if b_out is not None:
+            y = y + b_out
+        return y.reshape(b, t, d)
+
+    def xla_run(self) -> None:
+        self.output.devmem = self.xla_forward(
+            self.input.devmem, self.weights.devmem,
+            self.bias.devmem if self.include_bias else None,
+            self.weights_out.devmem,
+            self.bias_out.devmem if self.include_bias else None)
+
+    # -- numpy oracle ---------------------------------------------------
+    def _forward_np(self, x):
+        b, t, d = x.shape
+        qkv = x.reshape(b * t, d) @ self.weights.mem
+        if self.include_bias:
+            qkv = qkv + self.bias.mem
+        q, k, v = _split_heads(np, qkv.reshape(b, t, 3 * d),
+                               self.n_heads)
+        o, p = _local_attention_np(q, k, v, self.causal)
+        y = o.reshape(b * t, d) @ self.weights_out.mem
+        if self.include_bias:
+            y = y + self.bias_out.mem
+        return y.reshape(b, t, d), (qkv, q, k, v, o, p)
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.weights.map_read()
+        self.weights_out.map_read()
+        if self.include_bias:
+            self.bias.map_read()
+            self.bias_out.map_read()
+        y, _ = self._forward_np(self.input.mem.astype(np.float32))
+        self.output.map_invalidate()
+        self.output.mem[...] = y
+
+
+class GDMultiHeadAttention(GradientDescentBase):
+    """Attention backward: analytic numpy oracle vs ``jax.vjp`` of the
+    forward (which differentiates through the ring when
+    ``seq_parallel``)."""
+
+    MATCHES = (MultiHeadAttention,)
+
+    def __init__(self, workflow, name=None, **kwargs):
+        super().__init__(workflow, name=name, **kwargs)
+        self.forward_unit: MultiHeadAttention | None = None
+        self.accumulated_gradient_weights_out = Vector(
+            name=f"{self.name}.acc_gw_out")
+        self.accumulated_gradient_bias_out = Vector(
+            name=f"{self.name}.acc_gb_out")
+
+    def initialize(self, device=None, **kwargs) -> None:
+        if self.forward_unit is None:
+            raise ValueError(
+                f"{self}: forward_unit not set — assign the paired "
+                f"forward unit before initialize (link_attrs does not "
+                f"do this)")
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        super().initialize(device=device, **kwargs)
+        fwd = self.forward_unit
+        if self.gradient_moment:
+            self.accumulated_gradient_weights_out.reset(
+                np.zeros(fwd.weights_out.shape, np.float32))
+        if self.gradient_moment_bias and fwd.include_bias:
+            self.accumulated_gradient_bias_out.reset(
+                np.zeros(fwd.bias_out.shape, np.float32))
+        self.init_vectors(self.err_input, self.err_output, self.input,
+                          self.output, self.weights, self.bias,
+                          fwd.weights_out, fwd.bias_out,
+                          self.accumulated_gradient_weights_out,
+                          self.accumulated_gradient_bias_out)
+
+    def region_vectors(self):
+        vecs = super().region_vectors()
+        seen = {id(v) for v in vecs}
+        fwd = self.forward_unit
+        for vec in (fwd.weights_out, fwd.bias_out,
+                    self.accumulated_gradient_weights_out,
+                    self.accumulated_gradient_bias_out):
+            if vec and id(vec) not in seen:
+                vecs.append(vec)
+        return vecs
+
+    def xla_run(self) -> None:
+        fwd = self.forward_unit
+        has_bias = fwd.include_bias
+        args = (self.input.devmem, self.weights.devmem,
+                self.bias.devmem if has_bias else None,
+                fwd.weights_out.devmem,
+                fwd.bias_out.devmem if has_bias else None)
+        _, vjp = jax.vjp(
+            lambda x, wq, bq, wo, bo: fwd.xla_forward(x, wq, bq, wo, bo),
+            *args)
+        gx, gwq, gbq, gwo, gbo = vjp(
+            self.err_output.devmem.astype(jnp.float32))
+        if self.need_err_input:
+            self.err_input.devmem = gx
+        self._apply_weights_xla(gwq)
+        if has_bias:
+            self._apply_bias_xla(gbq)
+        # second pair through the SAME parameterized base update rule
+        self._apply_weights_xla(
+            gwo, vec=fwd.weights_out,
+            acc_vec=self.accumulated_gradient_weights_out)
+        if has_bias:
+            self._apply_bias_xla(
+                gbo, vec=fwd.bias_out,
+                acc_vec=self.accumulated_gradient_bias_out)
+
+    def numpy_run(self) -> None:
+        """Analytic attention backward (the oracle/spec)."""
+        fwd = self.forward_unit
+        for vec in (self.err_output, self.input):
+            vec.map_read()
+        self.weights.map_write()
+        fwd.weights_out.map_write()
+        if fwd.include_bias:
+            self.bias.map_write()
+            fwd.bias_out.map_write()
+        x = self.input.mem.astype(np.float32)
+        b, t, d = x.shape
+        h = fwd.n_heads
+        dh = d // h
+        _, (qkv, q, k, v, o, p) = fwd._forward_np(x)
+        dy = self.err_output.mem.astype(np.float32).reshape(b * t, d)
+        # output projection
+        grad_wo = o.reshape(b * t, d).T @ dy
+        grad_bo = dy.sum(axis=0)
+        do = (dy @ fwd.weights_out.mem.T).reshape(b, t, h, dh)
+        # attention core: dv, softmax jacobian, dq/dk
+        dv = np.einsum("bhqk,bqhd->bkhd", p, do)
+        dp = np.einsum("bqhd,bkhd->bhqk", do, v)
+        ds = p * (dp - (dp * p).sum(axis=-1, keepdims=True))
+        ds = ds / np.sqrt(dh)
+        dq = np.einsum("bhqk,bkhd->bqhd", ds, k)
+        dk = np.einsum("bhqk,bqhd->bkhd", ds, q)
+        dqkv = np.concatenate(
+            [a.reshape(b, t, d) for a in (dq, dk, dv)],
+            axis=-1).reshape(b * t, 3 * d)
+        # input projection
+        grad_wq = x.reshape(b * t, d).T @ dqkv
+        grad_bq = dqkv.sum(axis=0)
+        if self.need_err_input:
+            self.err_input.map_invalidate()
+            self.err_input.mem[...] = (
+                dqkv @ self.weights.mem.T).reshape(b, t, d)
+        self._apply_weights_np(grad_wq)
+        if fwd.include_bias:
+            self._apply_bias_np(grad_bq)
+        self._apply_weights_np(
+            grad_wo, vec=fwd.weights_out,
+            acc_vec=self.accumulated_gradient_weights_out)
+        if fwd.include_bias:
+            self._apply_bias_np(
+                grad_bo, vec=fwd.bias_out,
+                acc_vec=self.accumulated_gradient_bias_out)

@@ -268,59 +268,74 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             reg = reg + 0.5 * l1 * xp.sign(weights)
         return grad + decay * reg
 
-    def _apply_weights_np(self, grad_w: np.ndarray) -> None:
-        w = self.weights.mem
+    # ``vec``/``acc`` parameters let units with EXTRA parameter pairs
+    # (e.g. attention's output projection) reuse the exact update rule
+    # instead of copy-pasting the momentum/decay math
+    def _apply_weights_np(self, grad_w: np.ndarray, vec=None,
+                          acc_vec=None) -> None:
+        vec = vec if vec is not None else self.weights
+        acc_vec = acc_vec if acc_vec is not None \
+            else self.accumulated_gradient_weights
+        w = vec.mem
         g = self._regularized(np, grad_w, w, self.weights_decay)
         lr = self._lr(xla=False)
         if self.gradient_moment:
-            acc = self.accumulated_gradient_weights.mem
+            acc = acc_vec.mem
             acc *= self.gradient_moment
             acc -= lr * g
             w += acc
         else:
             w -= lr * g
 
-    def _apply_bias_np(self, grad_b: np.ndarray) -> None:
-        if self.bias is None or not self.bias:
+    def _apply_bias_np(self, grad_b: np.ndarray, vec=None,
+                       acc_vec=None) -> None:
+        vec = vec if vec is not None else self.bias
+        acc_vec = acc_vec if acc_vec is not None \
+            else self.accumulated_gradient_bias
+        if vec is None or not vec:
             return
-        b = self.bias.mem
+        b = vec.mem
         g = self._regularized(np, grad_b, b, self.weights_decay_bias)
         lr = self._lr_bias(xla=False)
         if self.gradient_moment_bias:
-            acc = self.accumulated_gradient_bias.mem
+            acc = acc_vec.mem
             acc *= self.gradient_moment_bias
             acc -= lr * g
             b += acc
         else:
             b -= lr * g
 
-    def _apply_weights_xla(self, grad_w) -> None:
+    def _apply_weights_xla(self, grad_w, vec=None, acc_vec=None) -> None:
+        vec = vec if vec is not None else self.weights
+        acc_vec = acc_vec if acc_vec is not None \
+            else self.accumulated_gradient_weights
         grad_w = maybe_pmean(grad_w)
-        w = self.weights.devmem
+        w = vec.devmem
         g = self._regularized(jnp, grad_w, w, self.weights_decay)
         lr = self._lr(xla=True)
         if self.gradient_moment:
-            acc = self.accumulated_gradient_weights.devmem
-            acc = self.gradient_moment * acc - lr * g
-            self.accumulated_gradient_weights.devmem = acc
-            self.weights.devmem = w + acc
+            acc = self.gradient_moment * acc_vec.devmem - lr * g
+            acc_vec.devmem = acc
+            vec.devmem = w + acc
         else:
-            self.weights.devmem = w - lr * g
+            vec.devmem = w - lr * g
 
-    def _apply_bias_xla(self, grad_b) -> None:
-        if self.bias is None or not self.bias:
+    def _apply_bias_xla(self, grad_b, vec=None, acc_vec=None) -> None:
+        vec = vec if vec is not None else self.bias
+        acc_vec = acc_vec if acc_vec is not None \
+            else self.accumulated_gradient_bias
+        if vec is None or not vec:
             return
         grad_b = maybe_pmean(grad_b)
-        b = self.bias.devmem
+        b = vec.devmem
         g = self._regularized(jnp, grad_b, b, self.weights_decay_bias)
         lr = self._lr_bias(xla=True)
         if self.gradient_moment_bias:
-            acc = self.accumulated_gradient_bias.devmem
-            acc = self.gradient_moment_bias * acc - lr * g
-            self.accumulated_gradient_bias.devmem = acc
-            self.bias.devmem = b + acc
+            acc = self.gradient_moment_bias * acc_vec.devmem - lr * g
+            acc_vec.devmem = acc
+            vec.devmem = b + acc
         else:
-            self.bias.devmem = b - lr * g
+            vec.devmem = b - lr * g
 
 
 # ----------------------------------------------------------------------
