@@ -144,6 +144,12 @@ def test_seq_parallel_matches_local():
         dst.initialize(ring.device)
     local.run()
     ring.run()
+    # DP composes with SP: the ring's shard_map spec threads the data
+    # axis, so the output stays batch-sharded (2 shards) while the
+    # time axis rides the model ring (4 shards)
+    out_shard = ring.output.devmem.sharding.shard_shape(
+        ring.output.devmem.shape)
+    assert out_shard == (B // 2, T // 4, D), out_shard
     local.output.map_read()
     ring.output.map_read()
     np.testing.assert_allclose(np.asarray(ring.output.mem, np.float32),
@@ -225,3 +231,20 @@ def test_seq_parallel_backward_matches_local():
             np.asarray(gd_u.err_input.mem, np.float32).copy())
     for a, b in zip(results["local"], results["ring"]):
         np.testing.assert_allclose(a, b, rtol=2e-3, atol=1e-4)
+
+
+def test_attention_seq_sample():
+    """The zoo sample builds and trains through the CLI protocol."""
+    from znicz_tpu.models.samples import attention_seq
+    from znicz_tpu.utils.config import root
+
+    prng.seed_all(17)
+    prev = root.attention_seq.max_epochs
+    root.attention_seq.max_epochs = 12
+    try:
+        wf = attention_seq.build()
+        wf.initialize(device=XLADevice())
+        wf.run()
+    finally:
+        root.attention_seq.max_epochs = prev
+    assert wf.decision.min_validation_n_err_pt <= 20.0

@@ -1,0 +1,82 @@
+"""Sequence-classification sample for the attention op family
+(beyond the 2015 reference, which predates attention — SURVEY.md §5.7
+marks the family as this framework's long-context extension).
+
+Task: each sample is a (T, D) sequence of noise with a marker token
+injected somewhere; the class is which third of the sequence holds the
+marker.  Solving it requires cross-position mixing — exactly what a
+position-agnostic per-token model cannot do — so a falling validation
+error certifies the attention unit end to end.
+
+Run: ``python -m znicz_tpu attention_seq``
+(``--root attention_seq.seq_parallel=True`` rides the ring over a
+mesh's model axis when one is present).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.utils.config import register_defaults, root
+
+register_defaults("attention_seq", {
+    "minibatch_size": 32,
+    "learning_rate": 0.05,
+    "gradient_moment": 0.9,
+    "n_heads": 4,
+    "seq_len": 12,
+    "features": 16,
+    "n_classes": 3,
+    "n_train": 384,
+    "n_valid": 96,
+    "max_epochs": 30,
+    "seq_parallel": False,
+    "seed": 9,
+})
+
+
+def make_data(cfg):
+    rng = np.random.default_rng(cfg["seed"])
+    n = cfg["n_train"] + cfg["n_valid"]
+    t, d, n_classes = cfg["seq_len"], cfg["features"], cfg["n_classes"]
+    span = t // n_classes
+    x = rng.normal(0, 0.3, size=(n, t, d)).astype(np.float32)
+    y = rng.integers(0, n_classes, size=n).astype(np.int32)
+    for i in range(n):
+        pos = y[i] * span + rng.integers(0, span)
+        x[i, pos] += 2.0
+    return x, y
+
+
+def build(**overrides) -> StandardWorkflow:
+    cfg = dict(root.attention_seq.as_dict())
+    cfg.update(overrides)
+    x, y = make_data(cfg)
+    n_train = cfg["n_train"]
+    gd_cfg = {"learning_rate": cfg["learning_rate"],
+              "gradient_moment": cfg["gradient_moment"]}
+    wf = StandardWorkflow(
+        name="attention_seq",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=x[:n_train], train_labels=y[:n_train],
+            valid_data=x[n_train:], valid_labels=y[n_train:],
+            minibatch_size=cfg["minibatch_size"]),
+        layers=[
+            {"type": "attention",
+             "->": {"n_heads": cfg["n_heads"],
+                    "seq_parallel": cfg["seq_parallel"]},
+             "<-": gd_cfg},
+            {"type": "softmax",
+             "->": {"output_sample_shape": cfg["n_classes"]},
+             "<-": gd_cfg},
+        ],
+        decision_config={"max_epochs": cfg["max_epochs"]})
+    wf._max_fires = 10 ** 9
+    return wf
+
+
+def run(load, main):
+    load(build)
+    main()

@@ -40,8 +40,9 @@ from znicz_tpu.ops.nn_units import Forward, GradientDescentBase
 from znicz_tpu.parallel.axis import MODEL_AXIS
 
 
-def _split_heads(xp, qkv, n_heads: int):
-    """(B, T, 3D) → three (B, T, H, D/H)."""
+def _split_heads(qkv, n_heads: int):
+    """(B, T, 3D) → three (B, T, H, D/H) (pure slicing/reshape —
+    backend-agnostic)."""
     b, t, d3 = qkv.shape
     d = d3 // 3
     dh = d // n_heads
@@ -130,8 +131,7 @@ class MultiHeadAttention(Forward):
         qkv = self.mxu_dot(jnp, x32.reshape(b * t, d), w_qkv)
         if b_qkv is not None:
             qkv = qkv + b_qkv
-        q, k, v = _split_heads(jnp, qkv.reshape(b, t, 3 * d),
-                               self.n_heads)
+        q, k, v = _split_heads(qkv.reshape(b, t, 3 * d), self.n_heads)
         if self.seq_parallel:
             from znicz_tpu.parallel.ring_attention import \
                 sequence_sharded_attention
@@ -159,8 +159,7 @@ class MultiHeadAttention(Forward):
         qkv = x.reshape(b * t, d) @ self.weights.mem
         if self.include_bias:
             qkv = qkv + self.bias.mem
-        q, k, v = _split_heads(np, qkv.reshape(b, t, 3 * d),
-                               self.n_heads)
+        q, k, v = _split_heads(qkv.reshape(b, t, 3 * d), self.n_heads)
         o, p = _local_attention_np(q, k, v, self.causal)
         y = o.reshape(b * t, d) @ self.weights_out.mem
         if self.include_bias:
@@ -185,6 +184,8 @@ class GDMultiHeadAttention(GradientDescentBase):
     ``seq_parallel``)."""
 
     MATCHES = (MultiHeadAttention,)
+    REQUIRES_FORWARD_UNIT = True
+    REQUIRES_INPUT = True
 
     def __init__(self, workflow, name=None, **kwargs):
         super().__init__(workflow, name=name, **kwargs)
@@ -195,13 +196,6 @@ class GDMultiHeadAttention(GradientDescentBase):
             name=f"{self.name}.acc_gb_out")
 
     def initialize(self, device=None, **kwargs) -> None:
-        if self.forward_unit is None:
-            raise ValueError(
-                f"{self}: forward_unit not set — assign the paired "
-                f"forward unit before initialize (link_attrs does not "
-                f"do this)")
-        if self.input is None or not self.input:
-            raise AttributeError(f"{self}: input not linked yet")
         super().initialize(device=device, **kwargs)
         fwd = self.forward_unit
         if self.gradient_moment:

@@ -168,6 +168,10 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
     """
 
     MATCHES: tuple = ()
+    #: subclasses that require a paired forward / a linked input set
+    #: these to get the labeled error instead of a raw AttributeError
+    REQUIRES_FORWARD_UNIT = False
+    REQUIRES_INPUT = False
 
     def __init__(self, workflow, name: str | None = None,
                  learning_rate: float = 0.01,
@@ -211,6 +215,15 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         self.lr_state = Vector(name=f"{self.name}.lr_state")
 
     def initialize(self, device=None, **kwargs) -> None:
+        if self.REQUIRES_FORWARD_UNIT \
+                and getattr(self, "forward_unit", None) is None:
+            raise ValueError(
+                f"{self}: forward_unit not set — assign the paired "
+                f"forward unit before initialize (link_attrs does not "
+                f"do this)")
+        if self.REQUIRES_INPUT and (self.input is None
+                                    or not self.input):
+            raise AttributeError(f"{self}: input not linked yet")
         super().initialize(device=device, **kwargs)
         # err_input allocation lives here (post-super, device resolved)
         # so its dtype can follow the activation storage policy
@@ -363,14 +376,6 @@ class WeightlessGradientUnit(GradientDescentBase):
         self.forward_unit = None  # set by link_gds / the sample
 
     def initialize(self, device=None, **kwargs) -> None:
-        if self.REQUIRES_FORWARD_UNIT and self.forward_unit is None:
-            raise ValueError(
-                f"{self}: forward_unit not set — assign the paired "
-                f"forward unit before initialize (link_attrs does not "
-                f"do this)")
-        if self.REQUIRES_INPUT:
-            if self.input is None or not self.input:
-                raise AttributeError(f"{self}: input not linked yet")
         super().initialize(device=device, **kwargs)
         self.init_vectors(self.err_input, self.err_output, self.input,
                           self.output)

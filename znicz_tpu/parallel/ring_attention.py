@@ -112,15 +112,25 @@ def ring_attention_block(q, k, v, axis_name: str = SEQ_AXIS,
 def sequence_sharded_attention(mesh, q, k, v, causal: bool = False,
                                axis_name: str = SEQ_AXIS):
     """Shard the time axis of q/k/v over ``mesh[axis_name]`` and run
-    ring attention; returns the full (replicated-batch) output with
-    the same sharding as q."""
+    ring attention; returns output with the same sharding as q.
+
+    When the mesh also has a ``data`` axis, the BATCH dim shards over
+    it — the ring runs per batch shard (the batch dim never enters the
+    ring collectives), so data parallelism composes with sequence
+    parallelism instead of being silently all-gathered away at the
+    shard_map boundary."""
     from jax.sharding import PartitionSpec as P
     try:
         from jax import shard_map  # jax >= 0.8
     except ImportError:  # pragma: no cover - older jax
         from jax.experimental.shard_map import shard_map
 
-    spec = P(None, axis_name, None, None)
+    from znicz_tpu.parallel.axis import DATA_AXIS
+    batch_axis = None
+    if DATA_AXIS in mesh.shape and mesh.shape[DATA_AXIS] > 1 \
+            and axis_name != DATA_AXIS:
+        batch_axis = DATA_AXIS
+    spec = P(batch_axis, axis_name, None, None)
     fn = shard_map(
         functools.partial(ring_attention_block, axis_name=axis_name,
                           causal=causal),
