@@ -4,7 +4,6 @@ publish, fetch, serve)."""
 import io
 import tarfile
 
-import numpy as np
 import pytest
 
 from znicz_tpu.backends import NumpyDevice

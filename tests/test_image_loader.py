@@ -9,12 +9,10 @@ from PIL import Image as PILImage
 
 from znicz_tpu.backends import NumpyDevice, XLADevice
 from znicz_tpu.dummy import DummyWorkflow
-from znicz_tpu.loader.base import TRAIN
 from znicz_tpu.loader.image import (FileImageLoader, FullBatchImageLoader,
                                     scan_directory)
 from znicz_tpu.models.standard_workflow import StandardWorkflow
 from znicz_tpu.native import ImagePipeline
-from znicz_tpu.units import Unit
 from znicz_tpu.workflow import Workflow
 
 

@@ -6,8 +6,6 @@ assert DP-sharded run ≡ single-device run")."""
 import numpy as np
 import pytest
 
-import jax
-
 from tests.conftest import make_blobs
 from znicz_tpu.backends import XLADevice
 from znicz_tpu.loader.fullbatch import ArrayLoader

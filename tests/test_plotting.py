@@ -16,8 +16,6 @@ from znicz_tpu.loader.fullbatch import ArrayLoader
 from znicz_tpu.models.standard_workflow import StandardWorkflow
 from znicz_tpu.ops.accumulator import FixAccumulator, RangeAccumulator
 from znicz_tpu.ops.nn_plotting_units import tile_filters
-from znicz_tpu.units import Unit
-from znicz_tpu.workflow import Workflow
 
 N_CLASSES, DIM = 3, 10
 

@@ -5,7 +5,6 @@ import json
 import urllib.error
 import urllib.request
 
-import numpy as np
 
 from znicz_tpu.backends import NumpyDevice
 from znicz_tpu.models.samples.wine import build

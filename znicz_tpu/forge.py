@@ -20,7 +20,6 @@ workflow."""
 
 from __future__ import annotations
 
-import io
 import json
 import os
 import re
