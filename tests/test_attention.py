@@ -248,3 +248,43 @@ def test_attention_seq_sample():
     finally:
         root.attention_seq.max_epochs = prev
     assert wf.decision.min_validation_n_err_pt <= 20.0
+
+
+def test_attention_export_roundtrip(tmp_path):
+    """Export must carry BOTH attention parameter pairs (a fresh
+    weights_out would silently corrupt served predictions)."""
+    from znicz_tpu.export import ExportedModel, export_forward
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+
+    rng = np.random.default_rng(21)
+    x = rng.normal(0, 0.5, size=(48, 6, 8)).astype(np.float32)
+    y = rng.integers(0, 3, size=48).astype(np.int32)
+    prng.seed_all(22)
+    wf = StandardWorkflow(
+        name="attn_export",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=x, train_labels=y, minibatch_size=16),
+        layers=[{"type": "attention", "->": {"n_heads": 2},
+                 "<-": {"learning_rate": 0.05}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": 0.05}}],
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    path = export_forward(wf, str(tmp_path / "attn.npz"))
+    served = ExportedModel.load(path, device=XLADevice())
+    batch = x[:8]
+    probs = np.asarray(served(batch))
+    # reference: the workflow's own forward math on the same weights
+    fwd = wf.forwards[0]
+    for vec in (fwd.weights, fwd.bias, fwd.weights_out, fwd.bias_out,
+                wf.forwards[1].weights, wf.forwards[1].bias):
+        vec.map_read()
+    y1, _ = fwd._forward_np(batch)
+    logits = y1.reshape(8, -1) @ wf.forwards[1].weights.mem \
+        + wf.forwards[1].bias.mem
+    e = np.exp(logits - logits.max(axis=1, keepdims=True))
+    expected = e / e.sum(axis=1, keepdims=True)
+    np.testing.assert_allclose(probs, expected, rtol=1e-3, atol=1e-4)

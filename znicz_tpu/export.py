@@ -65,7 +65,7 @@ def export_forward(workflow, path: str) -> str:
     manifest = _manifest_for(workflow)
     arrays: dict[str, np.ndarray] = {}
     for i, unit in enumerate(workflow.forwards):
-        for attr in ("weights", "bias"):
+        for attr in unit.EXPORT_PARAMS:
             vec = getattr(unit, attr)
             if vec:
                 vec.map_read()
@@ -171,22 +171,33 @@ class ExportedModel:
             if not self._params_loaded:
                 # units must see the stored params BEFORE their first
                 # initialize (so they skip the random fill)
-                for attr in ("weights", "bias"):
+                for attr in unit.EXPORT_PARAMS:
                     key = f"layer{i}_{attr}"
                     if key in self._params:
                         getattr(unit, attr).reset(
                             np.array(self._params[key], copy=True))
             unit.initialize(device=self.device)
             if not self._params_loaded:
-                for attr in ("weights", "bias"):
+                for attr in unit.EXPORT_PARAMS:
                     key = f"layer{i}_{attr}"
+                    vec = getattr(unit, attr)
                     if key in self._params:
-                        vec = getattr(unit, attr)
                         if tuple(vec.shape) != self._params[key].shape:
                             raise ValueError(
                                 f"layer {i} {attr}: bundle shape "
                                 f"{self._params[key].shape} != rebuilt "
                                 f"{tuple(vec.shape)}")
+                    elif vec and not (layer.get("tied_weights")
+                                      and attr == "weights"):
+                        # a non-empty parameter the bundle does not
+                        # carry means initialize random-filled it —
+                        # serving would be silently corrupted (e.g. a
+                        # truncated or pre-EXPORT_PARAMS bundle)
+                        raise ValueError(
+                            f"layer {i} ({layer['type']}): parameter "
+                            f"'{attr}' missing from the bundle — "
+                            f"refusing to serve a random-initialized "
+                            f"substitute")
         self._params_loaded = True
         self._cur_batch = batch
 

@@ -70,6 +70,8 @@ def _local_attention_np(q, k, v, causal: bool):
 class MultiHeadAttention(Forward):
     """Weighted multi-head self-attention layer."""
 
+    EXPORT_PARAMS = ("weights", "bias", "weights_out", "bias_out")
+
     def __init__(self, workflow, n_heads: int, causal: bool = False,
                  seq_parallel: bool = False, name=None, **kwargs) -> None:
         # attention defaults to fan-scaled init (the reference's

@@ -78,6 +78,10 @@ class Forward(AcceleratedUnit):
     with the paired backward unit.
     """
 
+    #: Vector attributes the exporter serializes; units with extra
+    #: parameter pairs (attention's output projection) extend this
+    EXPORT_PARAMS: tuple = ("weights", "bias")
+
     def __init__(self, workflow, name: str | None = None,
                  weights_filling: str = "uniform",
                  weights_stddev: float | None = None,

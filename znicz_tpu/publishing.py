@@ -29,7 +29,7 @@ def _layer_rows(workflow) -> list[dict]:
     rows = []
     for unit in getattr(workflow, "forwards", []):
         n_params = 0
-        for attr in ("weights", "bias"):
+        for attr in getattr(unit, "EXPORT_PARAMS", ("weights", "bias")):
             vec = getattr(unit, attr, None)
             if vec:  # shape, not mem: the device copy may be
                 n_params += int(np.prod(vec.shape))  # authoritative
