@@ -18,8 +18,10 @@ produces a machine-readable failure line (value 0 + "error" field)
 instead of silence, and (c) fast-fails when no usable backend exists.
 
 Knobs (env): BENCH_BATCH, BENCH_PRECISION (bfloat16|float32),
-BENCH_TIMEOUT_S (global watchdog), BENCH_PROFILE=<dir> (capture a
-jax.profiler trace of the timed loop), BENCH_PEAK_TFLOPS (override
+BENCH_TIMEOUT_S (global watchdog), BENCH_PROFILE=<dir> (where the
+jax.profiler trace of the timed loop goes — ON by default into
+profiles/bench_default at ~1-2% overhead; set BENCH_PROFILE="" to
+disable), BENCH_PEAK_TFLOPS (override
 chip peak for MFU), BENCH_INPUT=stream (feed through the streaming
 FileImageLoader: real JPEG decode via the native C++ pool with
 double-buffered prefetch, instead of the device-resident store —
@@ -56,7 +58,13 @@ PRECISION = os.environ.get("BENCH_PRECISION", "bfloat16")
 #: is the measured in-graph winner — see PALLAS_BENCH.md)
 PALLAS = os.environ.get("BENCH_PALLAS", "0") != "0"
 TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT_S", "900"))
-PROFILE_DIR = os.environ.get("BENCH_PROFILE", "")
+#: default ON: every bench run leaves a committed-readable trace of
+#: the timed loop (~3 MB; ~1-2% overhead) — perf numbers should never
+#: be unexplainable.  BENCH_PROFILE="" disables; set a path to move.
+PROFILE_DIR = os.environ.get(
+    "BENCH_PROFILE",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                 "profiles", "bench_default"))
 WARMUP_STEPS = 6
 TIMED_STEPS = 30
 BASELINE_IMG_PER_SEC_PER_CHIP = 250.0  # 8000 img/s ÷ 32 chips (v4-32)
@@ -238,7 +246,12 @@ def main() -> None:
     profiling = bool(PROFILE_DIR) and tpu_like
     if profiling:
         import jax
+        import shutil
 
+        # one trace per directory: jax writes a new timestamped
+        # subdir per run, which would grow without bound under the
+        # default-on policy — keep only the latest capture
+        shutil.rmtree(PROFILE_DIR, ignore_errors=True)
         jax.profiler.start_trace(PROFILE_DIR)
     start = time.perf_counter()
     for _ in range(timed_dispatches):

@@ -288,3 +288,46 @@ def test_attention_export_roundtrip(tmp_path):
     e = np.exp(logits - logits.max(axis=1, keepdims=True))
     expected = e / e.sum(axis=1, keepdims=True)
     np.testing.assert_allclose(probs, expected, rtol=1e-3, atol=1e-4)
+
+
+def test_export_refuses_missing_params(tmp_path):
+    """A bundle lacking a parameter the rebuilt unit random-fills
+    (e.g. pre-EXPORT_PARAMS attention exports) must refuse to serve,
+    not silently substitute noise."""
+    import io
+    import json
+
+    from znicz_tpu.export import ExportedModel, export_forward
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+
+    rng = np.random.default_rng(23)
+    x = rng.normal(size=(32, 4, 8)).astype(np.float32)
+    y = rng.integers(0, 2, size=32).astype(np.int32)
+    prng.seed_all(24)
+    wf = StandardWorkflow(
+        name="attn_trunc",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=x, train_labels=y, minibatch_size=16),
+        layers=[{"type": "attention", "->": {"n_heads": 2},
+                 "<-": {"learning_rate": 0.05}},
+                {"type": "softmax", "->": {"output_sample_shape": 2},
+                 "<-": {"learning_rate": 0.05}}],
+        decision_config={"max_epochs": 1})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    path = export_forward(wf, str(tmp_path / "full.npz"))
+    # rewrite the bundle WITHOUT the attention out-projection arrays —
+    # the shape of a pre-EXPORT_PARAMS export
+    with np.load(path) as bundle:
+        arrays = {k: bundle[k] for k in bundle.files
+                  if not k.endswith(("weights_out", "bias_out"))}
+    trunc = str(tmp_path / "truncated.npz")
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    with open(trunc, "wb") as fh:
+        fh.write(buf.getvalue())
+    served = ExportedModel.load(trunc, device=XLADevice())
+    with pytest.raises(ValueError, match="missing from the bundle"):
+        served(x[:4])

@@ -187,17 +187,20 @@ class ExportedModel:
                                 f"layer {i} {attr}: bundle shape "
                                 f"{self._params[key].shape} != rebuilt "
                                 f"{tuple(vec.shape)}")
-                    elif vec and not (layer.get("tied_weights")
-                                      and attr == "weights"):
-                        # a non-empty parameter the bundle does not
-                        # carry means initialize random-filled it —
-                        # serving would be silently corrupted (e.g. a
-                        # truncated or pre-EXPORT_PARAMS bundle)
-                        raise ValueError(
-                            f"layer {i} ({layer['type']}): parameter "
-                            f"'{attr}' missing from the bundle — "
-                            f"refusing to serve a random-initialized "
-                            f"substitute")
+                    else:
+                        spec = self.manifest["layers"][i]
+                        if vec and not (spec.get("tied_weights")
+                                        and attr == "weights"):
+                            # a non-empty parameter the bundle does
+                            # not carry means initialize random-filled
+                            # it — serving would be silently corrupted
+                            # (e.g. a truncated or pre-EXPORT_PARAMS
+                            # bundle)
+                            raise ValueError(
+                                f"layer {i} ({spec['type']}): "
+                                f"parameter '{attr}' missing from the "
+                                f"bundle — refusing to serve a random-"
+                                f"initialized substitute")
         self._params_loaded = True
         self._cur_batch = batch
 
