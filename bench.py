@@ -246,12 +246,16 @@ def main() -> None:
     profiling = bool(PROFILE_DIR) and tpu_like
     if profiling:
         import jax
-        import shutil
 
-        # one trace per directory: jax writes a new timestamped
-        # subdir per run, which would grow without bound under the
-        # default-on policy — keep only the latest capture
-        shutil.rmtree(PROFILE_DIR, ignore_errors=True)
+        if "BENCH_PROFILE" not in os.environ:
+            # one trace per directory, DEFAULT path only: jax writes a
+            # new timestamped subdir per run, which would grow without
+            # bound under the default-on policy.  A user-supplied
+            # BENCH_PROFILE dir is never cleaned — it may hold prior
+            # results.
+            import shutil
+
+            shutil.rmtree(PROFILE_DIR, ignore_errors=True)
         jax.profiler.start_trace(PROFILE_DIR)
     start = time.perf_counter()
     for _ in range(timed_dispatches):

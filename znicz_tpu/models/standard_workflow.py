@@ -34,6 +34,7 @@ from znicz_tpu.loader.base import TRAIN, Loader
 from znicz_tpu.mutable import Bool
 from znicz_tpu.ops import activation, all2all, conv, cutter, dropout, pooling
 from znicz_tpu.ops import attention, deconv, depooling, lstm, normalization
+from znicz_tpu.ops import pos_encoding
 from znicz_tpu.ops import gd, gd_conv, gd_pooling  # noqa: F401 (pairs)
 from znicz_tpu.ops.decision import DecisionGD, DecisionMSE
 from znicz_tpu.ops.lr_adjust import LearningRateAdjust
@@ -91,6 +92,7 @@ for _name, _cls in {
     "depooling": depooling.Depooling,
     "lstm": lstm.LSTM,
     "attention": attention.MultiHeadAttention,
+    "pos_encoding": pos_encoding.PositionalEncoding,
 }.items():
     register_layer_type(_name, _cls)
 

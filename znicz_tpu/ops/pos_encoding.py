@@ -1,0 +1,80 @@
+"""Sinusoidal positional encoding — companion to the attention family
+(beyond the 2015 reference, which has no sequence models;
+SURVEY.md §5.7 marks long-context machinery as this framework's
+extension).
+
+``y[b, t, d] = x[b, t, d] + PE[t, d]`` with the standard interleaved
+sin/cos table.  Weightless and elementwise-additive, so the backward
+is the identity pass-through; the table is baked into the jit region
+as a constant (XLA folds the add into neighbors).  Sequence-parallel
+friendly: positions are GLOBAL indices, so a time-sharded input adds
+the correct table slice per shard (the table is computed from the
+full length and sliced by the same sharding, handled by GSPMD).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.ops.nn_units import Forward, WeightlessGradientUnit
+
+
+def sinusoid_table(t: int, d: int) -> np.ndarray:
+    """The (T, D) encoding table: even dims sin, odd dims cos, with
+    the 10000^(2i/d) wavelength ladder."""
+    pos = np.arange(t, dtype=np.float32)[:, None]
+    i = np.arange(d, dtype=np.float32)[None, :]
+    angle = pos / np.power(10000.0, 2.0 * (i // 2) / d)
+    table = np.where(i % 2 == 0, np.sin(angle), np.cos(angle))
+    return table.astype(np.float32)
+
+
+class PositionalEncoding(Forward):
+    """Adds the sinusoidal table to a (B, T, D) input."""
+
+    def __init__(self, workflow, scale: float = 1.0, name=None,
+                 **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        self.scale = float(scale)
+        self._table: np.ndarray | None = None
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if len(self.input.shape) != 3:
+            raise ValueError(f"{self}: expected (batch, time, features) "
+                             f"input, got {self.input.shape}")
+        _, t, d = self.input.shape
+        self._table = self.scale * sinusoid_table(t, d)
+        self.output.reset(np.zeros(self.input.shape,
+                                   dtype=self.output_store_dtype))
+        self.inherit_model_shard(self.output)
+        self.init_vectors(self.input, self.output)
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = \
+            self.input.mem.astype(np.float32) + self._table
+
+    def xla_run(self) -> None:
+        self.output.devmem = (
+            self.input.devmem.astype(jnp.float32)
+            + jnp.asarray(self._table))
+
+
+class GDPositionalEncoding(WeightlessGradientUnit):
+    """Backward of an additive constant: identity pass-through."""
+
+    MATCHES = (PositionalEncoding,)
+
+    def numpy_run(self) -> None:
+        self.err_output.map_read()
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = self.err_output.mem
+
+    def xla_run(self) -> None:
+        self.err_input.devmem = self.err_output.devmem
