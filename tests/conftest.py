@@ -53,3 +53,33 @@ def make_blobs(n_per_class: int, n_classes: int, dim: int,
     labels = np.repeat(np.arange(n_classes), n_per_class).astype(np.int32)
     order = rng.permutation(len(data))
     return data[order], labels[order]
+
+
+def positional_task_workflow(layers, data_seed=9, prng_seed=11,
+                             t=9, d=8, n_classes=3, max_epochs=30):
+    """Shared builder for 'which third of the sequence carries the
+    signal' workflows (attention/PE/layer-norm tests): returns an
+    initialized-later StandardWorkflow over the synthetic task."""
+    import numpy as np
+
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils import prng
+
+    rng = np.random.default_rng(data_seed)
+    n = 120
+    x = rng.normal(0, 0.3, size=(n, t, d)).astype(np.float32)
+    y = rng.integers(0, n_classes, size=n).astype(np.int32)
+    span = t // n_classes
+    for i in range(n):
+        x[i, y[i] * span:(y[i] + 1) * span] += 1.0
+    prng.seed_all(prng_seed)
+    wf = StandardWorkflow(
+        name="positional_task",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=x[:96], train_labels=y[:96],
+            valid_data=x[96:], valid_labels=y[96:], minibatch_size=24),
+        layers=layers,
+        decision_config={"max_epochs": max_epochs})
+    wf._max_fires = 10 ** 6
+    return wf

@@ -377,30 +377,15 @@ def test_pe_attention_trains_on_positional_task():
     """Class = which third of the sequence carries the energy bump;
     without positions the attention pool is permutation-invariant, so
     passing this bound certifies PE actually injects position."""
-    from znicz_tpu.loader.fullbatch import ArrayLoader
-    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from tests.conftest import positional_task_workflow
 
-    rng = np.random.default_rng(41)
-    n, t, d, n_classes = 120, 9, 8, 3
-    x = rng.normal(0, 0.3, size=(n, t, d)).astype(np.float32)
-    y = rng.integers(0, n_classes, size=n).astype(np.int32)
-    for i in range(n):
-        x[i, y[i] * 3:(y[i] + 1) * 3] += 1.0  # same bump, any third
-    prng.seed_all(42)
-    wf = StandardWorkflow(
-        name="pe_wf",
-        loader_factory=lambda w: ArrayLoader(
-            w, train_data=x[:96], train_labels=y[:96],
-            valid_data=x[96:], valid_labels=y[96:], minibatch_size=24),
-        layers=[
-            {"type": "pos_encoding", "->": {}},
-            {"type": "attention", "->": {"n_heads": 2},
-             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
-            {"type": "softmax", "->": {"output_sample_shape": n_classes},
-             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
-        ],
-        decision_config={"max_epochs": 30})
-    wf._max_fires = 10 ** 6
+    gd = {"learning_rate": 0.05, "gradient_moment": 0.9}
+    wf = positional_task_workflow(
+        [{"type": "pos_encoding", "->": {}},
+         {"type": "attention", "->": {"n_heads": 2}, "<-": gd},
+         {"type": "softmax", "->": {"output_sample_shape": 3},
+          "<-": gd}],
+        data_seed=41, prng_seed=42)
     wf.initialize(device=XLADevice())
     wf.run()
     assert wf.decision.min_validation_n_err_pt <= 25.0

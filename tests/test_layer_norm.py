@@ -1,0 +1,126 @@
+"""LayerNorm: oracle agreement, numeric gradient, and training inside
+a transformer-ish stack (pos_encoding + attention + layer_norm)."""
+
+import numpy as np
+
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.dummy import DummyUnit, DummyWorkflow
+from znicz_tpu.memory import Vector
+from znicz_tpu.ops import layer_norm
+from znicz_tpu.utils import prng
+
+B, T, D = 3, 5, 8
+
+
+def build(device, x, gd=False):
+    prng.seed_all(6)
+    wf = DummyWorkflow()
+    src = DummyUnit(wf, output=Vector(np.asarray(x), name="x"))
+    fwd = layer_norm.LayerNorm(wf)
+    fwd.link_attrs(src, ("input", "output"))
+    fwd.initialize(device=device)
+    if not gd:
+        return fwd
+    unit = layer_norm.GDLayerNorm(wf, learning_rate=0.1,
+                                  gradient_moment=0.9)
+    unit.forward_unit = fwd
+    unit.link_attrs(fwd, "input", "output", "weights", "bias")
+    unit.err_output = Vector(np.zeros_like(x), name="err",
+                             batch_major=True)
+    unit.initialize(device=device)
+    return fwd, unit
+
+
+def _rand(seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return (scale * rng.normal(1.0, 2.0, size=(B, T, D))
+            ).astype(np.float32)
+
+
+def test_forward_oracle_agreement():
+    x = _rand()
+    np_u = build(NumpyDevice(), x)
+    xla_u = build(XLADevice(), x)
+    # non-trivial gamma/beta
+    gamma = np.linspace(0.5, 1.5, D).astype(np.float32)
+    beta = np.linspace(-0.2, 0.2, D).astype(np.float32)
+    for unit in (np_u, xla_u):
+        unit.weights.reset(gamma.copy())
+        unit.bias.reset(beta.copy())
+        unit.weights.initialize(unit.device)
+        unit.bias.initialize(unit.device)
+        unit.run()
+        unit.output.map_read()
+    np.testing.assert_allclose(np_u.output.mem, xla_u.output.mem,
+                               rtol=1e-4, atol=1e-5)
+    # normalized rows: unit variance / zero mean before affine
+    np_u.weights.reset(np.ones(D, np.float32))
+    np_u.bias.reset(np.zeros(D, np.float32))
+    np_u.run()
+    y = np_u.output.mem
+    np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(y.var(-1), 1.0, rtol=1e-3)
+
+
+def test_backward_oracle_vs_xla():
+    x = _rand(1)
+    err = np.random.default_rng(2).normal(
+        size=(B, T, D)).astype(np.float32)
+    results = {}
+    for device in (NumpyDevice(), XLADevice()):
+        fwd, gd_u = build(device, x, gd=True)
+        fwd.run()
+        gd_u.err_output.reset(err.copy())
+        gd_u.err_output.initialize(device)
+        gd_u.run()
+        for vec in (fwd.weights, fwd.bias, gd_u.err_input):
+            vec.map_read()
+        results[type(device).__name__] = (
+            fwd.weights.mem.copy(), fwd.bias.mem.copy(),
+            np.asarray(gd_u.err_input.mem, np.float32).copy())
+    for a, b in zip(results["NumpyDevice"], results["XLADevice"]):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=1e-4)
+
+
+def test_numeric_gradient():
+    x = _rand(3)[:1, :3]
+    np_u, gd_u = build(NumpyDevice(), x, gd=True)
+    np_u.run()
+    c = np.random.default_rng(4).normal(
+        size=np_u.output.shape).astype(np.float32)
+    gd_u.err_output.reset(c.copy())
+    gd_u.learning_rate = 0.0
+    gd_u.gradient_moment = 0.0
+    gd_u.run()
+    gd_u.err_input.map_read()
+    analytic = gd_u.err_input.mem.copy()
+    eps = 1e-3
+    fd = np.zeros_like(x)
+    for idx in np.ndindex(*x.shape):
+        for sign in (1, -1):
+            xp = x.copy()
+            xp[idx] += sign * eps
+            np_u.input.reset(xp)
+            np_u.run()
+            np_u.output.map_read()
+            fd[idx] += sign * float((np_u.output.mem * c).sum())
+    fd /= 2 * eps
+    np.testing.assert_allclose(analytic, fd, rtol=2e-2, atol=2e-3)
+
+
+def test_transformer_stack_trains():
+    """pos_encoding → attention → layer_norm → softmax learns the
+    positional-bump task."""
+    from tests.conftest import positional_task_workflow
+
+    gd = {"learning_rate": 0.05, "gradient_moment": 0.9}
+    wf = positional_task_workflow(
+        [{"type": "pos_encoding", "->": {}},
+         {"type": "attention", "->": {"n_heads": 2}, "<-": gd},
+         {"type": "layer_norm", "->": {}, "<-": gd},
+         {"type": "softmax", "->": {"output_sample_shape": 3},
+          "<-": gd}],
+        data_seed=51, prng_seed=52)
+    wf.initialize(device=XLADevice())
+    wf.run()
+    assert wf.decision.min_validation_n_err_pt <= 25.0
