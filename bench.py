@@ -20,8 +20,10 @@ instead of silence, and (c) fast-fails when no usable backend exists.
 Knobs (env): BENCH_BATCH, BENCH_PRECISION (bfloat16|float32),
 BENCH_TIMEOUT_S (global watchdog), BENCH_PROFILE=<dir> (where the
 jax.profiler trace of the timed loop goes — ON by default into
-profiles/bench_default at ~1-2% overhead; set BENCH_PROFILE="" to
-disable), BENCH_PEAK_TFLOPS (override
+profiles/bench_default at ~1-2% overhead for the device-resident
+mode, OFF by default in stream mode where the trace thread competes
+with the single-core decode pool; set BENCH_PROFILE="" to disable
+everywhere), BENCH_PEAK_TFLOPS (override
 chip peak for MFU), BENCH_INPUT=stream (feed through the streaming
 FileImageLoader: real JPEG decode via the native C++ pool with
 double-buffered prefetch, instead of the device-resident store —
@@ -63,6 +65,11 @@ TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT_S", "900"))
 #: be unexplainable.  BENCH_PROFILE="" disables; set a path to move.
 PROFILE_DIR = os.environ.get(
     "BENCH_PROFILE",
+    # stream mode is HOST-bound (single-core decode pool) and the
+    # profiler competes for that core — measured 816 → 294 img/s with
+    # default tracing on; only the device-resident mode profiles by
+    # default
+    "" if INPUT_MODE == "stream" else
     os.path.join(os.path.dirname(os.path.abspath(__file__)),
                  "profiles", "bench_default"))
 WARMUP_STEPS = 6

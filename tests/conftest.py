@@ -60,8 +60,6 @@ def positional_task_workflow(layers, data_seed=9, prng_seed=11,
     """Shared builder for 'which third of the sequence carries the
     signal' workflows (attention/PE/layer-norm tests): returns an
     initialized-later StandardWorkflow over the synthetic task."""
-    import numpy as np
-
     from znicz_tpu.loader.fullbatch import ArrayLoader
     from znicz_tpu.models.standard_workflow import StandardWorkflow
     from znicz_tpu.utils import prng
