@@ -86,3 +86,37 @@ def test_bf16_close_to_f32_one_epoch():
         wf.run()
         errs[precision] = wf.decision.min_validation_n_err_pt
     assert abs(errs["bfloat16"] - errs["float32"]) <= 10.0
+
+
+def test_bf16_snapshot_resume_exact():
+    """Snapshot/resume with bf16-stored activations: the state tree
+    pickles ml_dtypes host arrays, restores bit-for-bit, and the
+    resumed workflow TRAINS ON from the restored state (re-entering
+    the bf16 jit path)."""
+    from znicz_tpu.utils.config import root as cfg_root
+    from znicz_tpu.utils.snapshotter import Snapshotter
+
+    root.common.precision_type = "bfloat16"
+    prng.seed_all(9)
+    wf = _build()
+    wf.initialize(device=XLADevice())
+    wf.run()
+    state = wf.state_dict()
+    blob_path = Snapshotter.write(
+        state, str(cfg_root.common.dirs.snapshots), "bf16wf", "test")
+    # fresh workflow, resumed: weights must match bit-for-bit
+    prng.seed_all(1)  # different seed: resume must override the init
+    wf2 = _build()
+    wf2.initialize(device=XLADevice())
+    wf2.load_state(Snapshotter.load(blob_path))
+    for a, b in zip(wf.forwards, wf2.forwards):
+        a.weights.map_read()
+        b.weights.map_read()
+        np.testing.assert_array_equal(a.weights.mem, b.weights.mem)
+    assert wf2.loader.epoch_number == wf.loader.epoch_number
+    # and the resumed workflow must actually train onward in bf16
+    wf2.decision.max_epochs = wf2.loader.epoch_number + 2
+    wf2.decision.complete <<= False
+    wf2.run()
+    assert wf2.loader.epoch_number > wf.loader.epoch_number
+    assert wf2.decision.min_validation_n_err_pt <= 10.0
