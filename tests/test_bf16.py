@@ -93,7 +93,6 @@ def test_bf16_snapshot_resume_exact():
     pickles ml_dtypes host arrays, restores bit-for-bit, and the
     resumed workflow TRAINS ON from the restored state (re-entering
     the bf16 jit path)."""
-    from znicz_tpu.utils.config import root as cfg_root
     from znicz_tpu.utils.snapshotter import Snapshotter
 
     root.common.precision_type = "bfloat16"
@@ -103,7 +102,7 @@ def test_bf16_snapshot_resume_exact():
     wf.run()
     state = wf.state_dict()
     blob_path = Snapshotter.write(
-        state, str(cfg_root.common.dirs.snapshots), "bf16wf", "test")
+        state, str(root.common.dirs.snapshots), "bf16wf", "test")
     # fresh workflow, resumed: weights must match bit-for-bit
     prng.seed_all(1)  # different seed: resume must override the init
     wf2 = _build()

@@ -7,8 +7,9 @@ cases.  This module holds those kernels; the first resident is the
 ``znicz/ocl|cuda`` normalization kernels):
 
 - the forward fuses square → sliding channel-window sum → pow →
-  multiply into one VMEM pass over the activations (the jnp
-  composition materializes the padded concat + n shifted adds in HBM);
+  multiply into one VMEM pass over the activations (the plain-XLA
+  path now rides the MXU via a constant band-matrix matmul — see
+  ``normalization._window_sum`` — which is why Pallas stays opt-in);
 - the backward fuses the analytic gradient the same way (one pass,
   two window sums) instead of re-running the forward under ``jax.vjp``.
 
@@ -76,7 +77,8 @@ def use_pallas(device) -> bool:
 def _window_sum(arr, n: int, half_low: int):
     """Sliding sum over the last (lane) axis — the shared xp-generic
     definition traced with jnp inside the kernel."""
-    return _window_sum_xp(jnp, arr, n, half_low=half_low)
+    return _window_sum_xp(jnp, arr, n, half_low=half_low,
+                          via_matmul=False)
 
 
 def _lrn_fwd_kernel(x_ref, o_ref, *, alpha, beta, k, n):
