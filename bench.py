@@ -59,6 +59,8 @@ PRECISION = os.environ.get("BENCH_PRECISION", "bfloat16")
 #: BENCH_PALLAS=1 opts into the Pallas variants (A/B lever; plain XLA
 #: is the measured in-graph winner — see PALLAS_BENCH.md)
 PALLAS = os.environ.get("BENCH_PALLAS", "0") != "0"
+#: BENCH_S2D=1 opts into the space-to-depth conv rewrite (A/B lever)
+S2D = os.environ.get("BENCH_S2D", "0") != "0"
 TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT_S", "900"))
 #: default ON: every bench run leaves a committed-readable trace of
 #: the timed loop (~3 MB; ~1-2% overhead) — perf numbers should never
@@ -212,6 +214,7 @@ def main() -> None:
 
     root.common.precision_type = PRECISION
     root.common.engine.use_pallas = PALLAS
+    root.common.engine.space_to_depth = S2D
 
     # dataset sized a whole number of chunks per epoch so a scanned
     # chunk never spans the epoch-boundary reshuffle (ceil to a
