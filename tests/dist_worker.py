@@ -74,12 +74,28 @@ def build_workflow(tp_dir: "str | None" = None):
     return wf
 
 
+def build_ring_workflow():
+    """Sequence classifier with seq-parallel attention: the time axis
+    shards over the global mesh's model axis, so the ring's ppermute
+    crosses the PROCESS boundary (Gloo on CPU; ICI/DCN on pods) —
+    the multi-process proof of the long-context path.  Reuses the
+    attention_seq zoo sample (one source of truth for the task)."""
+    from znicz_tpu.models.samples import attention_seq
+
+    return attention_seq.build(
+        seq_parallel=True, n_heads=2, seq_len=12, features=8,
+        n_train=72, n_valid=24, minibatch_size=24, max_epochs=10,
+        learning_rate=0.05)
+
+
 def main() -> None:
     process_id = int(sys.argv[1])
     n_processes = int(sys.argv[2])
     coordinator = sys.argv[3]
     out_path = sys.argv[4]
-    tp_dir = sys.argv[5] if len(sys.argv) > 5 else None
+    mode_arg = sys.argv[5] if len(sys.argv) > 5 else None
+    ring_mode = mode_arg == "ring"
+    tp_dir = None if (mode_arg is None or ring_mode) else mode_arg
 
     # 2 virtual CPU devices per process, configured BEFORE any jax use
     # (the container's sitecustomize already imported jax, so go
@@ -91,7 +107,7 @@ def main() -> None:
     from znicz_tpu.launcher import Launcher
     from znicz_tpu.utils import prng
 
-    n_model = 2 if tp_dir else 1
+    n_model = 2 if (tp_dir or ring_mode) else 1
     if process_id == 0:
         launcher = Launcher(listen=coordinator, n_processes=n_processes,
                             n_model=n_model)
@@ -105,13 +121,16 @@ def main() -> None:
     prng.seed_all(1234)
 
     def run(load, main):  # reference sample protocol
-        load(build_workflow, tp_dir=tp_dir)
+        if ring_mode:
+            load(build_ring_workflow)
+        else:
+            load(build_workflow, tp_dir=tp_dir)
         main()
 
     wf = launcher.boot(run)
 
     snapshot_keys = -1
-    if process_id == 0 and tp_dir is None:
+    if process_id == 0 and tp_dir is None and not ring_mode:
         # master-only snapshot: must NOT issue collective reads (the
         # slaves are not in lockstep here) — regression for the
         # Vector.needs_collective_read skip in Unit.state_dict
@@ -135,6 +154,10 @@ def main() -> None:
     wf.forwards[0].weights.map_read()
     wf.forwards[1].weights.map_read()
     digest = {
+        "ring_engaged": bool(getattr(wf.forwards[0], "seq_parallel",
+                                     False)),
+        "ring_time_sharded": getattr(wf.forwards[0].output,
+                                     "model_shard_dim", None) == 1,
         "snapshot_keys": snapshot_keys,
         "tp_snapshot_full_shapes": tp_snapshot_full_shapes,
         "process_id": process_id,
