@@ -34,7 +34,7 @@ from znicz_tpu.loader.base import TRAIN, Loader
 from znicz_tpu.mutable import Bool
 from znicz_tpu.ops import activation, all2all, conv, cutter, dropout, pooling
 from znicz_tpu.ops import attention, deconv, depooling, lstm, normalization
-from znicz_tpu.ops import layer_norm, pos_encoding
+from znicz_tpu.ops import embedding, layer_norm, pos_encoding
 from znicz_tpu.ops import gd, gd_conv, gd_pooling  # noqa: F401 (pairs)
 from znicz_tpu.ops.decision import DecisionGD, DecisionMSE
 from znicz_tpu.ops.lr_adjust import LearningRateAdjust
@@ -94,6 +94,7 @@ for _name, _cls in {
     "attention": attention.MultiHeadAttention,
     "pos_encoding": pos_encoding.PositionalEncoding,
     "layer_norm": layer_norm.LayerNorm,
+    "embedding": embedding.Embedding,
 }.items():
     register_layer_type(_name, _cls)
 

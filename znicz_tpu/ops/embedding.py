@@ -1,0 +1,128 @@
+"""Token embedding — completes the sequence family (beyond the 2015
+reference, which has no discrete-token models; SURVEY.md §5.7 marks
+sequence machinery as this framework's extension).
+
+``y[b, t] = W[tokens[b, t]]`` with a learned (V, D) table.  The loader
+feeds token ids through the regular float ``minibatch_data`` path (the
+unit rounds-and-casts to indices), so every existing loader works
+unchanged.  Forward is a gather (XLA lowers to a dynamic-gather that
+pipelines well on TPU); the backward is the adjoint scatter-add into
+the table gradient, with the standard momentum/decay update riding the
+GD base's ``weights`` machinery.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from znicz_tpu.ops.nn_units import Forward, GradientDescentBase
+
+
+class Embedding(Forward):
+    """Learned lookup table: int-valued (B, T) input → (B, T, D)."""
+
+    def __init__(self, workflow, vocab_size: int, dim: int, name=None,
+                 **kwargs) -> None:
+        kwargs.setdefault("weights_filling", "gaussian")
+        kwargs.setdefault("weights_stddev", 0.02)
+        kwargs.setdefault("include_bias", False)
+        super().__init__(workflow, name=name, **kwargs)
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        if len(self.input.shape) != 2:
+            raise ValueError(f"{self}: expected (batch, time) token "
+                             f"input, got {self.input.shape}")
+        # token ids ride the loader's float minibatch path — the
+        # storage dtype must represent every id EXACTLY (bf16's 8-bit
+        # mantissa corrupts integers above 256 silently)
+        max_exact = {2: 256, 4: 2 ** 24, 8: 2 ** 53}.get(
+            np.dtype(self.input.dtype).itemsize, 2 ** 24)
+        if self.vocab_size - 1 > max_exact:
+            raise ValueError(
+                f"{self}: vocab_size {self.vocab_size} exceeds the "
+                f"largest integer the input storage dtype "
+                f"{self.input.dtype} represents exactly ({max_exact}) "
+                f"— disable bf16 activation storage "
+                f"(root.common.engine.bf16_activations=False) or use "
+                f"a smaller vocabulary")
+        if not self.weights:
+            self.weights.reset(self.fill_array(
+                (self.vocab_size, self.dim), self.weights_filling,
+                self.weights_stddev, fan_in=self.dim))
+        b, t = self.input.shape
+        self.output.reset(np.zeros((b, t, self.dim),
+                                   dtype=self.output_store_dtype))
+        self.init_vectors(self.input, self.output, self.weights)
+
+    def _tokens(self, xp, x):
+        """Loader data arrives as floats; round to table indices and
+        clip into range (out-of-vocab ids clamp to the last row)."""
+        idx = xp.round(xp.asarray(x).astype(xp.float32)).astype(xp.int32)
+        return xp.clip(idx, 0, self.vocab_size - 1)
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.weights.map_read()
+        self.output.map_invalidate()
+        tokens = self._tokens(np, self.input.mem)
+        self.output.mem[...] = self.weights.mem[tokens]
+
+    def xla_run(self) -> None:
+        tokens = self._tokens(jnp, self.input.devmem)
+        self.output.devmem = jnp.take(self.weights.devmem, tokens,
+                                      axis=0)
+
+
+class GDEmbedding(GradientDescentBase):
+    """Embedding backward: scatter-add of the error into the table
+    gradient (the gather's adjoint).  First-layer unit — there is no
+    err_input (token ids have no gradient)."""
+
+    MATCHES = (Embedding,)
+    REQUIRES_FORWARD_UNIT = True
+    REQUIRES_INPUT = True
+
+    def __init__(self, workflow, name=None, **kwargs):
+        kwargs.setdefault("need_err_input", False)
+        super().__init__(workflow, name=name, **kwargs)
+        self.forward_unit: Embedding | None = None
+
+    def initialize(self, device=None, **kwargs) -> None:
+        if self.need_err_input:
+            # token ids have no gradient — a layer wired BEFORE an
+            # embedding would silently receive the zeros err_input the
+            # base allocates; fail loudly instead
+            raise ValueError(
+                f"{self}: embedding must be the first trainable layer "
+                f"(need_err_input=True was requested but token ids "
+                f"have no gradient)")
+        super().initialize(device=device, **kwargs)
+        self.init_vectors(self.err_output, self.input, self.weights)
+
+    def numpy_run(self) -> None:
+        fwd = self.forward_unit
+        for vec in (self.err_output, self.input):
+            vec.map_read()
+        self.weights.map_write()
+        tokens = fwd._tokens(np, self.input.mem).reshape(-1)
+        err = np.asarray(self.err_output.mem,
+                         np.float32).reshape(len(tokens), -1)
+        grad_w = np.zeros_like(self.weights.mem)
+        np.add.at(grad_w, tokens, err)
+        self._apply_weights_np(grad_w)
+
+    def xla_run(self) -> None:
+        fwd = self.forward_unit
+        tokens = fwd._tokens(jnp, self.input.devmem).reshape(-1)
+        err = self.err_output.devmem.astype(jnp.float32)
+        err = err.reshape(tokens.shape[0], -1)
+        grad_w = jnp.zeros(fwd.weights.shape, jnp.float32)
+        grad_w = grad_w.at[tokens].add(err)
+        self._apply_weights_xla(grad_w)
