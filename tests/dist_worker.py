@@ -21,7 +21,8 @@ import json
 import sys
 
 
-def build_workflow(tp_dir: "str | None" = None):
+def build_workflow(tp_dir: "str | None" = None, learning_rate=0.1,
+                   max_epochs=3):
     """Tiny blob-classification MLP, mirroring the layer/optimizer
     config of ``tests/test_parallel.build``.  The data generator is
     duplicated here on purpose: importing ``tests.conftest`` (where
@@ -58,15 +59,18 @@ def build_workflow(tp_dir: "str | None" = None):
             {"type": "all2all_tanh",
              "->": {"output_sample_shape": 16,
                     "model_parallel": "column" if tp_dir else None},
-             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+             "<-": {"learning_rate": learning_rate,
+                    "gradient_moment": 0.9}},
             {"type": "all2all_tanh",
              "->": {"output_sample_shape": 12,
                     "model_parallel": "row" if tp_dir else None},
-             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+             "<-": {"learning_rate": learning_rate,
+                    "gradient_moment": 0.9}},
             {"type": "softmax", "->": {"output_sample_shape": n_classes},
-             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+             "<-": {"learning_rate": learning_rate,
+                    "gradient_moment": 0.9}},
         ],
-        decision_config={"max_epochs": 3},
+        decision_config={"max_epochs": max_epochs},
         snapshotter_config=(
             None if tp_dir is None
             else {"prefix": "dist_tp", "directory": tp_dir}))
@@ -88,6 +92,45 @@ def build_ring_workflow():
         learning_rate=0.05)
 
 
+def run_genetics(launcher) -> dict:
+    """Process-sharded GA: both processes hold the identical
+    deterministic population, train disjoint genome slices on local
+    devices, and all-gather the scores — the TPU restatement of the
+    reference's genome-per-cluster-node farm (``veles/genetics/``)."""
+    from znicz_tpu.genetics import GeneticsOptimizer, Tune
+
+    opt = GeneticsOptimizer(
+        build_fn=lambda **kw: build_workflow(**kw),
+        space={"learning_rate": Tune(0.1, 0.02, 0.5)},
+        population_size=4, generations=2, seed=11,
+        train_kwargs={"max_epochs": 2})
+    best = opt.run()
+    return {
+        "ga_best_genome": best,
+        "ga_best_fitness": float(opt.best_fitness),
+        "ga_local_evaluated": sorted(str(k) for k in opt.local_evaluated),
+        "ga_n_unique": len(opt._cache),
+    }
+
+
+def run_ensemble(launcher) -> dict:
+    """Process-sharded ensemble: 3 members round-robin over 2
+    processes (0 trains members 0 and 2, 1 trains member 1), merged
+    aggregate evaluation identical everywhere."""
+    from znicz_tpu.ensemble import Ensemble
+    from znicz_tpu.loader.base import VALID
+
+    ens = Ensemble(build_workflow, n_models=3, base_seed=42,
+                   train_kwargs={"max_epochs": 2})
+    ens.train()
+    result = ens.evaluate(VALID)
+    return {
+        "ens_member_ids": list(ens.member_ids),
+        "ens_member_stats": ens.member_stats,
+        "ens_result": result,
+    }
+
+
 def main() -> None:
     process_id = int(sys.argv[1])
     n_processes = int(sys.argv[2])
@@ -95,7 +138,9 @@ def main() -> None:
     out_path = sys.argv[4]
     mode_arg = sys.argv[5] if len(sys.argv) > 5 else None
     ring_mode = mode_arg == "ring"
-    tp_dir = None if (mode_arg is None or ring_mode) else mode_arg
+    shard_mode = mode_arg in ("genetics", "ensemble")
+    tp_dir = None if (mode_arg is None or ring_mode or shard_mode) \
+        else mode_arg
 
     # 2 virtual CPU devices per process, configured BEFORE any jax use
     # (the container's sitecustomize already imported jax, so go
@@ -119,6 +164,19 @@ def main() -> None:
     assert len(jax.devices()) == 2 * n_processes
 
     prng.seed_all(1234)
+
+    if shard_mode:
+        digest = (run_genetics(launcher) if mode_arg == "genetics"
+                  else run_ensemble(launcher))
+        digest.update({
+            "process_id": process_id,
+            "mode": launcher.mode,
+            "n_global_devices": len(jax.devices()),
+        })
+        with open(out_path, "w") as fh:
+            json.dump(digest, fh)
+        print(f"worker {process_id}: OK {digest}", flush=True)
+        return
 
     def run(load, main):  # reference sample protocol
         if ring_mode:

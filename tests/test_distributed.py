@@ -37,7 +37,8 @@ def _free_port() -> int:
         return sock.getsockname()[1]
 
 
-def _run_workers(tmp_path, extra_args=()) -> list[dict]:
+def _run_workers(tmp_path, extra_args=(),
+                 agree_keys=AGREE_KEYS) -> list[dict]:
     """Spawn the 2-process worker harness and return both digests
     (one launch/communicate/assert implementation for every mode)."""
     coordinator = f"127.0.0.1:{_free_port()}"
@@ -70,7 +71,7 @@ def _run_workers(tmp_path, extra_args=()) -> list[dict]:
         assert proc.returncode == 0, \
             f"worker {proc.args[2]} failed:\n{stdout[-4000:]}"
     digests = [json.loads(out.read_text()) for out in outs]
-    for key in AGREE_KEYS:
+    for key in agree_keys:
         assert digests[0][key] == digests[1][key], \
             f"{key}: master {digests[0][key]} != slave {digests[1][key]}"
     return digests
@@ -127,3 +128,39 @@ def test_two_process_ring_attention(tmp_path):
     # 24 validation samples, 3 classes: chance ≈ 16 errors; the
     # attention net must do clearly better through the ring gradients
     assert master["min_validation_n_err"] <= 8
+
+
+@pytest.mark.slow
+def test_two_process_sharded_genetics(tmp_path):
+    """Population parallelism (reference: ``veles/genetics/`` farmed
+    one genome per cluster node): each process trains the genome slice
+    ``pending[p::2]`` locally, the scores all-gather once per
+    generation, and both processes must converge on the IDENTICAL best
+    genome while having trained DISJOINT genome sets."""
+    master, slave = _run_workers(
+        tmp_path, extra_args=("genetics",),
+        agree_keys=("ga_best_genome", "ga_best_fitness", "ga_n_unique"))
+    evaluated = [set(d["ga_local_evaluated"]) for d in (master, slave)]
+    assert evaluated[0] and evaluated[1], \
+        "a process evaluated nothing — work was not sharded"
+    assert not (evaluated[0] & evaluated[1]), \
+        f"processes retrained the same genomes: {evaluated}"
+    assert len(evaluated[0]) + len(evaluated[1]) == \
+        master["ga_n_unique"], "evaluated sets do not cover the cache"
+
+
+@pytest.mark.slow
+def test_two_process_sharded_ensemble(tmp_path):
+    """Ensemble parallelism: 3 members round-robin over 2 processes
+    (0 trains members 0,2; 1 trains member 1); the merged aggregate
+    evaluation — probability sums, per-member and ensemble error — is
+    identical on every process."""
+    master, slave = _run_workers(
+        tmp_path, extra_args=("ensemble",),
+        agree_keys=("ens_result", "ens_member_stats"))
+    assert master["ens_member_ids"] == [0, 2]
+    assert slave["ens_member_ids"] == [1]
+    result = master["ens_result"]
+    assert result["n_samples"] == 24  # 120 blobs - 96 train
+    assert len(result["member_err_pt"]) == 3
+    assert 0.0 <= result["ensemble_err_pt"] <= 100.0

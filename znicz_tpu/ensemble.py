@@ -5,13 +5,21 @@ of the same workflow (different seeds), followed by an aggregated
 evaluation pass (averaged class probabilities) that is typically
 better than any single member.
 
-The reference trained members as separate cluster jobs; here members
-train sequentially on the local device (process-level scale-out mirrors
-genetics: with ``jax.distributed``, process *p* trains members
-``p::process_count``).  The aggregated pass replays each member's
-validation/test minibatches through its compiled hot chain — backward
-units stay gated off on non-train classes, dropout runs in eval mode —
-and averages the softmax outputs per sample.
+The reference trained members as separate cluster jobs; here
+process-level scale-out mirrors genetics: with ``jax.distributed``,
+process *p* trains members ``p::process_count`` on its local devices
+(collective-free — members are independent runs), then ``evaluate``
+merges the per-process probability sums and member error rates with
+lockstep all-gathers, so every process returns the identical ensemble
+result.  Single-process trains members sequentially with zero jax
+collectives.  Tested across real OS processes in
+``tests/test_distributed.py`` (``ensemble`` mode: disjoint member
+sets, identical aggregated result).
+
+The aggregated pass replays each member's validation/test minibatches
+through its compiled hot chain — backward units stay gated off on
+non-train classes, dropout runs in eval mode — and averages the
+softmax outputs per sample.
 """
 
 from __future__ import annotations
@@ -21,6 +29,11 @@ from typing import Callable
 import numpy as np
 
 from znicz_tpu.loader.base import TRAIN, VALID
+from znicz_tpu.parallel.process_shard import (allgather_sum,
+                                              broadcast_from_zero,
+                                              local_eval_device,
+                                              merge_round_robin,
+                                              process_info)
 from znicz_tpu.utils.logger import Logger
 
 
@@ -80,20 +93,29 @@ class Ensemble(Logger):
         self.base_seed = int(base_seed)
         self.device_factory = device_factory
         self.train_kwargs = dict(train_kwargs or {})
-        self.workflows: list = []
-        self.member_stats: list[dict] = []
+        self.workflows: list = []           # members trained locally
+        self.member_ids: list[int] = []     # their GLOBAL member indices
+        self.member_stats: list[dict] = []  # ALL members (gathered)
 
     # ------------------------------------------------------------------
     def train(self) -> "Ensemble":
         from znicz_tpu.backends import Device
         from znicz_tpu.utils import prng
+        pidx, pcount = process_info()
         self.workflows = []
-        self.member_stats = []
+        self.member_ids = []
+        local_err_pt: list[float] = []
         for i in range(self.n_models):
+            if i % pcount != pidx:
+                continue
             prng.seed_all(self.base_seed + i)
             wf = self.build_fn(**self.train_kwargs)
-            device = (self.device_factory() if self.device_factory
-                      else Device.create())
+            if self.device_factory:
+                device = self.device_factory()
+            elif pcount > 1:
+                device = local_eval_device()
+            else:
+                device = Device.create()
             wf.initialize(device=device)
             wf.run()
             d = wf.decision
@@ -104,26 +126,66 @@ class Ensemble(Logger):
             self.info("member %d/%d trained: %s", i + 1,
                       self.n_models, stats)
             self.workflows.append(wf)
-            self.member_stats.append(stats)
+            self.member_ids.append(i)
+            local_err_pt.append(stats.get("validation_err_pt", np.nan))
+        self.member_stats = self._gather_member_stats(
+            local_err_pt, pidx, pcount)
         return self
 
+    def _gather_member_stats(self, local_err_pt: list[float],
+                             pidx: int, pcount: int) -> list[dict]:
+        """Per-member stats for ALL members, identical on every
+        process.  Member *i* lives on process ``i % pcount`` at local
+        slot ``i // pcount`` — the round-robin inverse."""
+        if pcount == 1:
+            return [{"seed": self.base_seed + i,
+                     "validation_err_pt": err_pt}
+                    if not np.isnan(err_pt)
+                    else {"seed": self.base_seed + i}
+                    for i, err_pt in enumerate(local_err_pt)]
+        merged = merge_round_robin(local_err_pt, pidx, pcount,
+                                   self.n_models)
+        stats = []
+        for i in range(self.n_models):
+            entry = {"seed": self.base_seed + i}
+            if not np.isnan(merged[i]):
+                entry["validation_err_pt"] = float(merged[i])
+            stats.append(entry)
+        return stats
+
     # ------------------------------------------------------------------
+    _SPLIT_DISAGREES = (
+        "members disagree on sample labels: the loader's class split "
+        "depends on the PRNG seed; give the loader a fixed split (or "
+        "its own prng_name) so every member sees the same sample at "
+        "the same global index")
+
     def evaluate(self, klass: int = VALID) -> dict:
         """Aggregate evaluation on ``klass`` minibatches.
 
         Returns per-member error percentages and the ensemble's
-        (averaged class probabilities → argmax)."""
-        if not self.workflows:
+        (averaged class probabilities → argmax).  Multi-process: every
+        process contributes its local members' probability sums and
+        receives the identical merged result."""
+        pidx, pcount = process_info()
+        trained = self.workflows if pcount == 1 else self.member_stats
+        if not trained:
             raise RuntimeError("train() first")
         if klass == TRAIN:
             raise ValueError("evaluate on VALID or TEST, not TRAIN")
         sum_probs: dict[int, np.ndarray] = {}
         labels: dict[int, int] = {}
         member_errs: list[float] = []
+        # In multi-process mode a LOCAL failure must not raise before
+        # the collectives — a lone raise would leave the peers blocked
+        # in _evaluate_merge's broadcasts.  Record it; the merge
+        # gathers the failure flags so every process raises together.
+        local_error: str | None = None
         for wf in self.workflows:
             outputs, wf_labels = class_forward_pass(wf, klass)
             if not outputs:
-                raise ValueError(f"loader has no class-{klass} samples")
+                local_error = f"loader has no class-{klass} samples"
+                break
             errs = 0
             for gi, probs in outputs.items():
                 if int(np.argmax(probs)) != wf_labels[gi]:
@@ -137,13 +199,16 @@ class Ensemble(Logger):
                 # validation via the global PRNG) would silently
                 # average probabilities of unrelated samples
                 if labels.setdefault(gi, wf_labels[gi]) != wf_labels[gi]:
-                    raise ValueError(
-                        "members disagree on sample labels: the "
-                        "loader's class split depends on the PRNG "
-                        "seed; give the loader a fixed split (or its "
-                        "own prng_name) so every member sees the same "
-                        "sample at the same global index")
+                    local_error = self._SPLIT_DISAGREES
+                    break
+            if local_error:
+                break
             member_errs.append(100.0 * errs / len(outputs))
+        if pcount > 1:
+            return self._evaluate_merge(sum_probs, labels, member_errs,
+                                        local_error, pidx, pcount)
+        if local_error:
+            raise ValueError(local_error)
         ens_errs = sum(
             1 for gi, probs in sum_probs.items()
             if int(np.argmax(probs)) != labels[gi])
@@ -153,4 +218,56 @@ class Ensemble(Logger):
             "ensemble_err_pt": 100.0 * ens_errs / len(sum_probs),
         }
         self.info("ensemble eval: %s", result)
+        return result
+
+    def _evaluate_merge(self, sum_probs: dict, labels: dict,
+                        member_errs: list, local_error: "str | None",
+                        pidx: int, pcount: int) -> dict:
+        """Lockstep cross-process merge of the aggregate pass.
+
+        Process 0 always trained member 0 (round-robin), so its index
+        set defines the reference sample order; a process with no
+        members (``n_models < process_count``) contributes zeros.
+        Failures (a local one recorded by ``evaluate``, or a
+        cross-process split disagreement) are gathered as FLAGS before
+        raising, so every process raises together — a lone raise would
+        deadlock the peers in the later collectives."""
+        if allgather_sum(np.array([1.0 if local_error else 0.0]))[0] > 0:
+            raise ValueError(local_error or
+                             "a peer process failed the ensemble "
+                             "aggregate pass")
+        have = bool(sum_probs)
+        idxs = np.array(sorted(sum_probs), np.int64) if have \
+            else np.zeros(0, np.int64)
+        meta = broadcast_from_zero(
+            np.array([len(idxs),
+                      len(next(iter(sum_probs.values()))) if have
+                      else 0], np.int64))
+        n_samples, n_classes = int(meta[0]), int(meta[1])
+        ref_idx = broadcast_from_zero(
+            idxs if pidx == 0 else np.zeros(n_samples, np.int64))
+        ref_lab = broadcast_from_zero(
+            np.array([labels[g] for g in idxs], np.int64)
+            if pidx == 0 else np.zeros(n_samples, np.int64))
+        mismatch = 0.0
+        if have:
+            local_lab = np.array([labels[g] for g in idxs], np.int64)
+            if (not np.array_equal(idxs, ref_idx)
+                    or not np.array_equal(local_lab, ref_lab)):
+                mismatch = 1.0
+        if allgather_sum(np.array([mismatch]))[0] > 0:
+            raise ValueError(self._SPLIT_DISAGREES)
+        partial = (np.stack([sum_probs[g] for g in ref_idx]) if have
+                   else np.zeros((n_samples, n_classes)))
+        total = allgather_sum(partial)
+        merged_errs = merge_round_robin(member_errs, pidx, pcount,
+                                        self.n_models)
+        ens_errs = int((total.argmax(axis=1) != ref_lab).sum())
+        result = {
+            "n_samples": n_samples,
+            "member_err_pt": [float(e) for e in merged_errs],
+            "ensemble_err_pt": 100.0 * ens_errs / n_samples,
+        }
+        self.info("ensemble eval (merged over %d processes): %s",
+                  pcount, result)
         return result

@@ -10,8 +10,13 @@ through the master–slave launcher; here evaluation is a plain callable
 (train a workflow on the local device by default), and multi-host
 scale-out is process-level — with ``jax.distributed`` each process
 evaluates ``genomes[process_index::process_count]`` and the scores are
-all-gathered, replacing the reference's job queue.  The GA itself is
-deterministic given its seed.
+all-gathered once per generation (``_score_population``), replacing
+the reference's job queue.  Each evaluation is collective-free (local
+devices only), so differently-sized local slices cannot deadlock; the
+GA's own PRNG stream is consumed identically on every process, so the
+populations — and therefore the work lists — agree by construction.
+Tested across real OS processes in ``tests/test_distributed.py``
+(``genetics`` mode: disjoint evaluation sets, identical best genome).
 """
 
 from __future__ import annotations
@@ -20,6 +25,9 @@ from typing import Any, Callable
 
 import numpy as np
 
+from znicz_tpu.parallel.process_shard import (local_eval_device,
+                                              merge_sharded_scores,
+                                              process_info)
 from znicz_tpu.utils.logger import Logger
 
 
@@ -142,6 +150,9 @@ class GeneticsOptimizer(Logger):
         self.best_genome: dict | None = None
         self.best_fitness = -np.inf
         self._cache: dict[tuple, float] = {}
+        #: genome keys THIS process trained (disjoint across processes
+        #: in multi-process mode; every fresh genome in single-process)
+        self.local_evaluated: list[tuple] = []
 
     # ------------------------------------------------------------------
     def _train_fitness(self, genome: dict) -> float:
@@ -157,8 +168,14 @@ class GeneticsOptimizer(Logger):
         kwargs = apply_genome(genome)
         kwargs.update(self.train_kwargs)
         wf = self.build_fn(**kwargs)
-        device = (self.device_factory() if self.device_factory
-                  else Device.create())
+        if self.device_factory:
+            device = self.device_factory()
+        elif process_info()[1] > 1:
+            # multi-process: evaluate on LOCAL devices only — each
+            # genome is an independent run, no cross-process collectives
+            device = local_eval_device()
+        else:
+            device = Device.create()
         wf.initialize(device=device)
         wf.run()
         return workflow_fitness(wf)
@@ -200,11 +217,32 @@ class GeneticsOptimizer(Logger):
                     + self.rng.normal(0.0, span * self.mutation_sigma))
         return out
 
-    def _score(self, genome: dict) -> float:
-        key = tuple(sorted(genome.items()))
-        if key not in self._cache:
-            self._cache[key] = float(self.fitness_fn(dict(genome)))
-        return self._cache[key]
+    def _score_population(self, population: list[dict]) -> list[float]:
+        """Score one generation; with ``jax.distributed``, process *p*
+        trains the fresh genomes ``pending[p::process_count]`` and the
+        scores merge in one all-gather (docstring contract above).
+        Cache hits (elites, duplicate children) never retrain."""
+        keys = [tuple(sorted(g.items())) for g in population]
+        pending, seen = [], set()
+        for key, genome in zip(keys, population):
+            if key not in self._cache and key not in seen:
+                seen.add(key)
+                pending.append((key, genome))
+        pidx, pcount = process_info()
+        if pcount > 1 and pending:
+            scores = np.zeros(len(pending), np.float64)
+            for i in range(pidx, len(pending), pcount):
+                key, genome = pending[i]
+                self.local_evaluated.append(key)
+                scores[i] = float(self.fitness_fn(dict(genome)))
+            merged = merge_sharded_scores(scores, pcount)
+            for i, (key, _) in enumerate(pending):
+                self._cache[key] = float(merged[i])
+        else:
+            for key, genome in pending:
+                self.local_evaluated.append(key)
+                self._cache[key] = float(self.fitness_fn(dict(genome)))
+        return [self._cache[k] for k in keys]
 
     def _select(self, scored: list[tuple[float, dict]]) -> dict:
         """Tournament of 2 over the current generation."""
@@ -217,8 +255,9 @@ class GeneticsOptimizer(Logger):
         """Evolve; returns the best genome found."""
         population = self._initial_population()
         for gen in range(self.generations):
+            scores = self._score_population(population)
             scored = sorted(
-                ((self._score(g), g) for g in population),
+                zip(scores, population),
                 key=lambda t: t[0], reverse=True)
             if scored[0][0] > self.best_fitness:
                 self.best_fitness, self.best_genome = \

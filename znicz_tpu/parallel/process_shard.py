@@ -1,0 +1,85 @@
+"""Process-level work sharding for population/ensemble evaluation.
+
+The reference farmed independent jobs (genomes, ensemble members)
+across the cluster through the master's job queue (reference:
+``veles/genetics/__init__.py``, ``veles/ensemble/``; SURVEY.md §2.5
+"population parallelism").  The TPU-first restatement: under
+``jax.distributed`` every process holds the same deterministic work
+list, evaluates the round-robin slice ``work[process_index::
+process_count]`` on its *local* devices (no cross-process collectives
+inside an evaluation — each job is an independent training run), and
+the scores are merged with one all-gather per generation.  Single
+process degrades to plain serial evaluation with zero jax calls.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def process_info() -> tuple[int, int]:
+    """``(process_index, process_count)`` — (0, 1) when jax is not
+    initialized for multi-process."""
+    import jax
+    try:
+        count = jax.process_count()
+    except Exception:  # pragma: no cover - jax always importable here
+        return 0, 1
+    return (jax.process_index(), count) if count > 1 else (0, 1)
+
+
+def local_eval_device():
+    """An :class:`~znicz_tpu.backends.XLADevice` pinned to this
+    process's first *addressable* device — the evaluation device for
+    process-sharded jobs.  (``XLADevice()``'s default ``jax.devices()
+    [0]`` is a process-0 device globally; non-zero processes cannot
+    place buffers there.)"""
+    import jax
+
+    from znicz_tpu.backends import XLADevice
+    return XLADevice(device=jax.local_devices()[0])
+
+
+def merge_sharded_scores(scores: np.ndarray, owner_stride: int
+                         ) -> np.ndarray:
+    """All-gather a round-robin-sharded score vector.
+
+    ``scores[i]`` is valid only on process ``i % owner_stride`` (the
+    process that evaluated job *i*); other slots are don't-care.  Every
+    process calls this in lockstep; returns the merged vector where
+    slot *i* comes from its owning process.  ``owner_stride`` is the
+    process count."""
+    from jax.experimental import multihost_utils
+    gathered = np.asarray(
+        multihost_utils.process_allgather(np.asarray(scores, np.float64)))
+    # gathered: (process_count, n) — row p is process p's local vector
+    merged = np.empty_like(gathered[0])
+    for i in range(merged.shape[0]):
+        merged[i] = gathered[i % owner_stride, i]
+    return merged
+
+
+def merge_round_robin(local_values, pidx: int, pcount: int,
+                      n: int) -> np.ndarray:
+    """Merge per-job values when job *i* lives on process ``i %
+    pcount`` at local slot ``i // pcount`` (the round-robin inverse):
+    scatter this process's values into its global slots, then gather.
+    ``local_values`` must have length ``len(range(pidx, n, pcount))``."""
+    scores = np.full(n, np.nan)
+    scores[pidx::pcount] = local_values
+    return merge_sharded_scores(scores, pcount)
+
+
+def allgather_sum(partial: np.ndarray) -> np.ndarray:
+    """Sum a per-process partial array across processes (lockstep)."""
+    from jax.experimental import multihost_utils
+    gathered = np.asarray(multihost_utils.process_allgather(
+        np.asarray(partial, np.float64)))
+    return gathered.sum(axis=0)
+
+
+def broadcast_from_zero(arr: np.ndarray) -> np.ndarray:
+    """Broadcast process 0's array to every process (lockstep)."""
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.broadcast_one_to_all(
+        np.asarray(arr)))
