@@ -92,7 +92,7 @@ def build_ring_workflow():
         learning_rate=0.05)
 
 
-def run_genetics(launcher) -> dict:
+def run_genetics() -> dict:
     """Process-sharded GA: both processes hold the identical
     deterministic population, train disjoint genome slices on local
     devices, and all-gather the scores — the TPU restatement of the
@@ -113,7 +113,7 @@ def run_genetics(launcher) -> dict:
     }
 
 
-def run_ensemble(launcher) -> dict:
+def run_ensemble() -> dict:
     """Process-sharded ensemble: 3 members round-robin over 2
     processes (0 trains members 0 and 2, 1 trains member 1), merged
     aggregate evaluation identical everywhere."""
@@ -166,8 +166,8 @@ def main() -> None:
     prng.seed_all(1234)
 
     if shard_mode:
-        digest = (run_genetics(launcher) if mode_arg == "genetics"
-                  else run_ensemble(launcher))
+        digest = (run_genetics() if mode_arg == "genetics"
+                  else run_ensemble())
         digest.update({
             "process_id": process_id,
             "mode": launcher.mode,

@@ -85,9 +85,18 @@ def test_digits_golden_bound():
                     reason="MNIST idx files not present under "
                            "root.common.dirs.datasets/mnist")
 def test_mnist_real_golden_bound():
-    """With the real idx files on disk the 784-100-10 sample must hit
-    the reference-era accuracy: ≤240 of 6000 validation errors (≥96%)
-    in 10 epochs."""
+    """With the real idx files on disk the 784-100-10 sample should
+    hit reference-era accuracy in 10 epochs.
+
+    HONESTY NOTE: the ≤240/6000 bound is EXTRAPOLATED from the
+    reference's reported MNIST accuracy (SURVEY.md §6), not measured —
+    this environment has no real MNIST files, so this test has never
+    executed.  The idx parse path itself IS covered
+    (tests/test_dataset_readers.py feeds synthetic idx-format files
+    through the same ``load_mnist`` route, including an end-to-end
+    training run); only the bound's value awaits real data.  First run
+    with real MNIST: treat a failure here as 'recalibrate the bound',
+    not 'regression'."""
     from znicz_tpu.models.samples import mnist
 
     wf = mnist.build(max_epochs=10)

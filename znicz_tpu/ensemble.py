@@ -31,8 +31,8 @@ import numpy as np
 from znicz_tpu.loader.base import TRAIN, VALID
 from znicz_tpu.parallel.process_shard import (allgather_sum,
                                               broadcast_from_zero,
-                                              local_eval_device,
                                               merge_round_robin,
+                                              pick_eval_device,
                                               process_info)
 from znicz_tpu.utils.logger import Logger
 
@@ -99,25 +99,29 @@ class Ensemble(Logger):
 
     # ------------------------------------------------------------------
     def train(self) -> "Ensemble":
-        from znicz_tpu.backends import Device
         from znicz_tpu.utils import prng
         pidx, pcount = process_info()
         self.workflows = []
         self.member_ids = []
         local_err_pt: list[float] = []
+        local_exc: "Exception | None" = None
         for i in range(self.n_models):
             if i % pcount != pidx:
                 continue
-            prng.seed_all(self.base_seed + i)
-            wf = self.build_fn(**self.train_kwargs)
-            if self.device_factory:
-                device = self.device_factory()
-            elif pcount > 1:
-                device = local_eval_device()
-            else:
-                device = Device.create()
-            wf.initialize(device=device)
-            wf.run()
+            try:
+                prng.seed_all(self.base_seed + i)
+                wf = self.build_fn(**self.train_kwargs)
+                device = pick_eval_device(self.device_factory)
+                wf.initialize(device=device)
+                wf.run()
+            except Exception as exc:
+                if pcount == 1:
+                    raise
+                # multi-process: a lone raise would leave the peers
+                # blocked in the stats-merge collective below — record
+                # the failure, gather flags, raise together
+                local_exc = exc
+                break
             d = wf.decision
             stats = {"seed": self.base_seed + i}
             if getattr(d, "min_validation_n_err_pt", None) is not None:
@@ -128,6 +132,11 @@ class Ensemble(Logger):
             self.workflows.append(wf)
             self.member_ids.append(i)
             local_err_pt.append(stats.get("validation_err_pt", np.nan))
+        if pcount > 1 and allgather_sum(
+                np.array([1.0 if local_exc else 0.0]))[0] > 0:
+            raise RuntimeError(
+                "ensemble member training failed on a process; every "
+                "process aborts together") from local_exc
         self.member_stats = self._gather_member_stats(
             local_err_pt, pidx, pcount)
         return self

@@ -25,8 +25,8 @@ from typing import Any, Callable
 
 import numpy as np
 
-from znicz_tpu.parallel.process_shard import (local_eval_device,
-                                              merge_sharded_scores,
+from znicz_tpu.parallel.process_shard import (merge_sharded_scores,
+                                              pick_eval_device,
                                               process_info)
 from znicz_tpu.utils.logger import Logger
 
@@ -157,7 +157,6 @@ class GeneticsOptimizer(Logger):
     # ------------------------------------------------------------------
     def _train_fitness(self, genome: dict) -> float:
         """Default fitness: train a fresh workflow, score validation."""
-        from znicz_tpu.backends import Device
         from znicz_tpu.utils import prng
         from znicz_tpu.utils.config import root
         if self.build_fn is None:
@@ -168,14 +167,9 @@ class GeneticsOptimizer(Logger):
         kwargs = apply_genome(genome)
         kwargs.update(self.train_kwargs)
         wf = self.build_fn(**kwargs)
-        if self.device_factory:
-            device = self.device_factory()
-        elif process_info()[1] > 1:
-            # multi-process: evaluate on LOCAL devices only — each
-            # genome is an independent run, no cross-process collectives
-            device = local_eval_device()
-        else:
-            device = Device.create()
+        # multi-process: evaluates on LOCAL devices only — each genome
+        # is an independent run, no cross-process collectives
+        device = pick_eval_device(self.device_factory)
         wf.initialize(device=device)
         wf.run()
         return workflow_fitness(wf)
@@ -230,12 +224,26 @@ class GeneticsOptimizer(Logger):
                 pending.append((key, genome))
         pidx, pcount = process_info()
         if pcount > 1 and pending:
+            # a local fitness failure must not raise before the merge
+            # collective (a lone raise would leave peers blocked in
+            # process_allgather): record NaN, raise together after
             scores = np.zeros(len(pending), np.float64)
+            local_exc: Exception | None = None
             for i in range(pidx, len(pending), pcount):
                 key, genome = pending[i]
                 self.local_evaluated.append(key)
-                scores[i] = float(self.fitness_fn(dict(genome)))
+                try:
+                    scores[i] = float(self.fitness_fn(dict(genome)))
+                except Exception as exc:
+                    local_exc = exc
+                    scores[i] = np.nan
+                    break
             merged = merge_sharded_scores(scores, pcount)
+            if np.isnan(merged).any():
+                raise RuntimeError(
+                    "fitness evaluation failed on a process (NaN "
+                    "fitness or exception); every process aborts the "
+                    "GA together") from local_exc
             for i, (key, _) in enumerate(pending):
                 self._cache[key] = float(merged[i])
         else:

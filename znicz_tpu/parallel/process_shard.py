@@ -40,6 +40,32 @@ def local_eval_device():
     return XLADevice(device=jax.local_devices()[0])
 
 
+def pick_eval_device(device_factory=None):
+    """The one device-selection policy for process-sharded jobs:
+    explicit factory wins; multi-process defaults to the local device
+    (jobs are collective-free); single-process follows the config."""
+    from znicz_tpu.backends import Device
+    if device_factory:
+        return device_factory()
+    if process_info()[1] > 1:
+        return local_eval_device()
+    return Device.create()
+
+
+def _exact_allgather(arr: np.ndarray) -> np.ndarray:
+    """``process_allgather`` that survives jax's 32-bit dtype
+    canonicalization: 8-byte dtypes (float64/int64) ride the wire as
+    uint32 pairs and are restored bit-exactly, so multi-process
+    results cannot diverge numerically from single-process ones."""
+    from jax.experimental import multihost_utils
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.itemsize == 8:
+        out = np.asarray(
+            multihost_utils.process_allgather(arr.view(np.uint32)))
+        return out.view(arr.dtype)
+    return np.asarray(multihost_utils.process_allgather(arr))
+
+
 def merge_sharded_scores(scores: np.ndarray, owner_stride: int
                          ) -> np.ndarray:
     """All-gather a round-robin-sharded score vector.
@@ -49,9 +75,7 @@ def merge_sharded_scores(scores: np.ndarray, owner_stride: int
     process calls this in lockstep; returns the merged vector where
     slot *i* comes from its owning process.  ``owner_stride`` is the
     process count."""
-    from jax.experimental import multihost_utils
-    gathered = np.asarray(
-        multihost_utils.process_allgather(np.asarray(scores, np.float64)))
+    gathered = _exact_allgather(np.asarray(scores, np.float64))
     # gathered: (process_count, n) — row p is process p's local vector
     merged = np.empty_like(gathered[0])
     for i in range(merged.shape[0]):
@@ -71,15 +95,19 @@ def merge_round_robin(local_values, pidx: int, pcount: int,
 
 
 def allgather_sum(partial: np.ndarray) -> np.ndarray:
-    """Sum a per-process partial array across processes (lockstep)."""
-    from jax.experimental import multihost_utils
-    gathered = np.asarray(multihost_utils.process_allgather(
-        np.asarray(partial, np.float64)))
+    """Sum a per-process partial array across processes (lockstep).
+    Transport is bit-exact and the reduction runs on the host in the
+    input's own precision (float64 stays float64)."""
+    gathered = _exact_allgather(np.asarray(partial, np.float64))
     return gathered.sum(axis=0)
 
 
 def broadcast_from_zero(arr: np.ndarray) -> np.ndarray:
-    """Broadcast process 0's array to every process (lockstep)."""
+    """Broadcast process 0's array to every process (lockstep,
+    bit-exact for 8-byte dtypes)."""
     from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.broadcast_one_to_all(
-        np.asarray(arr)))
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.itemsize == 8:
+        return np.asarray(multihost_utils.broadcast_one_to_all(
+            arr.view(np.uint32))).view(arr.dtype)
+    return np.asarray(multihost_utils.broadcast_one_to_all(arr))
