@@ -62,9 +62,12 @@ PALLAS = os.environ.get("BENCH_PALLAS", "0") != "0"
 #: BENCH_S2D=1 opts into the space-to-depth conv rewrite (A/B lever)
 S2D = os.environ.get("BENCH_S2D", "0") != "0"
 TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT_S", "900"))
-#: default ON: every bench run leaves a committed-readable trace of
-#: the timed loop (~3 MB; ~1-2% overhead) — perf numbers should never
-#: be unexplainable.  BENCH_PROFILE="" disables; set a path to move.
+#: default ON: every bench run leaves a local trace of the timed loop
+#: (~3 MB; ~1-2% overhead) — perf numbers should never be
+#: unexplainable.  The default path is GITIGNORED (profiles/ holds
+#: regenerable binaries, not version-controlled evidence — the
+#: decisions each trace drove live in PERF.md).  BENCH_PROFILE=""
+#: disables; set a path to move (user paths are never cleaned).
 PROFILE_DIR = os.environ.get(
     "BENCH_PROFILE",
     # stream mode is HOST-bound (single-core decode pool) and the

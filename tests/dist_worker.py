@@ -212,7 +212,7 @@ def main() -> None:
     wf.forwards[0].weights.map_read()
     wf.forwards[1].weights.map_read()
     digest = {
-        "ring_engaged": bool(getattr(wf.forwards[0], "seq_parallel",
+        "ring_engaged": bool(getattr(wf.forwards[0], "ring_active",
                                      False)),
         "ring_time_sharded": getattr(wf.forwards[0].output,
                                      "model_shard_dim", None) == 1,

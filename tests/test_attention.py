@@ -134,7 +134,7 @@ def test_seq_parallel_matches_local():
     mesh = make_mesh(n_data=2, n_model=4)
     ring = build(XLADevice(mesh=mesh), x, causal=True,
                  seq_parallel=True)
-    assert ring.seq_parallel, "mesh has a model axis; ring must engage"
+    assert ring.ring_active, "mesh has a model axis; ring must engage"
     assert ring.output.model_shard_dim == 1
     for src, dst in ((local.weights, ring.weights),
                      (local.bias, ring.bias),
@@ -209,7 +209,7 @@ def test_seq_parallel_backward_matches_local():
         fwd, gd_u = build(device, x, gd=True, causal=True,
                           seq_parallel=(mode == "ring"))
         if mode == "ring":
-            assert fwd.seq_parallel
+            assert fwd.ring_active
         if init is None:
             init = (fwd.weights.mem.copy(), fwd.weights_out.mem.copy(),
                     fwd.bias.mem.copy(), fwd.bias_out.mem.copy())

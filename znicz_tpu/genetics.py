@@ -25,7 +25,8 @@ from typing import Any, Callable
 
 import numpy as np
 
-from znicz_tpu.parallel.process_shard import (merge_sharded_scores,
+from znicz_tpu.parallel.process_shard import (allgather_sum,
+                                              merge_sharded_scores,
                                               pick_eval_device,
                                               process_info)
 from znicz_tpu.utils.logger import Logger
@@ -224,9 +225,12 @@ class GeneticsOptimizer(Logger):
                 pending.append((key, genome))
         pidx, pcount = process_info()
         if pcount > 1 and pending:
-            # a local fitness failure must not raise before the merge
-            # collective (a lone raise would leave peers blocked in
-            # process_allgather): record NaN, raise together after
+            # a local fitness exception must not raise before the
+            # collectives (a lone raise would leave peers blocked in
+            # process_allgather): record it, gather an explicit
+            # failure flag, raise together.  A legitimately-NaN
+            # fitness is NOT a failure — it caches and sorts exactly
+            # as in the single-process path.
             scores = np.zeros(len(pending), np.float64)
             local_exc: Exception | None = None
             for i in range(pidx, len(pending), pcount):
@@ -236,14 +240,13 @@ class GeneticsOptimizer(Logger):
                     scores[i] = float(self.fitness_fn(dict(genome)))
                 except Exception as exc:
                     local_exc = exc
-                    scores[i] = np.nan
                     break
-            merged = merge_sharded_scores(scores, pcount)
-            if np.isnan(merged).any():
+            if allgather_sum(
+                    np.array([1.0 if local_exc else 0.0]))[0] > 0:
                 raise RuntimeError(
-                    "fitness evaluation failed on a process (NaN "
-                    "fitness or exception); every process aborts the "
-                    "GA together") from local_exc
+                    "fitness evaluation failed on a process; every "
+                    "process aborts the GA together") from local_exc
+            merged = merge_sharded_scores(scores, pcount)
             for i, (key, _) in enumerate(pending):
                 self._cache[key] = float(merged[i])
         else:

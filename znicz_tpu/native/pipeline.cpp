@@ -279,7 +279,9 @@ struct Pool {
         if (j.channels == 1) {
           float luma = 0.299f * p[0] + 0.587f * p[1] + 0.114f * p[2];
           if (j.out_u8)
-            drow8[x] = static_cast<uint8_t>(luma + 0.5f);
+            // round-half-to-even to match the PIL oracle's np.rint —
+            // truncating luma+0.5 disagreed by one level at .5 ties
+            drow8[x] = static_cast<uint8_t>(std::lrintf(luma));
           else
             drow[x] = luma * j.scale + j.bias;
         } else if (j.out_u8) {

@@ -71,14 +71,26 @@ class All2All(Forward):
 
     def _apply_model_parallel(self, n_in: int, n_out: int) -> None:
         """Set model-axis sharding dims on weights/bias/output before
-        the device places them (no-op without a model axis)."""
+        the device places them.  No-op when ``model_parallel`` is
+        unset or the device has no mesh; a mesh WITHOUT a model axis
+        raises (a silent no-op there would hide a sharding request)."""
         if self.model_parallel is None:
             return
         n_model = 1
         mesh = getattr(self.device, "mesh", None)
         if mesh is not None:
             from znicz_tpu.parallel.axis import MODEL_AXIS
-            n_model = mesh.shape.get(MODEL_AXIS, 1)
+            if MODEL_AXIS not in mesh.shape:
+                # a custom mesh without the model axis (e.g. a seq-only
+                # mesh) would otherwise die later in sharding_for with
+                # an opaque PartitionSpec error naming a missing axis
+                raise ValueError(
+                    f"{self}: model_parallel='{self.model_parallel}' "
+                    f"needs a mesh with a '{MODEL_AXIS}' axis; this "
+                    f"mesh has {dict(mesh.shape)} (framework "
+                    f"make_mesh always provides one; custom meshes "
+                    f"must too, or drop model_parallel)")
+            n_model = mesh.shape[MODEL_AXIS]
         if self.model_parallel == "column":
             if n_out % n_model:
                 raise ValueError(

@@ -80,8 +80,13 @@ class MultiHeadAttention(Forward):
         super().__init__(workflow, name=name, **kwargs)
         self.n_heads = int(n_heads)
         self.causal = bool(causal)
-        #: ring attention over the mesh's model axis (time-sharded)
+        #: ring attention over the mesh's model axis (time-sharded).
+        #: This is the CONFIGURED request and is never mutated;
+        #: :attr:`ring_active` is the per-initialize resolution (a mesh
+        #: without a model axis falls back to local attention, but
+        #: re-initializing on a capable mesh re-engages the ring).
         self.seq_parallel = bool(seq_parallel)
+        self._ring_active = False
         self.weights_out = Vector(name=f"{self.name}.weights_out")
         self.bias_out = Vector(name=f"{self.name}.bias_out")
 
@@ -112,19 +117,33 @@ class MultiHeadAttention(Forward):
         self.output.reset(np.zeros((b, t, d),
                                    dtype=self.output_store_dtype))
         mesh = getattr(self.device, "mesh", None)
+        self._ring_active = False
+        # Vector.reset preserves model_shard_dim: clear any stale
+        # time-sharding from a prior ring-engaged initialize (the
+        # ring branch below re-sets it when it actually engages)
+        self.output.model_shard_dim = None
         if self.seq_parallel:
             if mesh is None or mesh.shape.get(MODEL_AXIS, 1) < 2:
                 # no ring to ride — fall back to local attention (the
-                # math is identical; seq_parallel is a layout choice)
-                self.seq_parallel = False
+                # math is identical; seq_parallel is a layout choice).
+                # The configured flag stays intact so a later
+                # re-initialize on a capable mesh engages the ring.
+                pass
             else:
                 if t % mesh.shape[MODEL_AXIS]:
                     raise ValueError(
                         f"{self}: time axis {t} not divisible by the "
                         f"model-axis size {mesh.shape[MODEL_AXIS]}")
+                self._ring_active = True
                 self.output.model_shard_dim = 1  # time rides the ring
         self.init_vectors(self.input, self.output, self.weights,
                           self.bias, self.weights_out, self.bias_out)
+
+    @property
+    def ring_active(self) -> bool:
+        """True when THIS initialization actually rides the ring
+        (``seq_parallel`` requested AND the mesh has a model axis)."""
+        return self._ring_active
 
     # -- pure forward (jnp; the backward vjp's this) --------------------
     def xla_forward(self, x, w_qkv, b_qkv, w_out, b_out):
@@ -134,7 +153,7 @@ class MultiHeadAttention(Forward):
         if b_qkv is not None:
             qkv = qkv + b_qkv
         q, k, v = _split_heads(qkv.reshape(b, t, 3 * d), self.n_heads)
-        if self.seq_parallel:
+        if self.ring_active:
             from znicz_tpu.parallel.ring_attention import \
                 sequence_sharded_attention
             o = sequence_sharded_attention(

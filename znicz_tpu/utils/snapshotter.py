@@ -69,16 +69,50 @@ class Snapshotter(Unit):
         # (lockstep decision state), so every process records the SAME
         # destination — crash auto-resume must load one snapshot on
         # all processes, not master-only.
+        # MULTI-HOST REQUIREMENT: the snapshot directory must be a
+        # SHARED filesystem (NFS/GCS-fuse/...) — process 0 is the only
+        # writer, but every process records `destination` and crash
+        # auto-resume loads it on all processes.  On per-host local
+        # disks the non-master hosts would resume from a path that
+        # does not exist; the barrier+existence check below turns that
+        # silent failure into a loud warning at write time.
         import jax
         state = self.workflow.state_dict(allow_collective=True)
         suffix = self.snapshot_suffix()
         path = os.path.join(self.directory,
                             f"{self.prefix}_{suffix}.pickle.gz")
+        multi = jax.process_count() > 1
+        write_exc: "Exception | None" = None
         if jax.process_index() == 0:
-            written = self.write(state, self.directory, self.prefix,
-                                 suffix)
-            assert written == path
-            self.info("snapshot → %s", path)
+            try:
+                written = self.write(state, self.directory, self.prefix,
+                                     suffix)
+                assert written == path
+                self.info("snapshot → %s", path)
+            except Exception as exc:
+                if not multi:
+                    raise
+                # a lone raise here would strand the peers in the
+                # barrier below — gather the failure, raise together
+                write_exc = exc
+        if multi:
+            import numpy as np
+
+            from znicz_tpu.parallel.process_shard import allgather_sum
+            # doubles as the write barrier for the existence check
+            if allgather_sum(
+                    np.array([1.0 if write_exc else 0.0]))[0] > 0:
+                raise RuntimeError(
+                    "snapshot write failed on process 0; every "
+                    "process aborts together") from write_exc
+            if jax.process_index() != 0 and not os.path.exists(path):
+                self.warning(
+                    "snapshot %s is not visible on process %d — the "
+                    "snapshot directory is NOT a shared filesystem; "
+                    "auto-resume will fail on this host.  Point "
+                    "`directory` (or root.common.dirs.snapshots) at "
+                    "storage mounted on every host.", path,
+                    jax.process_index())
         self.destination = path
 
     @staticmethod
