@@ -196,6 +196,63 @@ def test_streaming_prefetch_consistency(image_tree):
         np.testing.assert_array_equal(a, b)
 
 
+def test_streaming_prefetch_actually_overlaps(tmp_path):
+    """The double-buffered prefetch must RUN CONCURRENTLY with the
+    consumer's compute window, not merely be correct: N steps with a
+    simulated device-compute sleep after each must take measurably
+    less wall time with prefetch than the serial sum of the measured
+    phases.  (Round-3 verdict: the measured stream step was additive —
+    decode + upload ≈ step — so overlap is asserted, not assumed.)"""
+    import time
+
+    # one epoch must cover the whole measured window: prefetch
+    # (correctly) never crosses the epoch-boundary reshuffle, so a
+    # short epoch would interleave sync decodes and mask the overlap
+    base = write_dataset(str(tmp_path / "data"), n_classes=2,
+                         n_per_class=88, hw=(256, 256))
+    n_steps = 8
+
+    from znicz_tpu.utils import prng
+    prng.seed_all(7)
+    wf = Workflow(name="w_overlap")
+    loader = FileImageLoader(
+        wf, train_dir=base, out_hw=(224, 224), resize_hw=(232, 232),
+        minibatch_size=16, use_native=True, prefetch=True,
+        n_threads=1)
+    loader.initialize(device=NumpyDevice())
+
+    # reference: what one batch costs to decode synchronously (same
+    # files, same pool) — the work the prefetch must hide.  The
+    # simulated compute window derives from the MEASURED decode cost
+    # so the test pins overlap, not this machine's decode speed.
+    paths = loader.file_paths[:16]
+    probe = np.zeros((16, 224, 224, 3), dtype=np.uint8)
+    t0 = time.perf_counter()
+    loader._pipe.submit(paths, probe, out_hw=(224, 224),
+                        resize_hw=(232, 232))
+    loader._pipe.wait()
+    decode_s = time.perf_counter() - t0
+    compute_s = 1.5 * decode_s
+
+    loader.run()  # first decode is synchronous (nothing in flight yet)
+    for _ in range(n_steps):
+        time.sleep(compute_s)   # the "device" chews the batch...
+        loader.run()            # ...while the pool decodes N+1
+    loader.stop()
+
+    assert loader.prefetch_hits == n_steps, (
+        f"prefetch served {loader.prefetch_hits}/{n_steps} steps "
+        f"(misses {loader.prefetch_misses})")
+    # decode (~decode_s per batch) ran during the sleep window, so the
+    # consumer's blocking wait must be a small fraction of it — a
+    # serialized pipeline would wait ≈ decode_s on every step
+    mean_wait = loader.prefetch_wait_s / n_steps
+    assert mean_wait < 0.3 * decode_s, (
+        f"mean prefetch wait {mean_wait * 1e3:.1f} ms vs decode "
+        f"{decode_s * 1e3:.1f} ms/batch: decode is NOT overlapping "
+        f"the compute window")
+
+
 def test_fullbatch_image_loader(image_tree):
     wf = Workflow(name="w")
     loader = FullBatchImageLoader(

@@ -25,6 +25,7 @@ unavailable.  Two consumption modes:
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
@@ -134,7 +135,12 @@ class ImageLoader(Loader):
     (reference's scale/crop options) while eval gets center crops.
 
     ``prefetch=True`` double-buffers: while the device chews step N,
-    the native pool decodes step N+1.
+    the native pool decodes step N+1 into the OTHER staging buffer and
+    the Vector rebinds to whichever buffer holds the current batch —
+    a zero-copy handoff (the old design memcpy'd the spare buffer into
+    the Vector each step: ~10 ms/step of pure host overhead at
+    ImageNet batch 256 on one core, measured in
+    ``benchmarks/stream_probe.py``).
     """
 
     def __init__(self, workflow, name: str | None = None,
@@ -168,9 +174,20 @@ class ImageLoader(Loader):
         self.minibatch_raw = Vector(name=f"{self.name}.minibatch_raw",
                                     batch_major=True)
         self._pipe = None
-        self._spare: np.ndarray | None = None   # prefetch target
+        #: two staging buffers: the decode pool fills one while the
+        #: device consumes the other; the Vector rebinds per step
+        self._buffers: list[np.ndarray] | None = None
+        self._decode_buf = 0                    # buffer being decoded
         self._pending: tuple[int, int] | None = None  # (epoch, cursor)
         self._pil_rng = np.random.default_rng(1)
+        #: overlap telemetry: hits = steps served by a prefetched
+        #: decode, misses = synchronous decodes (first step + epoch
+        #: boundaries), wait_s = total time blocked on in-flight
+        #: decodes.  wait_s ≈ 0 with hits > 0 means the decode fully
+        #: overlapped the consumer's compute window.
+        self.prefetch_hits = 0
+        self.prefetch_misses = 0
+        self.prefetch_wait_s = 0.0
 
     # subclasses fill file_paths/file_labels/class_lengths here
     def load_data(self) -> None:
@@ -211,10 +228,19 @@ class ImageLoader(Loader):
             # raises carrying the build error
             from znicz_tpu.native import ImagePipeline
             self._pipe = ImagePipeline(self.n_threads)
+            # buffer 0 reuses minibatch_raw's own allocation (a third
+            # full-size array would be waste); prefetch adds buffer 1
+            self._buffers = [self.minibatch_raw.mem]
             if self.prefetch:
-                self._spare = np.zeros_like(self.minibatch_raw.mem)
+                self._buffers.append(
+                    np.zeros_like(self.minibatch_raw.mem))
+            self._decode_buf = 0
+            self._pending = None
         else:
             self._pipe = None
+        self.prefetch_hits = 0
+        self.prefetch_misses = 0
+        self.prefetch_wait_s = 0.0
         self._pil_rng = np.random.default_rng(
             self.rnd.randint(0, 2 ** 31))
 
@@ -277,31 +303,51 @@ class ImageLoader(Loader):
         super().host_run()  # picks indices, epoch bookkeeping
         idx = self._host_indices
         cur = (self.epoch_number, self._cursor - 1)
-        self.minibatch_raw.map_invalidate()
-        out = self.minibatch_raw.mem
-        if self._pipe is not None and self.prefetch \
-                and self._pending == cur:
-            n_failed = self._pipe.wait()
-            if n_failed:
-                self.warning("%d failed decodes (zero-filled)", n_failed)
-            out[...] = self._spare
+        if self._pipe is not None:
+            if self.prefetch and self._pending == cur:
+                t0 = time.perf_counter()
+                n_failed = self._pipe.wait()
+                self.prefetch_wait_s += time.perf_counter() - t0
+                self.prefetch_hits += 1
+                if n_failed:
+                    self.warning("%d failed decodes (zero-filled)",
+                                 n_failed)
+            else:
+                self.prefetch_misses += 1
+                if self._pending is not None:
+                    # a stale prefetch is in flight (schedule jumped:
+                    # resume/reshuffle) — drain it before resubmitting
+                    self._pipe.wait()
+                self._decode_sync(idx, self.minibatch_class,
+                                  self._buffers[self._decode_buf],
+                                  self._decode_seed(*cur))
+            # zero-copy handoff: rebind the Vector to the filled
+            # buffer; the pool decodes the NEXT batch into the other
+            filled = self._decode_buf
+            self.minibatch_raw.mem = self._buffers[filled]
+            self._pending = None
+            # queue next step's decode BEFORE the upload below: the
+            # C++ workers chew N+1 while device_put streams batch N
+            # and the device computes it
+            if self.prefetch:
+                nxt = self._peek_next()
+                if nxt is not None:
+                    nidx, ncls = nxt
+                    self._decode_buf = 1 - filled
+                    self._submit(nidx, ncls,
+                                 self._buffers[self._decode_buf],
+                                 self._decode_seed(self.epoch_number,
+                                                   self._cursor))
+                    self._pending = (self.epoch_number, self._cursor)
         else:
-            self._decode_sync(idx, self.minibatch_class, out,
+            self.minibatch_raw.map_invalidate()
+            self._decode_sync(idx, self.minibatch_class,
+                              self.minibatch_raw.mem,
                               self._decode_seed(*cur))
-        self._pending = None
         # labels ride host-side (global label table lookup)
         self.minibatch_labels.map_invalidate()
         self.minibatch_labels.mem[...] = np.asarray(
             [self.file_labels[i] for i in idx], dtype=np.int32)
-        # queue next step's decode while the device computes this one
-        if self._pipe is not None and self.prefetch:
-            nxt = self._peek_next()
-            if nxt is not None:
-                nidx, ncls = nxt
-                self._submit(nidx, ncls, self._spare,
-                             self._decode_seed(self.epoch_number,
-                                               self._cursor))
-                self._pending = (self.epoch_number, self._cursor)
         if self.device is not None and not self.device.is_host_only:
             self.minibatch_raw.unmap()
             self.minibatch_labels.unmap()
