@@ -8,9 +8,19 @@ can't produce a falling loss curve) twice with identical seeds:
 once in float32, once in the bf16 mixed-precision mode the headline
 benchmark reports (bf16 matmul/conv inputs, f32 params+accumulation).
 
-Artifacts: BF16_CONVERGENCE.json (both per-epoch mean-CE loss curves
-+ error counts) and a pass/fail line asserting the bf16 trajectory
-tracks f32 within a band.
+The task is sized so the error metric MOVES (round-3 verdict asked
+for a non-degenerate curve; the round-3 zeros were in fact a
+read-after-reset bug — see the hooked() note — but the 16-class task
+also saturated in training error): 40 classes, few samples per class,
+and a held-out validation split — validation top-1 error starts near
+chance and falls without reaching zero, so the bf16-vs-f32 band is
+asserted on BOTH the train-CE curve and the validation n_err curve
+(the accuracy-shaped metric the north star is phrased in,
+BASELINE.md).
+
+Artifacts: BF16_CONVERGENCE.json (per-epoch train CE + train/valid
+error counts for both precisions) and a pass/fail line asserting the
+bf16 trajectory tracks f32 within both bands.
 
 Run: ``python benchmarks/bf16_convergence.py`` (env: BF16_EPOCHS,
 BF16_BATCH, BF16_CLASSES, BF16_IMAGE_SIZE).
@@ -27,11 +37,23 @@ sys.path.insert(0, REPO)
 
 import numpy as np  # noqa: E402
 
-EPOCHS = int(os.environ.get("BF16_EPOCHS", "60"))
+EPOCHS = int(os.environ.get("BF16_EPOCHS", "80"))
 BATCH = int(os.environ.get("BF16_BATCH", "64"))
-N_CLASSES = int(os.environ.get("BF16_CLASSES", "16"))
+N_CLASSES = int(os.environ.get("BF16_CLASSES", "40"))
 IMAGE_SIZE = int(os.environ.get("BF16_IMAGE_SIZE", "227"))
+#: per-pixel sigma around the class prototypes: large enough that the
+#: classes OVERLAP and validation error floors well above zero (the
+#: non-degeneracy the artifact exists to provide) yet far below chance
+NOISE = float(os.environ.get("BF16_NOISE", "100"))
 STEPS_PER_EPOCH = 8
+VALID_STEPS = 2
+
+
+def actual_split(n: int) -> int:
+    """``synthetic_images`` emits ``(n // n_classes) * n_classes``
+    samples (whole classes only) — every denominator must use the
+    ACTUAL split size, not the requested one."""
+    return (n // N_CLASSES) * N_CLASSES
 
 
 def build(precision: str):
@@ -46,9 +68,10 @@ def build(precision: str):
     cfg.update(n_classes=N_CLASSES, image_size=IMAGE_SIZE,
                learning_rate=0.001)
     n_train = STEPS_PER_EPOCH * BATCH
-    x, y, _, _ = datasets.synthetic_images(
-        n_train=n_train, n_test=0, size=IMAGE_SIZE, channels=3,
-        n_classes=N_CLASSES, seed=51)
+    n_valid = VALID_STEPS * BATCH
+    x, y, vx, vy = datasets.synthetic_images(
+        n_train=n_train, n_test=n_valid, size=IMAGE_SIZE, channels=3,
+        n_classes=N_CLASSES, seed=51, noise=NOISE)
     layers = alexnet.layers(cfg)
     for layer in layers:
         # the sample's reference-faithful 0.01/0.005 init needs real
@@ -63,7 +86,8 @@ def build(precision: str):
     wf = StandardWorkflow(
         name=f"alexnet_{precision}",
         loader_factory=lambda w: ArrayLoader(
-            w, train_data=x, train_labels=y, minibatch_size=BATCH,
+            w, train_data=x, train_labels=y,
+            valid_data=vx, valid_labels=vy, minibatch_size=BATCH,
             normalization_scale=2.0 / 255.0, normalization_bias=-1.0),
         layers=layers,
         decision_config={"max_epochs": EPOCHS})
@@ -81,17 +105,23 @@ def train_curve(precision: str) -> dict:
     wf = build(precision)
     wf.initialize(device=XLADevice())
 
-    losses, errors = [], []
+    losses, errors, valid_errors = [], [], []
     orig = wf.decision.on_epoch_ended
 
     def hooked():
         orig()
+        # NB: read last_epoch_n_err, not epoch_n_err — on_epoch_ended
+        # ends by archiving the finished epoch there and zeroing the
+        # running counters (round 3's artifact read epoch_n_err after
+        # the reset, which is why its error columns were identically 0)
         losses.append(wf.decision.epoch_loss[2])   # TRAIN mean CE
-        errors.append(wf.decision.epoch_n_err[2])
+        errors.append(wf.decision.last_epoch_n_err[2])
+        valid_errors.append(wf.decision.last_epoch_n_err[1])
 
     wf.decision.on_epoch_ended = hooked
     wf.run_chunked(steps_per_dispatch=STEPS_PER_EPOCH)
-    return {"precision": precision, "loss": losses, "n_err": errors}
+    return {"precision": precision, "loss": losses, "n_err": errors,
+            "valid_n_err": valid_errors}
 
 
 def main() -> None:
@@ -108,6 +138,19 @@ def main() -> None:
                           f"(drop {drop:.4f} of initial {initial:.4f}); "
                           "run longer (BF16_EPOCHS)"}), flush=True)
         sys.exit(2)
+    n_valid = actual_split(VALID_STEPS * BATCH)
+    err_initial = f32["valid_n_err"][0]
+    err_final_f32 = min(f32["valid_n_err"])
+    err_drop = err_initial - err_final_f32
+    if err_final_f32 == 0 or err_initial < 0.5 * n_valid:
+        # the whole point of this artifact is a NON-degenerate error
+        # curve: validation must start near chance and must not
+        # saturate at zero (round-3 verdict)
+        print(json.dumps({
+            "error": "validation error curve degenerate "
+                     f"(initial {err_initial}, best {err_final_f32} "
+                     f"of {n_valid}); resize the task"}), flush=True)
+        sys.exit(2)
     bf16 = train_curve("bfloat16")
     curves = {"float32": f32, "bfloat16": bf16}
     final_bf16 = bf16["loss"][-1]
@@ -115,20 +158,35 @@ def main() -> None:
     # one-sided band: bf16 must recover ≥70% of the f32 loss drop and
     # may trail f32's final loss by at most 30% of that drop; ENDING
     # LOWER than f32 is a pass, not a deviation
-    ok = (initial - final_bf16) >= 0.7 * drop and gap <= 0.3 * drop
+    loss_ok = (initial - final_bf16) >= 0.7 * drop and gap <= 0.3 * drop
+    # the same band on the accuracy-shaped metric: best validation
+    # error count (the north star's top-1 framing, BASELINE.md)
+    err_final_bf16 = min(bf16["valid_n_err"])
+    err_gap = err_final_bf16 - err_final_f32
+    err_ok = ((err_initial - err_final_bf16) >= 0.7 * err_drop
+              and err_gap <= 0.3 * err_drop)
+    ok = loss_ok and err_ok
     artifact = {
         "model": "alexnet", "image_size": IMAGE_SIZE, "batch": BATCH,
         "n_classes": N_CLASSES, "epochs": EPOCHS, "steps": steps,
+        "n_valid": n_valid,
         "loss_initial_f32": initial,
         "loss_final_f32": final_f32, "loss_final_bf16": final_bf16,
-        "gap": gap, "band_ok": bool(ok),
+        "gap": gap, "loss_band_ok": bool(loss_ok),
+        "valid_err_initial": err_initial,
+        "valid_err_best_f32": err_final_f32,
+        "valid_err_best_bf16": err_final_bf16,
+        "valid_err_gap": err_gap, "err_band_ok": bool(err_ok),
+        "band_ok": bool(ok),
         "curves": curves,
     }
     with open(os.path.join(REPO, "BF16_CONVERGENCE.json"), "w") as fh:
         json.dump(artifact, fh, indent=1)
     print(json.dumps({k: artifact[k] for k in (
         "steps", "loss_initial_f32", "loss_final_f32",
-        "loss_final_bf16", "gap", "band_ok")}), flush=True)
+        "loss_final_bf16", "gap", "loss_band_ok",
+        "valid_err_initial", "valid_err_best_f32",
+        "valid_err_best_bf16", "err_band_ok", "band_ok")}), flush=True)
     if not ok:
         sys.exit(1)
 

@@ -149,10 +149,15 @@ def mnist_is_real() -> bool:
 
 def synthetic_images(n_train: int, n_test: int, size: int, channels: int,
                      n_classes: int, seed: int,
-                     dtype=np.uint8) -> tuple[np.ndarray, np.ndarray,
-                                              np.ndarray, np.ndarray]:
+                     dtype=np.uint8,
+                     noise: float = 64.0) -> tuple[np.ndarray, np.ndarray,
+                                                   np.ndarray, np.ndarray]:
     """Class-prototype images + noise, uint8, learnable but not
-    trivial.  ``channels=0`` → (N, size, size) grayscale like MNIST."""
+    trivial.  ``channels=0`` → (N, size, size) grayscale like MNIST.
+    ``noise`` sets the per-pixel sigma around the prototypes — raise
+    it to overlap the classes and give the task a nonzero Bayes error
+    floor (convergence artifacts need validation error that neither
+    saturates at zero nor stays at chance)."""
     rng = np.random.default_rng(seed)
     shape = (size, size) if channels == 0 else (size, size, channels)
     protos = rng.uniform(0, 255, size=(n_classes,) + shape)
@@ -161,8 +166,9 @@ def synthetic_images(n_train: int, n_test: int, size: int, channels: int,
         per = n // n_classes
         xs, ys = [], []
         for c in range(n_classes):
-            noise = rng.normal(0, 64, size=(per,) + shape)
-            xs.append(np.clip(protos[c] + noise, 0, 255))
+            xs.append(np.clip(
+                protos[c] + rng.normal(0, noise, size=(per,) + shape),
+                0, 255))
             ys.append(np.full(per, c, dtype=np.int32))
         x = np.concatenate(xs).astype(dtype)
         y = np.concatenate(ys)

@@ -11,6 +11,13 @@ reused by the backward unit, exactly like the reference.
 region compiles a masked and an identity variant — this is the
 per-minibatch-gate case SURVEY.md §7 calls out.  Device randomness
 comes from the unit's own PRNG key chain (a region leaf).
+
+Pallas variant (``root.common.engine.use_pallas`` incl. ``"dropout"``,
+resolved once at initialize): mask generation + apply fuse into one
+VMEM pass over TPU-core PRNG bits (``pallas_kernels.dropout_apply``);
+no mask array materializes — the backward regenerates the identical
+mask from the same per-step seed.  The default follows the in-graph
+chip A/B in PALLAS_BENCH.md.
 """
 
 from __future__ import annotations
@@ -41,12 +48,23 @@ class DropoutForward(Forward):
         super().initialize(device=device, **kwargs)
         if self.input is None or not self.input:
             raise AttributeError(f"{self}: input not linked yet")
+        from znicz_tpu.ops import pallas_kernels
+        self._use_pallas = pallas_kernels.use_pallas(self.device,
+                                                     "dropout")
+        self._pallas_seed = None  # per-step traced seed (fwd → bwd)
         self.output.reset(np.zeros(self.input.shape,
                                    dtype=self.output_store_dtype))
-        self.mask.reset(np.ones(self.input.shape,
-                                dtype=self.act_store_dtype))
-        self.inherit_model_shard(self.output, self.mask)
-        self.init_vectors(self.input, self.output, self.mask)
+        if self._use_pallas:
+            # no mask array at all: the backward regenerates it in
+            # VMEM from the seed — allocating/uploading the Vector
+            # would negate the kernel's HBM saving
+            self.inherit_model_shard(self.output)
+            self.init_vectors(self.input, self.output)
+        else:
+            self.mask.reset(np.ones(self.input.shape,
+                                    dtype=self.act_store_dtype))
+            self.inherit_model_shard(self.output, self.mask)
+            self.init_vectors(self.input, self.output, self.mask)
         self.init_rng()
 
     def numpy_run(self) -> None:
@@ -65,15 +83,26 @@ class DropoutForward(Forward):
 
     def xla_run(self) -> None:
         x = self.input.devmem
-        if self.forward_mode == "train":
-            keep = 1.0 - self.dropout_ratio
-            key = self.take_key()
-            mask = jax.random.bernoulli(key, keep, x.shape).astype(
-                x.dtype) / keep
-            self.mask.devmem = mask
-            self.output.devmem = x * mask
-        else:
+        if self.forward_mode != "train":
             self.output.devmem = x
+            return
+        key = self.take_key()
+        if self._use_pallas:
+            from znicz_tpu.ops import pallas_kernels
+            # one int32 seed per step drives the TPU-core PRNG; the
+            # backward regenerates the identical mask from it (no
+            # mask array materializes in HBM)
+            seed = jax.random.bits(key, (1,), jnp.uint32) \
+                .astype(jnp.int32)
+            self._pallas_seed = seed
+            self.output.devmem = pallas_kernels.dropout_apply(
+                x, seed, self.dropout_ratio)
+            return
+        keep = 1.0 - self.dropout_ratio
+        mask = jax.random.bernoulli(key, keep, x.shape).astype(
+            x.dtype) / keep
+        self.mask.devmem = mask
+        self.output.devmem = x * mask
 
 
 class DropoutBackward(WeightlessGradientUnit):
@@ -96,8 +125,15 @@ class DropoutBackward(WeightlessGradientUnit):
     def xla_run(self) -> None:
         fwd = self.forward_unit
         err = self.err_output.devmem
-        if fwd.forward_mode == "train":
-            self.err_input.devmem = err * fwd.mask.devmem
-        else:
+        if fwd.forward_mode != "train":
             self.err_input.devmem = err
+            return
+        if getattr(fwd, "_use_pallas", False):
+            from znicz_tpu.ops import pallas_kernels
+            # same seed, same shape → bit-identical mask regenerated
+            # in VMEM (err · mask ≡ dropout_apply(err, seed))
+            self.err_input.devmem = pallas_kernels.dropout_apply(
+                err, fwd._pallas_seed, fwd.dropout_ratio)
+            return
+        self.err_input.devmem = err * fwd.mask.devmem
 

@@ -60,7 +60,18 @@ def _window_sum(xp, arr, n: int, half_low: int | None = None,
     if xp is jnp and via_matmul:
         # (Pallas kernels pass via_matmul=False: inside pallas_call
         # the traced jnp is not plain XLA and keeps the shift form.)
+        # engine.lrn_band_bf16 feeds the GEMM bf16 operands (f32
+        # accumulate) — the band sum is bandwidth-bound (2·C FLOP per
+        # element read), so halving the read traffic is the lever;
+        # the contribution is α-damped (~1e-4) in d and 2αβ-damped in
+        # the backward term, far inside the convergence band.  A/B
+        # lever, default follows PERF.md round-4 measurements.
+        from znicz_tpu.utils.config import root
+        dt = jnp.bfloat16 if root.common.engine.get(
+            "lrn_band_bf16", False) else None
         band = jnp.asarray(_band_matrix(c, n, half_low))
+        if dt is not None:
+            arr, band = arr.astype(dt), band.astype(dt)
         return jnp.matmul(arr, band,
                           preferred_element_type=jnp.float32)
     half_high = n - 1 - half_low
@@ -114,7 +125,7 @@ class LRNormalizerForward(Forward):
         self.inherit_model_shard(self.output)
         self.init_vectors(self.input, self.output)
         from znicz_tpu.ops import pallas_kernels
-        self._use_pallas = pallas_kernels.use_pallas(self.device)
+        self._use_pallas = pallas_kernels.use_pallas(self.device, "lrn")
 
     def _forward(self, xp, x):
         d = self.k + self.alpha * _window_sum(xp, x * x, self.n)
@@ -154,7 +165,7 @@ class LRNormalizerBackward(GradientDescentBase):
         self.init_vectors(self.err_input, self.err_output, self.input,
                           self.output)
         from znicz_tpu.ops import pallas_kernels
-        self._use_pallas = pallas_kernels.use_pallas(self.device)
+        self._use_pallas = pallas_kernels.use_pallas(self.device, "lrn")
 
     def numpy_run(self) -> None:
         """Analytic gradient (the oracle/spec):

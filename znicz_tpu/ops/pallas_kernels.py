@@ -38,18 +38,21 @@ from znicz_tpu.ops.normalization import _window_sum as _window_sum_xp
 _TILE_ROWS = 512
 
 
-def use_pallas(device) -> bool:
+def use_pallas(device, op: str | None = None) -> bool:
     """Pallas path gate: TPU platform + config switch.
 
-    **Default OFF** (``root.common.engine.use_pallas = True`` opts
-    in).  The standalone microbenchmark (PALLAS_BENCH.md) has the
-    Pallas LRN ahead of the jnp composition, but IN-GRAPH the picture
-    inverts: `pallas_call` pins its operand to a 2-D row-major layout,
-    so XLA brackets every call with layout copies + reshapes of the
-    (n,55,55,96) activations — profiled at ~40% of the AlexNet step
-    (profiles/r03_b256), and the chip A/B measured plain XLA 24%
-    faster end-to-end (7795 vs 6263 img/s, batch 256).  The fused-XLA
-    LRN fuses into its conv/pool neighbors with no layout constraint.
+    **Default OFF** (``root.common.engine.use_pallas`` opts in —
+    ``True`` enables every Pallas variant; a list/tuple/set of op
+    names (``["dropout"]``) enables per-op, which is how the in-graph
+    A/Bs isolate one kernel).  The standalone microbenchmark
+    (PALLAS_BENCH.md) has the Pallas LRN ahead of the jnp composition,
+    but IN-GRAPH the picture inverts: `pallas_call` pins its operand
+    to a 2-D row-major layout, so XLA brackets every call with layout
+    copies + reshapes of the (n,55,55,96) activations — profiled at
+    ~40% of the AlexNet step (profiles/r03_b256), and the chip A/B
+    measured plain XLA 24% faster end-to-end (7795 vs 6263 img/s,
+    batch 256).  The fused-XLA LRN fuses into its conv/pool neighbors
+    with no layout constraint.
 
     **Compile-time flag**: units resolve this ONCE at ``initialize``
     and bake the result into their traced program — flipping
@@ -68,7 +71,10 @@ def use_pallas(device) -> bool:
             and "tpu" not in getattr(jax_device, "device_kind",
                                      "").lower():
         return False
-    return bool(root.common.engine.get("use_pallas", False))
+    val = root.common.engine.get("use_pallas", False)
+    if isinstance(val, (list, tuple, set, frozenset)):
+        return op is not None and op in val
+    return bool(val)
 
 
 # ----------------------------------------------------------------------
