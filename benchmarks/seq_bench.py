@@ -1,0 +1,140 @@
+"""Sequence-stack training throughput on the real chip (tokens/s).
+
+The reference predates attention entirely, so there is no baseline to
+beat — this artifact pins the absolute capability of the long-context
+extension (SURVEY.md §5.7): a transformer-style block
+(attention → layer_norm → FC) trained end-to-end through the jit
+region at realistic sequence geometry, reported as tokens/s/chip and
+attention-FLOPs utilization.
+
+Single-chip measurement: the attention core runs the LOCAL path (the
+ring engages on a mesh's model axis — its cross-process correctness
+is proven by tests/test_distributed.py; its purpose is fitting longer
+sequences, not speeding up one chip).
+
+Run: ``python benchmarks/seq_bench.py`` (env: SEQ_BATCH, SEQ_LEN,
+SEQ_DIM, SEQ_HEADS, SEQ_STEPS, SEQ_FLASH=<block_k> for the blocked
+flash-style core).  Writes SEQ_BENCH.json at the repo root with one
+JSON line per configuration.
+
+Timing note: through this environment's PJRT tunnel,
+``block_until_ready`` on the per-step dispatch path returns before
+device execution completes (measured: a 500-GFLOP step "finished" in
+0.6 ms, >2x the chip's peak rate — impossible).  The loop therefore
+fences with a VALUE fetch of a scalar reduction of the last unit's
+weights, which the tunnel cannot satisfy without executing the whole
+dependency chain.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+BATCH = int(os.environ.get("SEQ_BATCH", "16"))
+SEQ_LEN = int(os.environ.get("SEQ_LEN", "2048"))
+DIM = int(os.environ.get("SEQ_DIM", "512"))
+HEADS = int(os.environ.get("SEQ_HEADS", "8"))
+STEPS = int(os.environ.get("SEQ_STEPS", "30"))
+FLASH = int(os.environ.get("SEQ_FLASH", "0"))  # 0 = plain local core
+WARMUP = 5
+
+
+def build():
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+
+    rng = np.random.default_rng(3)
+    n = 4 * BATCH
+    x = rng.normal(0, 0.3, size=(n, SEQ_LEN, DIM)).astype(np.float32)
+    y = rng.integers(0, 8, size=n).astype(np.int32)
+    gd = {"learning_rate": 0.01, "gradient_moment": 0.9}
+    wf = StandardWorkflow(
+        name="seq_bench",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=x, train_labels=y, minibatch_size=BATCH),
+        layers=[
+            {"type": "attention",
+             "->": {"n_heads": HEADS,
+                    "flash_block_k": FLASH or None}, "<-": gd},
+            {"type": "layer_norm", "->": {}, "<-": gd},
+            {"type": "softmax", "->": {"output_sample_shape": 8},
+             "<-": gd},
+        ],
+        decision_config={"max_epochs": 10 ** 6})
+    wf._max_fires = 10 ** 9
+    return wf
+
+
+def attn_train_flops() -> float:
+    """Model FLOPs per train step (fwd ×3 for training): attention
+    projections (QKV + out: 4 D×D GEMMs over B·T tokens) +
+    score/value matmuls (2 × 2·B·H·T²·(D/H)) + the classifier head
+    ((T·D) × 8 GEMM)."""
+    proj = 4 * 2.0 * BATCH * SEQ_LEN * DIM * DIM
+    scores = 2 * 2.0 * BATCH * HEADS * SEQ_LEN * SEQ_LEN * (DIM // HEADS)
+    head = 2.0 * BATCH * SEQ_LEN * DIM * 8
+    return 3.0 * (proj + scores + head)
+
+
+def main() -> None:
+    from bench import peak_tflops
+
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.utils import prng
+    from znicz_tpu.utils.config import root
+
+    root.common.precision_type = os.environ.get("SEQ_PRECISION",
+                                                "bfloat16")
+    prng.seed_all(11)
+    wf = build()
+    import jax.numpy as jnp
+    device = XLADevice()
+    wf.initialize(device=device)
+    assert wf._region_unit is not None
+
+    def step():
+        wf.loader.run()
+        wf._region_unit.run()
+
+    def fence() -> float:
+        # VALUE fetch = the only barrier the tunnel honors (see note)
+        return float(jnp.sum(
+            wf.forwards[-1].weights.devmem.astype(jnp.float32)))
+
+    for _ in range(WARMUP):
+        step()
+    fence()
+    t0 = time.perf_counter()
+    for _ in range(STEPS):
+        step()
+    fence()
+    dt = (time.perf_counter() - t0) / STEPS
+    tokens_per_sec = BATCH * SEQ_LEN / dt
+    mfu = attn_train_flops() / dt / (peak_tflops(device.jax_device)
+                                     * 1e12)
+    line = json.dumps({
+        "metric": "seq_stack_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "batch": BATCH, "seq_len": SEQ_LEN, "dim": DIM,
+        "heads": HEADS, "flash_block_k": FLASH or None,
+        "step_time_ms": round(dt * 1e3, 3),
+        "mfu": round(mfu, 4),
+        "precision": str(root.common.precision_type),
+    })
+    print(line, flush=True)
+    with open(os.path.join(REPO, "SEQ_BENCH.json"), "a") as fh:
+        fh.write(line + "\n")
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Process-sharding helpers (single-process semantics; the 2-process
+behavior is proven by tests/test_distributed.py's genetics/ensemble
+modes)."""
+
+import numpy as np
+
+from znicz_tpu.parallel.process_shard import (allgather_sum,
+                                              broadcast_from_zero,
+                                              merge_round_robin,
+                                              merge_sharded_scores,
+                                              pick_eval_device,
+                                              process_info)
+
+
+def test_process_info_single():
+    assert process_info() == (0, 1)
+
+
+def test_merge_sharded_scores_single_process_identity():
+    scores = np.array([1.5, -2.0, 3.25])
+    merged = merge_sharded_scores(scores, 1)
+    np.testing.assert_array_equal(merged, scores)
+
+
+def test_merge_round_robin_single_process():
+    merged = merge_round_robin([5.0, 6.0, 7.0], 0, 1, 3)
+    np.testing.assert_array_equal(merged, [5.0, 6.0, 7.0])
+
+
+def test_allgather_sum_and_broadcast_bit_exact_f64():
+    # values with no exact float32 representation: the uint32-pair
+    # transport must round-trip them bit-exactly (jax canonicalizes
+    # f64 -> f32 otherwise)
+    vals = np.array([1.0 + 2.0 ** -40, np.pi, 1e300])
+    total = allgather_sum(vals)
+    np.testing.assert_array_equal(total, vals)  # 1 process: sum = self
+    got = broadcast_from_zero(vals)
+    np.testing.assert_array_equal(got, vals)
+    ints = np.array([2 ** 40 + 3, -7], np.int64)
+    np.testing.assert_array_equal(broadcast_from_zero(ints), ints)
+
+
+def test_pick_eval_device_prefers_factory():
+    sentinel = object()
+    assert pick_eval_device(lambda: sentinel) is sentinel
+
+
+def test_pick_eval_device_single_process_uses_config():
+    from znicz_tpu.backends import NumpyDevice
+    from znicz_tpu.utils.config import root
+
+    old = root.common.engine.backend
+    root.common.engine.backend = "numpy"
+    try:
+        assert isinstance(pick_eval_device(), NumpyDevice)
+    finally:
+        root.common.engine.backend = old

@@ -71,3 +71,41 @@ def test_ring_long_sequence_jit():
     want = np.asarray(local_attention(
         jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=True))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("block_k", [4, 8, 16])
+def test_blocked_equals_local(causal, block_k):
+    """The flash-style blocked local attention must equal the plain
+    form — forward AND vjp.  Compared under `highest` matmul precision
+    (at the default precision both paths are individually correct but
+    round differently, ~1e-3 on CPU)."""
+    rng = np.random.default_rng(0)
+    B, T, H, D = 2, 16, 2, 4
+    q = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(B, T, H, D)).astype(np.float32))
+    from znicz_tpu.parallel.ring_attention import local_attention_blocked
+    with jax.default_matmul_precision("highest"):
+        ref = local_attention(q, k, v, causal=causal)
+        got = local_attention_blocked(q, k, v, causal=causal,
+                                      block_k=block_k)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        ct = jnp.asarray(rng.normal(size=ref.shape).astype(np.float32))
+        _, vjp_ref = jax.vjp(
+            lambda a, b, c: local_attention(a, b, c, causal=causal),
+            q, k, v)
+        _, vjp_got = jax.vjp(
+            lambda a, b, c: local_attention_blocked(
+                a, b, c, causal=causal, block_k=block_k), q, k, v)
+        for gr, gg in zip(vjp_ref(ct), vjp_got(ct)):
+            np.testing.assert_allclose(np.asarray(gg), np.asarray(gr),
+                                       rtol=2e-4, atol=2e-4)
+
+
+def test_blocked_rejects_indivisible():
+    from znicz_tpu.parallel.ring_attention import local_attention_blocked
+    q = jnp.zeros((1, 6, 1, 4))
+    with pytest.raises(ValueError, match="divisible"):
+        local_attention_blocked(q, q, q, block_k=4)

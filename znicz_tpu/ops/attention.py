@@ -73,13 +73,22 @@ class MultiHeadAttention(Forward):
     EXPORT_PARAMS = ("weights", "bias", "weights_out", "bias_out")
 
     def __init__(self, workflow, n_heads: int, causal: bool = False,
-                 seq_parallel: bool = False, name=None, **kwargs) -> None:
+                 seq_parallel: bool = False,
+                 flash_block_k: int | None = None,
+                 name=None, **kwargs) -> None:
         # attention defaults to fan-scaled init (the reference's
         # fixed-stddev fillings predate attention entirely)
         kwargs.setdefault("weights_filling", "xavier")
         super().__init__(workflow, name=name, **kwargs)
         self.n_heads = int(n_heads)
         self.causal = bool(causal)
+        #: flash-style blocked local attention: scan over K/V blocks
+        #: of this size with the ring's online-softmax fold, so the
+        #: (T, T) score matrix never materializes in HBM (None = the
+        #: plain form; long sequences want T×T HBM traffic gone —
+        #: measured A/B in SEQ_BENCH.json)
+        self.flash_block_k = (None if flash_block_k is None
+                              else int(flash_block_k))
         #: ring attention over the mesh's model axis (time-sharded).
         #: This is the CONFIGURED request and is never mutated;
         #: :attr:`ring_active` is the per-initialize resolution (a mesh
@@ -101,6 +110,10 @@ class MultiHeadAttention(Forward):
         if d % self.n_heads:
             raise ValueError(f"{self}: features {d} not divisible by "
                              f"{self.n_heads} heads")
+        if self.flash_block_k and t % self.flash_block_k:
+            raise ValueError(
+                f"{self}: time axis {t} not divisible by "
+                f"flash_block_k {self.flash_block_k}")
         if not self.weights:
             self.weights.reset(self.fill_array(
                 (d, 3 * d), self.weights_filling,
@@ -159,6 +172,11 @@ class MultiHeadAttention(Forward):
             o = sequence_sharded_attention(
                 self.device.mesh, q, k, v, causal=self.causal,
                 axis_name=MODEL_AXIS)
+        elif self.flash_block_k:
+            from znicz_tpu.parallel.ring_attention import \
+                local_attention_blocked
+            o = local_attention_blocked(q, k, v, causal=self.causal,
+                                        block_k=self.flash_block_k)
         else:
             from znicz_tpu.parallel.ring_attention import local_attention
             o = local_attention(q, k, v, causal=self.causal)
