@@ -95,13 +95,17 @@ def build(precision: str):
     return wf
 
 
-def train_curve(precision: str) -> dict:
+def train_curve(precision: str, bf16_opt_state: bool = False) -> dict:
     from znicz_tpu.backends import XLADevice
     from znicz_tpu.utils import prng
-    from znicz_tpu.utils.config import reset_root
+    from znicz_tpu.utils.config import reset_root, root
 
     reset_root()
     prng.seed_all(4242)
+    # the optimizer-state arm is what's under test: pin the flag per
+    # curve so the artifact's three arms are f32 / bf16+f32-state /
+    # bf16+bf16-state regardless of the engine default
+    root.common.engine.bf16_optimizer_state = bf16_opt_state
     wf = build(precision)
     wf.initialize(device=XLADevice())
 
@@ -120,7 +124,9 @@ def train_curve(precision: str) -> dict:
 
     wf.decision.on_epoch_ended = hooked
     wf.run_chunked(steps_per_dispatch=STEPS_PER_EPOCH)
-    return {"precision": precision, "loss": losses, "n_err": errors,
+    return {"precision": precision,
+            "bf16_opt_state": bool(bf16_opt_state),
+            "loss": losses, "n_err": errors,
             "valid_n_err": valid_errors}
 
 
@@ -151,42 +157,57 @@ def main() -> None:
                      f"(initial {err_initial}, best {err_final_f32} "
                      f"of {n_valid}); resize the task"}), flush=True)
         sys.exit(2)
-    bf16 = train_curve("bfloat16")
-    curves = {"float32": f32, "bfloat16": bf16}
-    final_bf16 = bf16["loss"][-1]
-    gap = final_bf16 - final_f32  # positive = bf16 worse
-    # one-sided band: bf16 must recover ≥70% of the f32 loss drop and
-    # may trail f32's final loss by at most 30% of that drop; ENDING
-    # LOWER than f32 is a pass, not a deviation
-    loss_ok = (initial - final_bf16) >= 0.7 * drop and gap <= 0.3 * drop
-    # the same band on the accuracy-shaped metric: best validation
-    # error count (the north star's top-1 framing, BASELINE.md)
-    err_final_bf16 = min(bf16["valid_n_err"])
-    err_gap = err_final_bf16 - err_final_f32
-    err_ok = ((err_initial - err_final_bf16) >= 0.7 * err_drop
-              and err_gap <= 0.3 * err_drop)
-    ok = loss_ok and err_ok
+    def bands(arm: dict) -> dict:
+        """One-sided band vs the f32 baseline: the arm must recover
+        ≥70% of the f32 loss drop / error drop and may trail f32's
+        final by at most 30% of that drop; ENDING LOWER than f32 is a
+        pass, not a deviation.  Applied to BOTH the train-CE curve
+        and the best validation error count (the north star's top-1
+        framing, BASELINE.md)."""
+        final = arm["loss"][-1]
+        gap = final - final_f32  # positive = arm worse
+        loss_ok = ((initial - final) >= 0.7 * drop
+                   and gap <= 0.3 * drop)
+        err_final = min(arm["valid_n_err"])
+        err_gap = err_final - err_final_f32
+        err_ok = ((err_initial - err_final) >= 0.7 * err_drop
+                  and err_gap <= 0.3 * err_drop)
+        return {"loss_final": final, "gap": gap,
+                "loss_band_ok": bool(loss_ok),
+                "valid_err_best": err_final, "valid_err_gap": err_gap,
+                "err_band_ok": bool(err_ok),
+                "band_ok": bool(loss_ok and err_ok)}
+
+    # arm 2: the headline mixed-precision mode (f32 optimizer state)
+    bf16 = train_curve("bfloat16", bf16_opt_state=False)
+    # arm 3: + bf16 momentum STORAGE (the +1.0% bandwidth lever round
+    # 4 measured and declined pending exactly this validation)
+    bf16_opt = train_curve("bfloat16", bf16_opt_state=True)
+    curves = {"float32": f32, "bfloat16": bf16,
+              "bfloat16_optstate": bf16_opt}
+    verdicts = {"bfloat16": bands(bf16),
+                "bfloat16_optstate": bands(bf16_opt)}
+    ok = all(v["band_ok"] for v in verdicts.values())
     artifact = {
         "model": "alexnet", "image_size": IMAGE_SIZE, "batch": BATCH,
         "n_classes": N_CLASSES, "epochs": EPOCHS, "steps": steps,
         "n_valid": n_valid,
         "loss_initial_f32": initial,
-        "loss_final_f32": final_f32, "loss_final_bf16": final_bf16,
-        "gap": gap, "loss_band_ok": bool(loss_ok),
+        "loss_final_f32": final_f32,
         "valid_err_initial": err_initial,
         "valid_err_best_f32": err_final_f32,
-        "valid_err_best_bf16": err_final_bf16,
-        "valid_err_gap": err_gap, "err_band_ok": bool(err_ok),
+        "verdicts": verdicts,
         "band_ok": bool(ok),
         "curves": curves,
     }
     with open(os.path.join(REPO, "BF16_CONVERGENCE.json"), "w") as fh:
         json.dump(artifact, fh, indent=1)
-    print(json.dumps({k: artifact[k] for k in (
-        "steps", "loss_initial_f32", "loss_final_f32",
-        "loss_final_bf16", "gap", "loss_band_ok",
-        "valid_err_initial", "valid_err_best_f32",
-        "valid_err_best_bf16", "err_band_ok", "band_ok")}), flush=True)
+    print(json.dumps({"steps": steps, "loss_initial_f32": initial,
+                      "loss_final_f32": final_f32,
+                      "valid_err_initial": err_initial,
+                      "valid_err_best_f32": err_final_f32,
+                      "verdicts": verdicts, "band_ok": bool(ok)}),
+          flush=True)
     if not ok:
         sys.exit(1)
 

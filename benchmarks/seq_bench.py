@@ -44,6 +44,19 @@ DIM = int(os.environ.get("SEQ_DIM", "512"))
 HEADS = int(os.environ.get("SEQ_HEADS", "8"))
 STEPS = int(os.environ.get("SEQ_STEPS", "30"))
 FLASH = int(os.environ.get("SEQ_FLASH", "0"))  # 0 = plain local core
+#: SEQ_PALLAS: the fused flash-attention Pallas kernel A/B lever —
+#: unset = the unit's default (ON for TPU, the measured winner);
+#: 0 = force the XLA cores; 1 = force the kernel
+PALLAS_ENV = os.environ.get("SEQ_PALLAS", "")
+#: steps per device dispatch (lax.scan chunk — the framework's real
+#: training loop shape, same as bench.py's BENCH_CHUNK; through this
+#: environment's tunnel a Pallas program pays a large PER-DISPATCH
+#:  overhead that chunking amortizes, measured in PERF.md round 5)
+CHUNK = max(1, int(os.environ.get("SEQ_CHUNK", "8")))
+#: SEQ_PROFILE=<dir>: capture a jax.profiler trace of the timed loop
+#: (same discipline as bench.py — a seq perf number should never be
+#: unexplainable)
+PROFILE_DIR = os.environ.get("SEQ_PROFILE", "")
 WARMUP = 5
 
 
@@ -52,8 +65,22 @@ def build():
     from znicz_tpu.models.standard_workflow import StandardWorkflow
 
     rng = np.random.default_rng(3)
-    n = 4 * BATCH
-    x = rng.normal(0, 0.3, size=(n, SEQ_LEN, DIM)).astype(np.float32)
+    # the epoch schedule must hold at least one whole chunk so
+    # run_chunk never scans past the device-resident schedule (the
+    # run_chunked contract: chunks never span a reshuffle).  In bf16
+    # mode the dataset is stored bf16 (the loader keeps original
+    # dtype in HBM; the model consumes bf16 anyway): TPU row gathers
+    # from a resident table cost ~table-bytes of traffic per step, so
+    # storage width is the gather's price — measured in PERF.md round
+    # 5.  The f32 arm keeps f32 inputs so SEQ_PRECISION=float32 still
+    # measures the real f32 data path.
+    n = max(4, CHUNK) * BATCH
+    x = rng.normal(0, 0.3, size=(n, SEQ_LEN, DIM))
+    if os.environ.get("SEQ_PRECISION", "bfloat16") == "bfloat16":
+        import ml_dtypes
+        x = x.astype(ml_dtypes.bfloat16)
+    else:
+        x = x.astype(np.float32)
     y = rng.integers(0, 8, size=n).astype(np.int32)
     gd = {"learning_rate": 0.01, "gradient_moment": 0.9}
     wf = StandardWorkflow(
@@ -93,6 +120,8 @@ def main() -> None:
 
     root.common.precision_type = os.environ.get("SEQ_PRECISION",
                                                 "bfloat16")
+    if PALLAS_ENV:
+        root.common.engine.flash_attention = PALLAS_ENV != "0"
     prng.seed_all(11)
     wf = build()
     import jax.numpy as jnp
@@ -100,23 +129,39 @@ def main() -> None:
     wf.initialize(device=device)
     assert wf._region_unit is not None
 
+    region = wf._region_unit.region
+
     def step():
-        wf.loader.run()
-        wf._region_unit.run()
+        """One dispatch = CHUNK scanned train steps (the framework's
+        chunked hot path), or a single region step at CHUNK=1."""
+        if CHUNK > 1:
+            for _ in range(CHUNK):
+                wf.loader.run()   # host bookkeeping only
+            region.run_chunk(CHUNK)
+        else:
+            wf.loader.run()
+            wf._region_unit.run()
 
     def fence() -> float:
         # VALUE fetch = the only barrier the tunnel honors (see note)
         return float(jnp.sum(
             wf.forwards[-1].weights.devmem.astype(jnp.float32)))
 
-    for _ in range(WARMUP):
+    dispatches = max(2, STEPS // CHUNK)
+    for _ in range(max(1, WARMUP // CHUNK)):
         step()
     fence()
+    if PROFILE_DIR:
+        import jax
+        jax.profiler.start_trace(PROFILE_DIR)
     t0 = time.perf_counter()
-    for _ in range(STEPS):
+    for _ in range(dispatches):
         step()
     fence()
-    dt = (time.perf_counter() - t0) / STEPS
+    dt = (time.perf_counter() - t0) / (dispatches * CHUNK)
+    if PROFILE_DIR:
+        import jax
+        jax.profiler.stop_trace()
     tokens_per_sec = BATCH * SEQ_LEN / dt
     mfu = attn_train_flops() / dt / (peak_tflops(device.jax_device)
                                      * 1e12)
@@ -126,6 +171,7 @@ def main() -> None:
         "unit": "tokens/sec/chip",
         "batch": BATCH, "seq_len": SEQ_LEN, "dim": DIM,
         "heads": HEADS, "flash_block_k": FLASH or None,
+        "pallas": wf.forwards[0]._flash_pallas, "chunk": CHUNK,
         "step_time_ms": round(dt * 1e3, 3),
         "mfu": round(mfu, 4),
         "precision": str(root.common.precision_type),

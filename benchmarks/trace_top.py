@@ -1,0 +1,94 @@
+"""Summarize a jax.profiler trace: top device-time sinks by fusion.
+
+Usage: ``python benchmarks/trace_top.py <profile_dir_or_trace.json.gz>
+[n_steps]`` — finds the newest ``*.trace.json.gz`` under the
+directory, sums durations of device-lane events by name, and prints
+the top entries (total ms, ms/step when ``n_steps`` given, % of
+device total).  This is how PERF.md's "named sinks" tables are made.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import sys
+
+
+def find_trace(path: str) -> str:
+    if path.endswith(".json.gz"):
+        return path
+    hits = sorted(glob.glob(
+        os.path.join(path, "**", "*.trace.json.gz"), recursive=True),
+        key=os.path.getmtime)
+    if not hits:
+        raise SystemExit(f"no *.trace.json.gz under {path}")
+    return hits[-1]
+
+
+def main() -> None:
+    trace = find_trace(sys.argv[1])
+    n_steps = int(sys.argv[2]) if len(sys.argv) > 2 else None
+    with gzip.open(trace, "rt") as fh:
+        data = json.load(fh)
+    events = data["traceEvents"]
+    # device lanes: pid whose process_name metadata contains TPU/device
+    pid_names = {}
+    tid_names = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pid_names[ev["pid"]] = ev["args"].get("name", "")
+        if ev.get("ph") == "M" and ev.get("name") == "thread_name":
+            tid_names[(ev["pid"], ev["tid"])] = ev["args"].get("name", "")
+    device_pids = {pid for pid, name in pid_names.items()
+                   if any(t in name.lower()
+                          for t in ("tpu", "device", "axon", "/device"))}
+    by_name: collections.Counter = collections.Counter()
+    lane_total: collections.Counter = collections.Counter()
+    info: dict = {}
+    for ev in events:
+        if ev.get("ph") != "X" or ev.get("pid") not in device_pids:
+            continue
+        lane = tid_names.get((ev["pid"], ev["tid"]), "")
+        low = lane.lower()
+        # the Modules lane is the program envelope — it double-counts
+        # every op; keep only the per-op lane(s)
+        if "step" in low or "module" in low:
+            continue
+        dur = ev.get("dur", 0) / 1e3  # us -> ms
+        by_name[ev["name"]] += dur
+        lane_total[lane] += dur
+        args = ev.get("args") or {}
+        if args and ev["name"] not in info:
+            src = (args.get("source") or "").rsplit("/", 1)[-1]
+            info[ev["name"]] = (
+                float(args.get("model_flops") or 0),
+                float(args.get("bytes_accessed") or 0),
+                src, (args.get("tf_op") or "").strip(": "))
+    total = sum(by_name.values())
+    print(f"trace: {trace}")
+    print(f"device busy: {total:.1f} ms"
+          + (f" ({total / n_steps:.3f} ms/step)" if n_steps else ""))
+    n_events: collections.Counter = collections.Counter()
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("pid") in device_pids:
+            n_events[ev["name"]] += 1
+    for name, ms in by_name.most_common(25):
+        line = f"{ms:9.1f} ms  {100 * ms / total:5.1f}%"
+        if n_steps:
+            line += f"  {ms / n_steps:7.3f} ms/step"
+        flops, nbytes, src, tf_op = info.get(name, (0, 0, "", ""))
+        count = n_events[name]
+        sec = ms / 1e3 / max(count, 1)
+        perf = ""
+        if flops:
+            perf += f"  {flops / sec / 1e12:6.1f} TF/s"
+        if nbytes:
+            perf += f"  {nbytes / sec / 1e9:6.0f} GB/s"
+        print(f"{line}{perf}  {name[:40]:40s} {src:34s} {tf_op[:60]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -68,3 +68,58 @@ def test_channel_splitter_validates():
         split.initialize(device=NumpyDevice())
     with pytest.raises(ValueError, match="at least one"):
         ChannelSplitter(wf, groups=[])
+
+
+def test_to_sequence_trains_end_to_end():
+    """ToSequence (ViT-style spatial→token flatten) forward/backward
+    parity: a conv→to_sequence→attention→softmax net must train on
+    XLA-CPU, and the unit's numpy oracle must match the XLA reshape
+    exactly."""
+    import jax.numpy as jnp
+
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.ops.seq_reshape import ToSequence
+    from znicz_tpu.utils import prng
+
+    # oracle parity on a standalone unit
+    wf0 = DummyWorkflow(device=NumpyDevice())
+    x = RNG.normal(size=(2, 3, 4, 5)).astype(np.float32)
+    src = DummyUnit(wf0, output=Vector(x, name="x"))
+    unit = ToSequence(wf0)
+    unit.link_attrs(src, ("input", "output"))
+    unit.initialize(device=NumpyDevice())
+    unit.run()
+    unit.output.map_read()
+    np.testing.assert_array_equal(unit.output.mem, x.reshape(2, 12, 5))
+
+    # end-to-end: trains through the reshape pair
+    prng.seed_all(5)
+    rng = np.random.default_rng(5)
+    protos = rng.normal(0, 1, (3, 8, 8, 2)).astype(np.float32)
+    y = rng.integers(0, 3, 96).astype(np.int32)
+    data = protos[y] + 0.5 * rng.normal(size=(96, 8, 8, 2))
+    wf = StandardWorkflow(
+        name="toseq",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data.astype(np.float32), train_labels=y,
+            minibatch_size=32),
+        layers=[
+            {"type": "to_sequence", "->": {}},
+            {"type": "attention", "->": {"n_heads": 2},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": 3},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}}],
+        decision_config={"max_epochs": 6})
+    wf.initialize(device=XLADevice())
+    losses = []
+    orig = wf.decision.on_epoch_ended
+
+    def hooked():
+        orig()
+        losses.append(wf.decision.epoch_loss[2])
+
+    wf.decision.on_epoch_ended = hooked
+    wf.run()
+    assert losses[-1] < losses[0], losses

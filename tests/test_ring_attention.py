@@ -109,3 +109,62 @@ def test_blocked_rejects_indivisible():
     q = jnp.zeros((1, 6, 1, 4))
     with pytest.raises(ValueError, match="divisible"):
         local_attention_blocked(q, q, q, block_k=4)
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("n_shards,block_k", [(2, 4), (4, 8), (4, 4)])
+def test_blocked_ring_equals_local_fwd_and_vjp(causal, n_shards,
+                                               block_k):
+    """Flash-in-ring (round-4 verdict item 6): the sub-blocked fold
+    inside each ring step must equal the plain ring AND the local
+    oracle — forward and vjp — so the single-chip blocked memory
+    behavior extends to T-per-device × ring."""
+    mesh = make_seq_mesh(n_shards)
+    rng = np.random.default_rng(7)
+    B, T, H, D = 2, 16 * n_shards, 2, 4
+    q, k, v = (jnp.asarray(rng.normal(size=(B, T, H, D))
+                           .astype(np.float32)) for _ in range(3))
+    with jax.default_matmul_precision("highest"):
+        ref = local_attention(q, k, v, causal=causal)
+        plain_ring = sequence_sharded_attention(
+            mesh, q, k, v, causal=causal)
+        got = sequence_sharded_attention(
+            mesh, q, k, v, causal=causal, block_k=block_k)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(got),
+                                   np.asarray(plain_ring),
+                                   rtol=2e-4, atol=2e-5)
+        ct = jnp.asarray(rng.normal(size=ref.shape).astype(np.float32))
+        _, vjp_ref = jax.vjp(
+            lambda a, b, c: local_attention(a, b, c, causal=causal),
+            q, k, v)
+        _, vjp_got = jax.vjp(
+            lambda a, b, c: sequence_sharded_attention(
+                mesh, a, b, c, causal=causal, block_k=block_k),
+            q, k, v)
+        for gr, gg in zip(vjp_ref(ct), vjp_got(ct)):
+            np.testing.assert_allclose(np.asarray(gg), np.asarray(gr),
+                                       rtol=3e-4, atol=3e-4)
+
+
+def test_blocked_ring_rejects_indivisible_local_t():
+    mesh = make_seq_mesh(2)
+    q = jnp.zeros((1, 12, 1, 4))  # T_local = 6, not divisible by 4
+    with pytest.raises(ValueError, match="divisible"):
+        sequence_sharded_attention(mesh, q, q, q, block_k=4)
+
+
+def test_blocked_ring_whole_tile_when_block_exceeds_local_t():
+    """block_k ≥ T_local degrades to the whole-tile fold (the valid
+    config seq_parallel + a single-chip-sized flash_block_k hits when
+    the ring splits T below the block size)."""
+    mesh = make_seq_mesh(4)
+    rng = np.random.default_rng(9)
+    q, k, v = (jnp.asarray(rng.normal(size=(1, 64, 2, 4))
+                           .astype(np.float32)) for _ in range(3))
+    with jax.default_matmul_precision("highest"):
+        ref = local_attention(q, k, v, causal=True)
+        got = sequence_sharded_attention(  # T_local=16 < block_k=32
+            mesh, q, k, v, causal=True, block_k=32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)

@@ -35,6 +35,7 @@ from znicz_tpu.mutable import Bool
 from znicz_tpu.ops import activation, all2all, conv, cutter, dropout, pooling
 from znicz_tpu.ops import attention, deconv, depooling, lstm, normalization
 from znicz_tpu.ops import embedding, layer_norm, pos_encoding
+from znicz_tpu.ops import seq_reshape
 from znicz_tpu.ops import gd, gd_conv, gd_pooling  # noqa: F401 (pairs)
 from znicz_tpu.ops.decision import DecisionGD, DecisionMSE
 from znicz_tpu.ops.lr_adjust import LearningRateAdjust
@@ -92,6 +93,7 @@ for _name, _cls in {
     "depooling": depooling.Depooling,
     "lstm": lstm.LSTM,
     "attention": attention.MultiHeadAttention,
+    "to_sequence": seq_reshape.ToSequence,
     "pos_encoding": pos_encoding.PositionalEncoding,
     "layer_norm": layer_norm.LayerNorm,
     "embedding": embedding.Embedding,

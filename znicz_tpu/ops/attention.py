@@ -96,6 +96,9 @@ class MultiHeadAttention(Forward):
         #: re-initializing on a capable mesh re-engages the ring).
         self.seq_parallel = bool(seq_parallel)
         self._ring_active = False
+        #: pullback stashed by xla_run for the GD pair (same trace;
+        #: transient — never pickled, cleared by the consumer)
+        self._traced_vjp = None
         self.weights_out = Vector(name=f"{self.name}.weights_out")
         self.bias_out = Vector(name=f"{self.name}.bias_out")
 
@@ -149,6 +152,27 @@ class MultiHeadAttention(Forward):
                         f"model-axis size {mesh.shape[MODEL_AXIS]}")
                 self._ring_active = True
                 self.output.model_shard_dim = 1  # time rides the ring
+        # fused flash-attention Pallas kernel (ops/pallas_attention):
+        # DEFAULT ON for real TPU devices — the measured winner at
+        # every T (chip A/B in PERF.md round 5 / SEQ_BENCH.json:
+        # 2.51M vs 1.63M tokens/s at T=2048, and the only form that
+        # runs T≥8k on one chip at speed).  Opt out with
+        # ``root.common.engine.flash_attention = False``; resolved
+        # ONCE here like every engine flag.  The ring path keeps the
+        # jnp fold (it runs under shard_map across devices); shapes
+        # the kernel's tiling cannot cover fall back to the XLA cores.
+        from znicz_tpu.ops import pallas_attention, pallas_kernels
+        from znicz_tpu.utils.config import root
+        flag = root.common.engine.get("flash_attention", "auto")
+        if flag == "auto":
+            flag = pallas_kernels.is_tpu_device(self.device)
+        bq = min(pallas_attention.BLOCK_Q, t)
+        bk = min(self.flash_block_k or pallas_attention.BLOCK_K, t)
+        self._flash_pallas = (
+            bool(flag)
+            and pallas_kernels.is_tpu_device(self.device)
+            and not self._ring_active
+            and t % bq == 0 and t % bk == 0 and t % 8 == 0)
         self.init_vectors(self.input, self.output, self.weights,
                           self.bias, self.weights_out, self.bias_out)
 
@@ -165,32 +189,68 @@ class MultiHeadAttention(Forward):
         qkv = self.mxu_dot(jnp, x32.reshape(b * t, d), w_qkv)
         if b_qkv is not None:
             qkv = qkv + b_qkv
+        # attention-core GEMM/storage dtype: the repo-wide bf16-inputs/
+        # f32-accumulation convention (profiled: the core's (T, T)
+        # tensors are the step's HBM-bandwidth sink — PERF.md round 5).
+        # Cast ONCE here so q/k/v reach the core (and the flash
+        # kernel's layout transposes) at half width.
+        dot_dtype = self.mxu_dtype
+        if dot_dtype is not None:
+            qkv = qkv.astype(dot_dtype)
         q, k, v = _split_heads(qkv.reshape(b, t, 3 * d), self.n_heads)
         if self.ring_active:
             from znicz_tpu.parallel.ring_attention import \
                 sequence_sharded_attention
             o = sequence_sharded_attention(
                 self.device.mesh, q, k, v, causal=self.causal,
-                axis_name=MODEL_AXIS)
+                axis_name=MODEL_AXIS, dot_dtype=dot_dtype,
+                block_k=self.flash_block_k)
+        elif getattr(self, "_flash_pallas", False):
+            from znicz_tpu.ops import pallas_attention
+            o = pallas_attention.flash_attention(
+                q, k, v, causal=self.causal,
+                block_k=self.flash_block_k or pallas_attention.BLOCK_K,
+                dot_dtype=dot_dtype)
         elif self.flash_block_k:
             from znicz_tpu.parallel.ring_attention import \
                 local_attention_blocked
             o = local_attention_blocked(q, k, v, causal=self.causal,
-                                        block_k=self.flash_block_k)
+                                        block_k=self.flash_block_k,
+                                        dot_dtype=dot_dtype)
         else:
             from znicz_tpu.parallel.ring_attention import local_attention
-            o = local_attention(q, k, v, causal=self.causal)
+            o = local_attention(q, k, v, causal=self.causal,
+                                dot_dtype=dot_dtype)
         y = self.mxu_dot(jnp, o.reshape(b * t, d), w_out)
         if b_out is not None:
             y = y + b_out
         return y.reshape(b, t, d)
 
     def xla_run(self) -> None:
-        self.output.devmem = self.xla_forward(
-            self.input.devmem, self.weights.devmem,
-            self.bias.devmem if self.include_bias else None,
-            self.weights_out.devmem,
-            self.bias_out.devmem if self.include_bias else None)
+        args = (self.input.devmem, self.weights.devmem,
+                self.bias.devmem if self.include_bias else None,
+                self.weights_out.devmem,
+                self.bias_out.devmem if self.include_bias else None)
+        if not self.output._tracing:
+            # eager (non-region) execution: plain forward.  Stashing a
+            # pullback here would pin the forward residuals — for the
+            # plain core that includes the (B, H, T, T) probability
+            # tensor — in HBM across steps of forward-only workflows.
+            self._traced_vjp = None
+            self.output.devmem = self.xla_forward(*args)
+            return
+        # region trace: compute through jax.vjp and STASH the pullback
+        # for this unit's GD pair — both are traced into one program,
+        # and re-deriving the vjp there would re-run the forward.  XLA
+        # CSE merges the duplicated einsums of the plain core, but an
+        # opaque pallas_call (the fused flash kernel) is never CSE'd,
+        # so the kernel executed twice per step (measured +3.4 ms at
+        # T=2048 — PERF.md round 5).  In eval-mode region variants the
+        # unused pullback is dead code and XLA drops it.
+        out, self._traced_vjp = jax.vjp(
+            lambda x, wq, bq, wo, bo: self.xla_forward(
+                x, wq, bq, wo, bo), *args)
+        self.output.devmem = out
 
     # -- numpy oracle ---------------------------------------------------
     def _forward_np(self, x):
@@ -239,10 +299,10 @@ class GDMultiHeadAttention(GradientDescentBase):
         fwd = self.forward_unit
         if self.gradient_moment:
             self.accumulated_gradient_weights_out.reset(
-                np.zeros(fwd.weights_out.shape, np.float32))
+                np.zeros(fwd.weights_out.shape, self.opt_state_dtype))
         if self.gradient_moment_bias and fwd.include_bias:
             self.accumulated_gradient_bias_out.reset(
-                np.zeros(fwd.bias_out.shape, np.float32))
+                np.zeros(fwd.bias_out.shape, self.opt_state_dtype))
         self.init_vectors(self.err_input, self.err_output, self.input,
                           self.output, self.weights, self.bias,
                           fwd.weights_out, fwd.bias_out,
@@ -263,13 +323,24 @@ class GDMultiHeadAttention(GradientDescentBase):
     def xla_run(self) -> None:
         fwd = self.forward_unit
         has_bias = fwd.include_bias
-        args = (self.input.devmem, self.weights.devmem,
-                self.bias.devmem if has_bias else None,
-                fwd.weights_out.devmem,
-                fwd.bias_out.devmem if has_bias else None)
-        _, vjp = jax.vjp(
-            lambda x, wq, bq, wo, bo: fwd.xla_forward(x, wq, bq, wo, bo),
-            *args)
+        # consume the stashed pullback ONLY when this GD is tracing
+        # into the same region program the forward just traced into
+        # (the region schedules forward before backward, and the
+        # forward overwrites the stash at the top of every trace, so a
+        # tracing consumer can never see a stale trace's closure); an
+        # EAGER backward must rebuild — a stash from some earlier
+        # trace would hold escaped tracers
+        vjp = fwd._traced_vjp if self.err_output._tracing else None
+        fwd._traced_vjp = None   # single-use: never reuse stale state
+        if vjp is None:          # forward ran outside this trace
+            args = (self.input.devmem, self.weights.devmem,
+                    self.bias.devmem if has_bias else None,
+                    fwd.weights_out.devmem,
+                    fwd.bias_out.devmem if has_bias else None)
+            _, vjp = jax.vjp(
+                lambda x, wq, bq, wo, bo: fwd.xla_forward(
+                    x, wq, bq, wo, bo),
+                *args)
         gx, gwq, gbq, gwo, gbo = vjp(
             self.err_output.devmem.astype(jnp.float32))
         if self.need_err_input:

@@ -1,0 +1,317 @@
+"""Fused flash-attention Pallas TPU kernels (forward + backward).
+
+Why this exists: the round-5 profile of the T=2048 sequence step
+(PERF.md) shows the six attention-core GEMMs plus the softmax
+reduction pinned at the HBM bandwidth roof (~660–800 GB/s, 11–50
+TF/s) streaming (B, H, T, T) score/probability tensors — ~78% of the
+step.  The XLA paths (plain einsum and the ``lax.scan`` blocked fold
+in :mod:`znicz_tpu.parallel.ring_attention`) cannot avoid
+materializing those tensors (plain) or the per-step carry round-trips
+(scan).  A fused kernel keeps every (block_q, block_k) score tile in
+VMEM: the only HBM traffic is q/k/v/o (+ per-row logsumexp), so the
+core runs at MXU rate instead of bandwidth rate.
+
+Design (the standard flash decomposition, implemented TPU-first):
+
+- **forward**: grid (B, H, nq, nk), K-blocks innermost ("arbitrary"
+  semantics — sequential per core); online-softmax state (running row
+  max m, normalizer l, weighted accumulator) lives in VMEM scratch
+  across the K iterations; the output block and the per-row
+  logsumexp are written once at the last K block.
+- **backward**: recompute-from-lse form — no (T, T) residual is ever
+  stored.  Saves (q, k, v, o, lse) from the forward, precomputes
+  ``delta = rowsum(do·o)`` (one cheap XLA pass), then two kernels:
+  ``dq`` (grid over K blocks innermost, accumulating dq tiles) and
+  ``dk/dv`` (grid over Q blocks innermost, accumulating dk/dv tiles);
+  each recomputes the score tile p = exp(s − lse) in VMEM.
+- **dtypes**: tile GEMMs run at the input dtype (bf16 in the
+  framework's mixed-precision mode) with f32 accumulation via
+  ``preferred_element_type``; softmax statistics, lse, delta and all
+  accumulators are f32 — the same bf16-inputs/f32-accumulation
+  convention as the rest of the repo.
+- **causal**: global-position mask inside the tile (exact across
+  block boundaries — same rule as ``ring_attention._visibility``);
+  fully-masked tiles are skipped via ``pl.when``, so causal runs at
+  ~2× effective rate.
+
+Layout contract: (B, T, H, D) at the boundary (the unit-graph
+convention); kernels run head-major (B, H, T, D) — the wrapper
+transposes, which costs two cheap bandwidth passes versus the many
+(T, T) passes saved.
+
+Adoption is measured, not assumed: SEQ_BENCH.json / PERF.md round 5
+carry the chip A/B against the plain and scan-blocked XLA forms (the
+PALLAS_BENCH.md decision rule).  ``interpret=True`` runs the same
+kernels on CPU for the oracle equality tests.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+#: default tile sizes — chip-swept (PERF.md round 5): 1024×1024 beats
+#: 512×512 by ~1.2× (fewer grid revisits of the VMEM stats; the f32
+#: score tile is 4 MB); 2048-wide tiles overflow VMEM and fail to
+#: compile, so callers wanting other shapes pass block_q/block_k
+BLOCK_Q = 1024
+BLOCK_K = 1024
+#: lane width for the per-row statistics arrays (lse, delta): the
+#: minimum tile-legal last dim — the value is replicated across lanes
+_LANES = 8
+
+
+def _causal_mask(iq, ik, bq: int, bk: int):
+    """(bq, bk) visibility tile from GLOBAL positions (rows iq·bq…,
+    cols ik·bk…)."""
+    rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return rows >= cols
+
+
+def _dot(a, b, trans_a: bool = False, trans_b: bool = False):
+    """MXU dot with f32 accumulation, contracting dims picked so no
+    operand is materialized transposed."""
+    dims = (((0,) if trans_a else (1,), (1,) if trans_b else (0,)),
+            ((), ()))
+    return jax.lax.dot_general(a, b, dims,
+                               preferred_element_type=jnp.float32)
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale, causal, bq, bk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    visible = True if not causal else iq * bq + bq - 1 >= ik * bk
+
+    @pl.when(visible)
+    def _fold():
+        q = q_ref[0, 0]                       # (bq, D)
+        s = _dot(q, k_ref[0, 0], trans_b=True) * scale   # (bq, bk) f32
+        if causal:
+            s = jnp.where(_causal_mask(iq, ik, bq, bk), s, _NEG_INF)
+        m_prev = m_scr[:, :1]                 # (bq, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                # masked → exp(−huge) = 0
+        corr = jnp.exp(m_prev - m_new)        # (bq, 1)
+        l_scr[...] = l_scr[...] * corr + jnp.sum(p, axis=1,
+                                                 keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr \
+            + _dot(p.astype(v_ref.dtype), v_ref[0, 0])
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        # row stats ride 8 lanes (minimum tile-legal lane width; the
+        # value is the same in every lane)
+        lse_ref[0, 0] = jnp.broadcast_to(
+            m_scr[:, :1] + jnp.log(l), lse_ref.shape[2:])
+
+
+def _fwd_call(q, k, v, causal, bq, bk, interpret):
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    nq, nk = t // bq, tk // bk
+    kernel = functools.partial(_fwd_kernel, scale=1.0 / np.sqrt(d),
+                               causal=causal, bq=bq, bk=bk)
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+    kspec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(b, h, nq, nk),
+        in_specs=[qspec, kspec, kspec],
+        out_specs=(qspec,
+                   pl.BlockSpec((1, 1, bq, _LANES),
+                                lambda b_, h_, iq, ik: (b_, h_, iq, 0))),
+        out_shape=(jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+                   jax.ShapeDtypeStruct((b, h, t, _LANES), jnp.float32)),
+        scratch_shapes=[pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, 128), jnp.float32),
+                        pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v)
+
+
+# ----------------------------------------------------------------------
+# backward: dq kernel (K blocks innermost), dk/dv kernel (Q innermost)
+# ----------------------------------------------------------------------
+def _p_tile(q_ref, k_ref, lse_ref, iq, ik, scale, causal, bq, bk):
+    """Recompute the probability tile p = exp(s − lse) in VMEM."""
+    s = _dot(q_ref[0, 0], k_ref[0, 0], trans_b=True) * scale
+    if causal:
+        s = jnp.where(_causal_mask(iq, ik, bq, bk), s, _NEG_INF)
+    return jnp.exp(s - lse_ref[0, 0][:, :1])     # masked → 0
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr, *, scale, causal, bq, bk):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    visible = True if not causal else iq * bq + bq - 1 >= ik * bk
+
+    @pl.when(visible)
+    def _fold():
+        p = _p_tile(q_ref, k_ref, lse_ref, iq, ik, scale, causal,
+                    bq, bk)
+        dp = _dot(do_ref[0, 0], v_ref[0, 0], trans_b=True)  # (bq, bk)
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
+        dq_scr[...] += _dot(ds.astype(k_ref.dtype), k_ref[0, 0])
+
+    @pl.when(ik == nk - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                bq, bk):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    visible = True if not causal else iq * bq + bq - 1 >= ik * bk
+
+    @pl.when(visible)
+    def _fold():
+        p = _p_tile(q_ref, k_ref, lse_ref, iq, ik, scale, causal,
+                    bq, bk)
+        do = do_ref[0, 0]
+        # dv += pᵀ · do ; contract the q dim without materializing pᵀ
+        dv_scr[...] += _dot(p.astype(do.dtype), do, trans_a=True)
+        dp = _dot(do, v_ref[0, 0], trans_b=True)
+        ds = p * (dp - delta_ref[0, 0][:, :1]) * scale
+        dk_scr[...] += _dot(ds.astype(q_ref.dtype), q_ref[0, 0],
+                            trans_a=True)
+
+    @pl.when(iq == nq - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_call(q, k, v, o, lse, do, causal, bq, bk, interpret):
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    nq, nk = t // bq, tk // bk
+    delta = jnp.broadcast_to(
+        jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                axis=-1, keepdims=True),
+        (b, h, t, _LANES))                            # (B, H, T, 8)
+    scale = 1.0 / np.sqrt(d)
+    qspec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+    kspec = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, iq, ik: (b_, h_, ik, 0))
+    rspec = pl.BlockSpec((1, 1, bq, _LANES),
+                         lambda b_, h_, iq, ik: (b_, h_, iq, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk),
+        grid=(b, h, nq, nk),
+        in_specs=[qspec, kspec, kspec, qspec, rspec, rspec],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    # dk/dv: Q blocks innermost; the q-side specs index by the LAST
+    # grid dim now, the k-side by dim 2
+    qspec2 = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ik, iq: (b_, h_, iq, 0))
+    kspec2 = pl.BlockSpec((1, 1, bk, d), lambda b_, h_, ik, iq: (b_, h_, ik, 0))
+    rspec2 = pl.BlockSpec((1, 1, bq, _LANES),
+                          lambda b_, h_, ik, iq: (b_, h_, iq, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk),
+        grid=(b, h, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2, qspec2, rspec2, rspec2],
+        out_specs=(kspec2, kspec2),
+        out_shape=(jax.ShapeDtypeStruct((b, h, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, tk, d), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------------
+# custom_vjp wrapper (head-major) + the (B, T, H, D) public entry
+# ----------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, bq, bk, interpret):
+    out, _ = _fwd_call(q, k, v, causal, bq, bk, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, bq, bk, interpret):
+    out, lse = _fwd_call(q, k, v, causal, bq, bk, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, bq, bk, interpret, res, do):
+    q, k, v, out, lse = res
+    do = do.astype(q.dtype)
+    return _bwd_call(q, k, v, out, lse, do, causal, bq, bk, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    block_q: int = BLOCK_Q, block_k: int = BLOCK_K,
+                    dot_dtype=None, interpret: bool = False):
+    """Fused flash attention: (B, T, H, D) → (B, T, H, D) f32.
+
+    ``dot_dtype`` casts q/k/v (the tile-GEMM operand dtype — bf16 in
+    the framework's mixed-precision mode); accumulation and softmax
+    statistics are always f32.  Blocks must divide T (same contract as
+    ``local_attention_blocked``).  Differentiable via the fused
+    recompute backward — no (T, T) tensor ever reaches HBM in either
+    direction.
+    """
+    b, t, h, d = q.shape
+    tk = k.shape[1]
+    bq, bk = min(block_q, t), min(block_k, tk)
+    if t % bq or tk % bk:
+        raise ValueError(f"T {t}/{tk} not divisible by blocks "
+                         f"({bq}, {bk})")
+    if dot_dtype is not None:
+        q, k, v = (a.astype(dot_dtype) for a in (q, k, v))
+    qh, kh, vh = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
+    out = _flash(qh, kh, vh, causal, bq, bk, interpret)
+    return out.transpose(0, 2, 1, 3).astype(jnp.float32)

@@ -38,6 +38,18 @@ from znicz_tpu.ops.normalization import _window_sum as _window_sum_xp
 _TILE_ROWS = 512
 
 
+def is_tpu_device(device) -> bool:
+    """True when ``device`` fronts a real TPU (Pallas kernels can
+    compile).  Accepts ``axon`` (this environment's TPU tunnel plugin
+    reports its own platform name) and anything whose device_kind
+    names a TPU."""
+    jax_device = getattr(device, "jax_device", None)
+    if jax_device is None:
+        return False
+    return (jax_device.platform in ("tpu", "axon")
+            or "tpu" in getattr(jax_device, "device_kind", "").lower())
+
+
 def use_pallas(device, op: str | None = None) -> bool:
     """Pallas path gate: TPU platform + config switch.
 
@@ -64,12 +76,7 @@ def use_pallas(device, op: str | None = None) -> bool:
     whose device_kind names a TPU.
     """
     from znicz_tpu.utils.config import root
-    jax_device = getattr(device, "jax_device", None)
-    if jax_device is None:
-        return False
-    if jax_device.platform not in ("tpu", "axon") \
-            and "tpu" not in getattr(jax_device, "device_kind",
-                                     "").lower():
+    if not is_tpu_device(device):
         return False
     val = root.common.engine.get("use_pallas", False)
     if isinstance(val, (list, tuple, set, frozenset)):

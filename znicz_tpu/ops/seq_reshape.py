@@ -1,0 +1,65 @@
+"""ToSequence: flatten spatial positions into a token axis.
+
+(B, H, W, C) → (B, H·W, C) — the ViT-style bridge from the conv
+feature map to the sequence stack (attention / layer_norm consume
+(batch, time, features)).  The 2015 reference predates attention
+(SURVEY.md §5.7); this unit exists so conv front-ends and the
+long-context op family compose in one workflow — e.g. the multichip
+dryrun trains conv → attention in a single GSPMD program.
+
+Backward is the exact reshape adjoint (a reshape), so the pair is
+weightless and loss-free in both directions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.ops.nn_units import Forward, WeightlessGradientUnit
+
+
+class ToSequence(Forward):
+    """Reshape (B, H, W, C) — or any (B, d1..dn, C) — to
+    (B, Πdᵢ, C)."""
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        shape = self.input.shape
+        if len(shape) < 3:
+            raise ValueError(f"{self}: need (batch, ..., features) "
+                             f"rank ≥ 3, got {shape}")
+        b, c = shape[0], shape[-1]
+        t = int(np.prod(shape[1:-1]))
+        self.output.reset(np.zeros((b, t, c),
+                                   dtype=self.output_store_dtype))
+        self.init_vectors(self.input, self.output)
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = self.input.mem.reshape(self.output.shape)
+
+    def xla_run(self) -> None:
+        self.output.devmem = self.input.devmem.reshape(
+            self.output.shape)
+
+
+class GDToSequence(WeightlessGradientUnit):
+    """Reshape the error back to the spatial shape."""
+
+    MATCHES = (ToSequence,)
+
+    def numpy_run(self) -> None:
+        if not self.need_err_input:
+            return
+        self.err_output.map_read()
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = self.err_output.mem.reshape(
+            self.err_input.shape)
+
+    def xla_run(self) -> None:
+        if self.need_err_input:
+            self.err_input.devmem = self.err_output.devmem.reshape(
+                self.err_input.shape)

@@ -41,32 +41,62 @@ def _visibility(tq: int, tk: int, q_pos=None, k_pos=None):
     return (q_pos[:, None] >= k_pos[None, :])[None, None]
 
 
-def local_attention(q, k, v, causal: bool = False):
+def local_attention(q, k, v, causal: bool = False, dot_dtype=None):
     """Single-device softmax attention — the oracle.
 
     Shapes: q (B, Tq, H, D), k/v (B, Tk, H, D) → (B, Tq, H, D).
+
+    ``dot_dtype`` (e.g. ``jnp.bfloat16``) casts the GEMM operands AND
+    the materialized (T, T) score/probability tensors to that dtype —
+    the profile of the T=2048 step (PERF.md round 5) shows the six
+    attention-core GEMMs + the softmax reduction pinned at the HBM
+    bandwidth roof (~660–775 GB/s, 11–24 TF/s) streaming f32 (B, H,
+    T, T) tensors, so halving the bytes nearly halves the step.
+    Softmax statistics (row max, normalizer) still reduce in f32 via
+    ``preferred_element_type`` on the reductions' inputs; ``None``
+    keeps the original full-f32 math (the CPU/oracle path).
     """
     d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(d)
+    if dot_dtype is not None:
+        q, k, v = (a.astype(dot_dtype) for a in (q, k, v))
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) / np.sqrt(d)
     if causal:
         tq, tk = q.shape[1], k.shape[1]
         mask = (jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :])
         s = jnp.where(mask[None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    if dot_dtype is not None:
+        # stabilized softmax with the big (T, T) tensors STORED in
+        # dot_dtype; exp/normalizer math in f32
+        s = s.astype(dot_dtype)
+        m = jax.lax.stop_gradient(
+            s.max(axis=-1, keepdims=True).astype(jnp.float32))
+        e = jnp.exp(s.astype(jnp.float32) - m)
+        p = (e / e.sum(axis=-1, keepdims=True)).astype(dot_dtype)
+    else:
+        p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v,
+                     preferred_element_type=jnp.float32)
+    return out
 
 
 def local_attention_blocked(q, k, v, causal: bool = False,
-                            block_k: int = 512):
-    """Single-device FLASH-style attention: scan over K/V blocks with
-    the same online-softmax fold the ring uses, so the full (T, T)
-    score matrix never materializes in HBM — per scan step only a
-    (B, H, Tq, block_k) tile exists.  EXPLICIT opt-in via
-    ``MultiHeadAttention(flash_block_k=...)``: while (T, T) fits HBM
-    the plain fused form is FASTER (measured: 885k vs 587k tokens/s
-    at T=2048 — SEQ_BENCH.json), so this path is for the regime where
-    the plain form cannot run at all (T=8192 needs 24.2 G of 15.75 G
-    HBM on v5e; blocked runs T=16k+ on one chip).
+                            block_k: int = 512, dot_dtype=None):
+    """Single-device FLASH-style attention as a plain-XLA ``lax.scan``
+    over K/V blocks with the same online-softmax fold the ring uses,
+    so the full (T, T) score matrix never materializes in HBM — per
+    scan step only a (B, H, Tq, block_k) tile exists.
+
+    Since round 5 this is the portable FALLBACK, not the production
+    path: on TPU the fused Pallas kernel
+    (:func:`znicz_tpu.ops.pallas_attention.flash_attention`) is the
+    measured winner at every T (SEQ_BENCH.json / PERF.md round 5) and
+    is the unit's default.  The scan form remains for platforms
+    without Pallas and as the shard_map-compatible fold the ring path
+    shares; while (T, T) fits HBM the plain fused form beats this
+    scan (the carry round-trips dominate — measured round 4), so the
+    scan is only selected explicitly via
+    ``MultiHeadAttention(flash_block_k=...)`` on non-TPU backends.
 
     Exact same math as :func:`local_attention` (tested equal, fwd and
     vjp); ``jax.checkpoint`` on the fold keeps the backward from
@@ -96,7 +126,8 @@ def local_attention_blocked(q, k, v, causal: bool = False,
             tq, block_k,
             *((q_pos, i * block_k + jnp.arange(block_k)) if causal
               else (None, None)))
-        return _fold_block(carry, qh, k_blk, v_blk, mask), None
+        return _fold_block(carry, qh, k_blk, v_blk, mask,
+                           dot_dtype=dot_dtype), None
 
     (m, denom, acc), _ = jax.lax.scan(
         fold, (m0, denom0, acc0),
@@ -105,11 +136,18 @@ def local_attention_blocked(q, k, v, causal: bool = False,
     return out.transpose(0, 2, 1, 3).astype(q.dtype)
 
 
-def _fold_block(carry, q, k_blk, v_blk, s_mask):
-    """Online-softmax fold of one K/V block into (m, denom, acc)."""
+def _fold_block(carry, q, k_blk, v_blk, s_mask, dot_dtype=None):
+    """Online-softmax fold of one K/V block into (m, denom, acc).
+
+    ``dot_dtype`` casts the two tile GEMMs' operands (scores stay f32
+    via ``preferred_element_type``; the running statistics are always
+    f32 — same convention as :func:`local_attention`)."""
     m, denom, acc = carry
     d = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk) / np.sqrt(d)
+    if dot_dtype is not None:
+        q, k_blk = q.astype(dot_dtype), k_blk.astype(dot_dtype)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_blk,
+                   preferred_element_type=jnp.float32) / np.sqrt(d)
     s = jnp.where(s_mask, s, _NEG_INF)
     m_blk = s.max(axis=-1)
     m_new = jnp.maximum(m, m_blk)
@@ -117,29 +155,72 @@ def _fold_block(carry, q, k_blk, v_blk, s_mask):
     p = jnp.exp(s - m_new[..., None])
     p = jnp.where(s_mask, p, 0.0)
     correction = jnp.exp(m - m_new)
+    if dot_dtype is not None:
+        p, v_blk = p.astype(dot_dtype), v_blk.astype(dot_dtype)
     acc = acc * correction[..., None] \
-        + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk)
-    denom = denom * correction + p.sum(axis=-1)
+        + jnp.einsum("bhqk,bkhd->bhqd", p, v_blk,
+                     preferred_element_type=jnp.float32)
+    denom = (denom * correction
+             + p.astype(jnp.float32).sum(axis=-1))
     return m_new, denom, acc
 
 
 def ring_attention_block(q, k, v, axis_name: str = SEQ_AXIS,
-                         causal: bool = False):
+                         causal: bool = False, dot_dtype=None,
+                         block_k: int | None = None):
     """The per-device body (call under ``shard_map``): q/k/v are THIS
-    device's sequence shards; K/V rotate the full ring."""
+    device's sequence shards; K/V rotate the full ring.
+
+    ``block_k`` composes the flash-style K/V-block fold INTO each ring
+    step: the arriving (tq × tk_local) tile is folded sub-block by
+    sub-block under ``jax.checkpoint``, so a device never materializes
+    even its per-step local score tile — the single-chip
+    ``local_attention_blocked`` memory behavior, per ring hop.
+    Without it, large per-device T_local hits the same (tq, tk) HBM
+    wall on every hop that the blocked form was built to remove
+    (round-4 verdict item 6)."""
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, tq, h, dim = q.shape
     tk = k.shape[1]
+    # block_k >= T_local degrades to the whole-tile fold below — only
+    # a PARTIAL blocking that doesn't tile evenly is an error
+    if block_k is not None and block_k < tk and tk % block_k:
+        raise ValueError(f"T_local {tk} not divisible by "
+                         f"block_k {block_k}")
     q_pos = my_idx * tq + jnp.arange(tq)            # global positions
 
-    def block_mask(src):
-        """Visibility of the K block that originated on device
-        ``src``."""
-        return _visibility(
-            tq, tk,
-            *((q_pos, src * tk + jnp.arange(tk)) if causal
-              else (None, None)))
+    def fold_tile(state, k_t, v_t, src):
+        """Fold the whole K/V tile that originated on device ``src``
+        — one `_fold_block` when ``block_k`` is off, a checkpointed
+        sub-block scan when on."""
+        k_pos0 = src * tk
+        if block_k is None or block_k >= tk:
+            mask = _visibility(
+                tq, tk,
+                *((q_pos, k_pos0 + jnp.arange(tk)) if causal
+                  else (None, None)))
+            return _fold_block(state, q, k_t, v_t, mask,
+                               dot_dtype=dot_dtype)
+        nb = tk // block_k
+        k_sub = jnp.moveaxis(
+            k_t.reshape(b, nb, block_k, h, dim), 1, 0)
+        v_sub = jnp.moveaxis(
+            v_t.reshape(b, nb, block_k, h, dim), 1, 0)
+
+        @functools.partial(jax.checkpoint, prevent_cse=False)
+        def sub_fold(carry, blk):
+            i, kk, vv = blk
+            mask = _visibility(
+                tq, block_k,
+                *((q_pos, k_pos0 + i * block_k + jnp.arange(block_k))
+                  if causal else (None, None)))
+            return _fold_block(carry, q, kk, vv, mask,
+                               dot_dtype=dot_dtype), None
+
+        state, _ = jax.lax.scan(sub_fold, state,
+                                (jnp.arange(nb), k_sub, v_sub))
+        return state
 
     # accumulators: derived from q so they carry its sharded/varying
     # type under shard_map, but cast to f32 — attention statistics
@@ -149,7 +230,7 @@ def ring_attention_block(q, k, v, axis_name: str = SEQ_AXIS,
     state = (zero4[..., 0] + _NEG_INF, zero4[..., 0], zero4)
     # fold the local block first, then rotate-then-fold — the final
     # iteration folds without a trailing (wasted) ppermute
-    state = _fold_block(state, q, k, v, block_mask(my_idx))
+    state = fold_tile(state, k, v, my_idx)
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
     def step(i, loop_state):
@@ -157,8 +238,7 @@ def ring_attention_block(q, k, v, axis_name: str = SEQ_AXIS,
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
         src = (my_idx - i) % axis_size   # origin of the arriving block
-        m, denom, acc = _fold_block((m, denom, acc), q, k_cur, v_cur,
-                                    block_mask(src))
+        m, denom, acc = fold_tile((m, denom, acc), k_cur, v_cur, src)
         return m, denom, acc, k_cur, v_cur
 
     m, denom, acc, _, _ = jax.lax.fori_loop(
@@ -169,7 +249,9 @@ def ring_attention_block(q, k, v, axis_name: str = SEQ_AXIS,
 
 
 def sequence_sharded_attention(mesh, q, k, v, causal: bool = False,
-                               axis_name: str = SEQ_AXIS):
+                               axis_name: str = SEQ_AXIS,
+                               dot_dtype=None,
+                               block_k: int | None = None):
     """Shard the time axis of q/k/v over ``mesh[axis_name]`` and run
     ring attention; returns output with the same sharding as q.
 
@@ -192,7 +274,8 @@ def sequence_sharded_attention(mesh, q, k, v, causal: bool = False,
     spec = P(batch_axis, axis_name, None, None)
     fn = shard_map(
         functools.partial(ring_attention_block, axis_name=axis_name,
-                          causal=causal),
+                          causal=causal, dot_dtype=dot_dtype,
+                          block_k=block_k),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
