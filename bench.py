@@ -79,6 +79,11 @@ WGRAD_IM2COL = os.environ.get("BENCH_WGRAD_IM2COL", "0") != "0"
 #: BENCH_LRN_BAND_BF16=1: bf16 operands into the LRN band GEMMs (A/B
 #: lever for the bandwidth-bound band adjoints, PERF.md round 4)
 LRN_BAND_BF16 = os.environ.get("BENCH_LRN_BAND_BF16", "0") != "0"
+#: BENCH_LRN_D_BF16: bf16 STORAGE for the shared LRN denominator
+#: tensors (~1.5 GB/step of f32 traffic at b384 — PERF.md round 5,
+#: measured +5.4%).  Unset = the engine's auto default (on in bf16
+#: mode); 0/1 forces the A/B arm.
+LRN_D_BF16 = os.environ.get("BENCH_LRN_D_BF16", "")
 TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT_S", "900"))
 #: default ON: every bench run leaves a local trace of the timed loop
 #: (~3 MB; ~1-2% overhead) — perf numbers should never be
@@ -238,6 +243,8 @@ def main() -> None:
     root.common.engine.space_to_depth = S2D
     root.common.engine.conv_wgrad_im2col = WGRAD_IM2COL
     root.common.engine.lrn_band_bf16 = LRN_BAND_BF16
+    if LRN_D_BF16:
+        root.common.engine.lrn_d_bf16 = LRN_D_BF16 != "0"
 
     # dataset sized a whole number of chunks per epoch so a scanned
     # chunk never spans the epoch-boundary reshuffle (ceil to a

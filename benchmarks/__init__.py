@@ -1,0 +1,1 @@
+"""Chip-measured evidence harnesses (bench/convergence artifacts)."""

@@ -157,26 +157,11 @@ def main() -> None:
                      f"(initial {err_initial}, best {err_final_f32} "
                      f"of {n_valid}); resize the task"}), flush=True)
         sys.exit(2)
+    from benchmarks.convergence_common import one_sided_band
+
     def bands(arm: dict) -> dict:
-        """One-sided band vs the f32 baseline: the arm must recover
-        ≥70% of the f32 loss drop / error drop and may trail f32's
-        final by at most 30% of that drop; ENDING LOWER than f32 is a
-        pass, not a deviation.  Applied to BOTH the train-CE curve
-        and the best validation error count (the north star's top-1
-        framing, BASELINE.md)."""
-        final = arm["loss"][-1]
-        gap = final - final_f32  # positive = arm worse
-        loss_ok = ((initial - final) >= 0.7 * drop
-                   and gap <= 0.3 * drop)
-        err_final = min(arm["valid_n_err"])
-        err_gap = err_final - err_final_f32
-        err_ok = ((err_initial - err_final) >= 0.7 * err_drop
-                  and err_gap <= 0.3 * err_drop)
-        return {"loss_final": final, "gap": gap,
-                "loss_band_ok": bool(loss_ok),
-                "valid_err_best": err_final, "valid_err_gap": err_gap,
-                "err_band_ok": bool(err_ok),
-                "band_ok": bool(loss_ok and err_ok)}
+        return one_sided_band(initial, final_f32, err_initial,
+                              err_final_f32, arm)
 
     # arm 2: the headline mixed-precision mode (f32 optimizer state)
     bf16 = train_curve("bfloat16", bf16_opt_state=False)

@@ -1,0 +1,34 @@
+"""THE one-sided convergence band — single home of the acceptance
+rule every precision-convergence artifact judges by
+(BF16_CONVERGENCE.json, SEQ_CONVERGENCE.json).
+
+An arm passes against the f32 baseline when it recovers ≥70% of the
+f32 drop AND trails the f32 final by ≤30% of that drop — on BOTH the
+train-CE curve and the best validation error count (the accuracy-
+shaped metric the north star is phrased in, BASELINE.md).  Ending
+better than f32 is a pass, not a deviation.
+"""
+
+from __future__ import annotations
+
+
+def one_sided_band(initial: float, final_f32: float,
+                   err_initial: float, err_final_f32: float,
+                   arm: dict) -> dict:
+    """Judge ``arm`` ({"loss": [...], "valid_n_err": [...]}) against
+    the f32 baseline endpoints; returns the per-arm verdict dict the
+    artifacts embed."""
+    drop = initial - final_f32
+    err_drop = err_initial - err_final_f32
+    final = arm["loss"][-1]
+    gap = final - final_f32              # positive = arm worse
+    loss_ok = (initial - final) >= 0.7 * drop and gap <= 0.3 * drop
+    err_final = min(arm["valid_n_err"])
+    err_gap = err_final - err_final_f32
+    err_ok = ((err_initial - err_final) >= 0.7 * err_drop
+              and err_gap <= 0.3 * err_drop)
+    return {"loss_final": final, "gap": gap,
+            "loss_band_ok": bool(loss_ok),
+            "valid_err_best": err_final, "valid_err_gap": err_gap,
+            "err_band_ok": bool(err_ok),
+            "band_ok": bool(loss_ok and err_ok)}

@@ -120,7 +120,6 @@ def main() -> None:
     n_valid = VALID_STEPS * BATCH
     err_initial = f32["valid_n_err"][0]
     err_final_f32 = min(f32["valid_n_err"])
-    err_drop = err_initial - err_final_f32
     if err_final_f32 == 0 or err_initial < 0.5 * n_valid:
         print(json.dumps({"error": "validation curve degenerate "
                           f"(initial {err_initial}, best "
@@ -128,15 +127,14 @@ def main() -> None:
               flush=True)
         sys.exit(2)
     bf16 = train_curve("bfloat16")
-    final_bf16 = bf16["loss"][-1]
-    gap = final_bf16 - final_f32
-    loss_ok = (initial - final_bf16) >= 0.7 * drop \
-        and gap <= 0.3 * drop
-    err_final_bf16 = min(bf16["valid_n_err"])
-    err_gap = err_final_bf16 - err_final_f32
-    err_ok = ((err_initial - err_final_bf16) >= 0.7 * err_drop
-              and err_gap <= 0.3 * err_drop)
-    ok = loss_ok and err_ok
+    from benchmarks.convergence_common import one_sided_band
+    verdict = one_sided_band(initial, final_f32, err_initial,
+                             err_final_f32, bf16)
+    final_bf16, gap = verdict["loss_final"], verdict["gap"]
+    loss_ok, err_ok = verdict["loss_band_ok"], verdict["err_band_ok"]
+    err_final_bf16 = verdict["valid_err_best"]
+    err_gap = verdict["valid_err_gap"]
+    ok = verdict["band_ok"]
     artifact = {
         "model": "pos_encoding+attention+layer_norm+softmax",
         "seq_len": SEQ_LEN, "dim": DIM, "heads": HEADS,

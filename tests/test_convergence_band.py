@@ -1,0 +1,40 @@
+"""The shared one-sided convergence band (benchmarks/
+convergence_common.py) — the single acceptance rule both precision
+artifacts judge by."""
+
+from benchmarks.convergence_common import one_sided_band
+
+
+def _arm(loss_final, err_best):
+    return {"loss": [5.0, loss_final], "valid_n_err": [100, err_best]}
+
+
+def test_equal_to_f32_passes():
+    v = one_sided_band(5.0, 2.0, 100, 40, _arm(2.0, 40))
+    assert v["band_ok"] and v["gap"] == 0.0
+
+
+def test_better_than_f32_is_a_pass_not_a_deviation():
+    v = one_sided_band(5.0, 2.0, 100, 40, _arm(1.5, 30))
+    assert v["band_ok"] and v["gap"] < 0 and v["valid_err_gap"] < 0
+
+
+def test_trailing_within_30pct_of_drop_passes():
+    # f32 drop = 3.0 → gap 0.9 allowed; err drop = 60 → gap 18 allowed
+    v = one_sided_band(5.0, 2.0, 100, 40, _arm(2.9, 58))
+    assert v["band_ok"]
+
+
+def test_trailing_beyond_band_fails_each_metric_independently():
+    v = one_sided_band(5.0, 2.0, 100, 40, _arm(3.1, 40))
+    assert not v["loss_band_ok"] and v["err_band_ok"]
+    assert not v["band_ok"]
+    v = one_sided_band(5.0, 2.0, 100, 40, _arm(2.0, 59))
+    assert v["loss_band_ok"] and not v["err_band_ok"]
+    assert not v["band_ok"]
+
+
+def test_insufficient_recovery_fails():
+    # recovers only 2.0 of the 3.0 f32 drop (< 70%)
+    v = one_sided_band(5.0, 2.0, 100, 40, _arm(3.0, 40))
+    assert not v["loss_band_ok"]

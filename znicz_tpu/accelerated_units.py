@@ -281,6 +281,12 @@ class JitRegion(Logger):
             finally:
                 for vec in vectors:
                     vec._tracing = False
+                for unit in units:
+                    # drop any intra-trace pullback stash a forward
+                    # left for a (possibly gate-skipped) GD pair —
+                    # escaped tracers must not outlive the trace
+                    if getattr(unit, "_traced_vjp", None) is not None:
+                        unit._traced_vjp = None
 
         return fn
 
@@ -333,6 +339,11 @@ class JitRegion(Logger):
             invariant = tuple(
                 ov is iv for ov, iv in zip(jaxpr.jaxpr.outvars,
                                            jaxpr.jaxpr.invars))
+            # the probe jaxpr IS the step body — reuse it so the
+            # region is traced once, not once per analysis + once
+            # per jit
+            from jax.extend import core as jex_core
+            body = jex_core.jaxpr_as_fun(jaxpr)
 
             def chunk_fn(*leaves):
                 ro = [l for l, inv in zip(leaves, invariant) if inv]

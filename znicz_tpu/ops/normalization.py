@@ -105,6 +105,30 @@ def _pow_neg_beta(xp, d, beta: float):
     return d ** (-beta)
 
 
+def _store_d(xp, d):
+    """STORAGE cast for the LRN denominator tensor.
+
+    The round-5 profile (profiles/bench_default) shows the four LRN
+    band fusions at 27% of the AlexNet step, dominated by the f32
+    ``d`` tensors XLA materializes and shares between forward and
+    backward (446 MB + 287 MB at batch 384, written once, read once
+    ≈ 1.5 GB/step at the bandwidth roof).  ``engine.lrn_d_bf16``
+    stores them bf16 (the upcast fuses in-register): d = k + α·Σx²
+    with k = 2 dominating, so bf16 rounding perturbs y by ≲ β·2⁻⁹ —
+    the same order as the (already convergence-validated) bf16
+    activation storage.  A/B lever; default follows the PERF.md
+    round-5 measurement + BF16_CONVERGENCE band."""
+    if xp is not jnp:
+        return d
+    from znicz_tpu.utils.config import root
+    flag = root.common.engine.get("lrn_d_bf16", None)
+    if flag is None:  # auto: ride the configured mixed-precision mode
+        flag = str(root.common.precision_type) == "bfloat16"
+    if not flag:
+        return d
+    return d.astype(jnp.bfloat16).astype(jnp.float32)
+
+
 class LRNormalizerForward(Forward):
     """Across-channel LRN (weightless forward)."""
 
@@ -129,6 +153,7 @@ class LRNormalizerForward(Forward):
 
     def _forward(self, xp, x):
         d = self.k + self.alpha * _window_sum(xp, x * x, self.n)
+        d = _store_d(xp, d)
         return x * _pow_neg_beta(xp, d, self.beta)
 
     def numpy_run(self) -> None:
@@ -199,6 +224,8 @@ class LRNormalizerBackward(GradientDescentBase):
                 x, err, fwd.alpha, fwd.beta, fwd.k, fwd.n)
             return
         d = fwd.k + fwd.alpha * _window_sum(jnp, x * x, fwd.n)
+        d = _store_d(jnp, d)  # identical expression to the forward's
+        # — XLA CSE shares ONE materialized d between fwd and bwd
         p = _pow_neg_beta(jnp, d, fwd.beta)
         t = err * x * (p / d)  # d^{−β−1} without a second pow
         self.err_input.devmem = (
