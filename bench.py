@@ -41,9 +41,11 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-#: batch 384 is the measured sweet spot on v5e with bf16 activation
-#: storage (sweep in PERF.md: 256→0.327, 384→0.331, 512→0.320)
-BATCH = int(os.environ.get("BENCH_BATCH", "384"))
+#: batch 768 is the round-5 measured sweet spot on v5e — the bf16
+#: LRN-denominator + optimizer-state changes shifted the balance
+#: upward from round 3's 384 (sweep in PERF.md round 5: 256→0.493,
+#: 384→0.495-0.502, 512→0.488-0.510, 768→0.499-0.513, 1024→0.505)
+BATCH = int(os.environ.get("BENCH_BATCH", "768"))
 INPUT_MODE = os.environ.get("BENCH_INPUT", "resident")  # resident|stream
 #: steps per device dispatch (lax.scan chunk; device-resident schedule).
 #: 1 = per-step dispatch (round-2 behavior).  Streaming input is

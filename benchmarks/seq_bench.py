@@ -48,6 +48,8 @@ FLASH = int(os.environ.get("SEQ_FLASH", "0"))  # 0 = plain local core
 #: unset = the unit's default (ON for TPU, the measured winner);
 #: 0 = force the XLA cores; 1 = force the kernel
 PALLAS_ENV = os.environ.get("SEQ_PALLAS", "")
+#: SEQ_PALLAS_LN: same A/B lever for the fused Pallas layer norm
+PALLAS_LN_ENV = os.environ.get("SEQ_PALLAS_LN", "")
 #: steps per device dispatch (lax.scan chunk — the framework's real
 #: training loop shape, same as bench.py's BENCH_CHUNK; through this
 #: environment's tunnel a Pallas program pays a large PER-DISPATCH
@@ -122,6 +124,8 @@ def main() -> None:
                                                 "bfloat16")
     if PALLAS_ENV:
         root.common.engine.flash_attention = PALLAS_ENV != "0"
+    if PALLAS_LN_ENV:
+        root.common.engine.pallas_layer_norm = PALLAS_LN_ENV != "0"
     prng.seed_all(11)
     wf = build()
     import jax.numpy as jnp

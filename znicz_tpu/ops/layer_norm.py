@@ -43,6 +43,28 @@ class LayerNorm(Forward):
         self.output.reset(np.zeros(self.input.shape,
                                    dtype=self.output_store_dtype))
         self.inherit_model_shard(self.output)
+        # fused Pallas layer norm (one VMEM pass vs the XLA
+        # composition's materialized xhat + f32 upcasts): default ON
+        # for real TPU devices per the round-5 in-graph A/B (PERF.md);
+        # opt out with engine.pallas_layer_norm = False.  Sharded
+        # inputs keep the XLA path (pallas_call under GSPMD would
+        # gather).
+        from znicz_tpu.ops import pallas_kernels
+        from znicz_tpu.utils.config import root
+        flag = root.common.engine.get("pallas_layer_norm", "auto")
+        if flag == "auto":
+            flag = pallas_kernels.is_tpu_device(self.device)
+        # sharded inputs keep the XLA path: a pallas_call has no
+        # sharding rule, so under GSPMD it would gather the operand
+        # onto one replica — that covers BOTH model-sharded inputs
+        # and the batch-major data-axis sharding any multi-device
+        # mesh applies
+        mesh = getattr(self.device, "mesh", None)
+        multi_device = mesh is not None and mesh.size > 1
+        self._pallas_ln = (
+            bool(flag) and pallas_kernels.is_tpu_device(self.device)
+            and not multi_device
+            and getattr(self.input, "model_shard_dim", None) is None)
         self.init_vectors(self.input, self.output, self.weights,
                           self.bias)
 
@@ -72,8 +94,13 @@ class LayerNorm(Forward):
         self.output.mem[...] = y
 
     def xla_run(self) -> None:
-        x = self.input.devmem.astype(jnp.float32)  # f32 statistics
         beta = self.bias.devmem if self.include_bias else None
+        if getattr(self, "_pallas_ln", False):
+            from znicz_tpu.ops import pallas_kernels
+            self.output.devmem = pallas_kernels.layer_norm_forward(
+                self.input.devmem, self.weights.devmem, beta, self.eps)
+            return
+        x = self.input.devmem.astype(jnp.float32)  # f32 statistics
         y, _, _ = self._forward(jnp, x, self.weights.devmem, beta)
         self.output.devmem = y
 
@@ -133,10 +160,17 @@ class GDLayerNorm(GradientDescentBase):
 
     def xla_run(self) -> None:
         has_bias = self.bias is not None and self.bias
-        dx, grad_g, grad_b = self._backward(
-            jnp, self.input.devmem.astype(jnp.float32),
-            self.err_output.devmem.astype(jnp.float32),
-            self.weights.devmem, has_bias)
+        if getattr(self.forward_unit, "_pallas_ln", False):
+            from znicz_tpu.ops import pallas_kernels
+            dx, grad_g, grad_b = pallas_kernels.layer_norm_backward(
+                self.input.devmem, self.err_output.devmem,
+                self.weights.devmem, self.forward_unit.eps,
+                with_beta=bool(has_bias))
+        else:
+            dx, grad_g, grad_b = self._backward(
+                jnp, self.input.devmem.astype(jnp.float32),
+                self.err_output.devmem.astype(jnp.float32),
+                self.weights.devmem, has_bias)
         if self.need_err_input:
             self.err_input.devmem = dx
         self._apply_weights_xla(grad_g)

@@ -171,6 +171,136 @@ def dropout_apply(x, seed, drop_ratio: float, interpret: bool = False):
 
 
 # ----------------------------------------------------------------------
+# LayerNorm: per-row statistics + scale/shift in one VMEM pass; the
+# backward fuses dx with the cross-row γ/β grad accumulation (scratch
+# accumulators over a sequential row-tile grid) — the XLA composition
+# materializes xhat and the f32 upcasts between passes (profiled at
+# ~8% of the T=2048 seq step, PERF.md round 5)
+# ----------------------------------------------------------------------
+def _ln_fwd_kernel(x_ref, g_ref, b_ref, o_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    mu = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    y = xc * jax.lax.rsqrt(var + eps) * g_ref[...] + b_ref[...]
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def _ln_bwd_kernel(*refs, eps, m, tile, has_beta):
+    if has_beta:
+        (x_ref, e_ref, g_ref, dx_ref, gg_ref, gb_ref,
+         gg_scr, gb_scr) = refs
+    else:  # β-less layer norm: no grad_beta output/accumulator
+        x_ref, e_ref, g_ref, dx_ref, gg_ref, gg_scr = refs
+        gb_ref = gb_scr = None
+    i = pl.program_id(0)
+    n = pl.num_programs(0)
+
+    @pl.when(i == 0)
+    def _init():
+        gg_scr[...] = jnp.zeros_like(gg_scr)
+        if has_beta:
+            gb_scr[...] = jnp.zeros_like(gb_scr)
+
+    x = x_ref[...].astype(jnp.float32)
+    err = e_ref[...].astype(jnp.float32)
+    # tail tile: rows beyond m are UNDEFINED padding — zero BOTH
+    # operands so the cross-row grad sums stay clean (masked err
+    # alone wouldn't neutralize a non-finite x̂ from garbage x:
+    # 0·NaN = NaN would poison the accumulators); per-row dx for
+    # padded rows is garbage-in-garbage-out and its stores land out
+    # of bounds, which Pallas drops
+    rows = i * tile + jax.lax.broadcasted_iota(
+        jnp.int32, err.shape, 0)
+    valid = rows < m
+    err = jnp.where(valid, err, 0.0)
+    x = jnp.where(valid, x, 0.0)
+    mu = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = xc * rstd
+    dxhat = err * g_ref[...]
+    dx = (dxhat - jnp.mean(dxhat, axis=1, keepdims=True)
+          - xhat * jnp.mean(dxhat * xhat, axis=1, keepdims=True)) \
+        * rstd
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+    gg_scr[...] += jnp.sum(err * xhat, axis=0, keepdims=True)
+    if has_beta:
+        gb_scr[...] += jnp.sum(err, axis=0, keepdims=True)
+
+    @pl.when(i == n - 1)
+    def _finish():
+        gg_ref[...] = gg_scr[...]
+        if has_beta:
+            gb_ref[...] = gb_scr[...]
+
+
+def layer_norm_forward(x, gamma, beta, eps: float,
+                       interpret: bool = False):
+    """Fused layer norm over (..., D): f32 statistics in VMEM, output
+    stored at the input dtype.  ``beta`` may be None (no-shift)."""
+    shape = x.shape
+    x2d = x.reshape(-1, shape[-1])
+    m, d = x2d.shape
+    if beta is None:
+        beta = jnp.zeros((), jnp.float32)
+    tile = min(_TILE_ROWS, m)
+    spec = pl.BlockSpec((tile, d), lambda i: (i, 0))
+    pspec = pl.BlockSpec((1, d), lambda i: (0, 0))
+    out = pl.pallas_call(
+        functools.partial(_ln_fwd_kernel, eps=eps),
+        grid=(pl.cdiv(m, tile),),
+        in_specs=[spec, pspec, pspec],
+        out_specs=spec,
+        out_shape=jax.ShapeDtypeStruct((m, d), x.dtype),
+        interpret=interpret,
+    )(x2d, gamma.reshape(1, d).astype(jnp.float32),
+      jnp.broadcast_to(beta, (1, d)).astype(jnp.float32))
+    return out.reshape(shape)
+
+
+def layer_norm_backward(x, err, gamma, eps: float,
+                        with_beta: bool = True,
+                        interpret: bool = False):
+    """Fused layer-norm backward: per-row dx plus the cross-row γ (and
+    β when ``with_beta``) gradient sums, one pass.  Returns
+    (dx, grad_gamma, grad_beta-or-None) with the grads in f32 shape
+    (D,)."""
+    shape = x.shape
+    d = shape[-1]
+    from jax.experimental.pallas import tpu as pltpu
+
+    x2d = x.reshape(-1, d)
+    e2d = err.reshape(-1, d)
+    m = x2d.shape[0]
+    tile = min(_TILE_ROWS, m)
+    spec = pl.BlockSpec((tile, d), lambda i: (i, 0))
+    pspec = pl.BlockSpec((1, d), lambda i: (0, 0))
+    out_specs = [spec, pspec] + ([pspec] if with_beta else [])
+    out_shape = [jax.ShapeDtypeStruct((m, d), err.dtype),
+                 jax.ShapeDtypeStruct((1, d), jnp.float32)] \
+        + ([jax.ShapeDtypeStruct((1, d), jnp.float32)]
+           if with_beta else [])
+    scratch = [pltpu.VMEM((1, d), jnp.float32)
+               for _ in range(2 if with_beta else 1)]
+    out = pl.pallas_call(
+        functools.partial(_ln_bwd_kernel, eps=eps, m=m, tile=tile,
+                          has_beta=with_beta),
+        grid=(pl.cdiv(m, tile),),
+        in_specs=[spec, spec, pspec],
+        out_specs=tuple(out_specs),
+        out_shape=tuple(out_shape),
+        scratch_shapes=scratch,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(x2d, e2d, gamma.reshape(1, d).astype(jnp.float32))
+    gb = out[2][0] if with_beta else None
+    return out[0].reshape(shape), out[1][0], gb
+
+
+# ----------------------------------------------------------------------
 # Softmax (+ argmax): one row pass — max, exp, sum, divide, argmax
 # fused in VMEM (candidate; the XLA composition is 3-4 HBM passes)
 # ----------------------------------------------------------------------
