@@ -50,6 +50,9 @@ FLASH = int(os.environ.get("SEQ_FLASH", "0"))  # 0 = plain local core
 PALLAS_ENV = os.environ.get("SEQ_PALLAS", "")
 #: SEQ_PALLAS_LN: same A/B lever for the fused Pallas layer norm
 PALLAS_LN_ENV = os.environ.get("SEQ_PALLAS_LN", "")
+#: SEQ_CAUSAL=1: causal attention (the flash kernel skips
+#: fully-masked tiles via pl.when — ~half the tile work)
+CAUSAL = os.environ.get("SEQ_CAUSAL", "0") != "0"
 #: steps per device dispatch (lax.scan chunk — the framework's real
 #: training loop shape, same as bench.py's BENCH_CHUNK; through this
 #: environment's tunnel a Pallas program pays a large PER-DISPATCH
@@ -91,7 +94,7 @@ def build():
             w, train_data=x, train_labels=y, minibatch_size=BATCH),
         layers=[
             {"type": "attention",
-             "->": {"n_heads": HEADS,
+             "->": {"n_heads": HEADS, "causal": CAUSAL,
                     "flash_block_k": FLASH or None}, "<-": gd},
             {"type": "layer_norm", "->": {}, "<-": gd},
             {"type": "softmax", "->": {"output_sample_shape": 8},
@@ -109,6 +112,8 @@ def attn_train_flops() -> float:
     ((T·D) × 8 GEMM)."""
     proj = 4 * 2.0 * BATCH * SEQ_LEN * DIM * DIM
     scores = 2 * 2.0 * BATCH * HEADS * SEQ_LEN * SEQ_LEN * (DIM // HEADS)
+    if CAUSAL:
+        scores *= 0.5  # only the lower triangle is model work
     head = 2.0 * BATCH * SEQ_LEN * DIM * 8
     return 3.0 * (proj + scores + head)
 
@@ -176,6 +181,7 @@ def main() -> None:
         "batch": BATCH, "seq_len": SEQ_LEN, "dim": DIM,
         "heads": HEADS, "flash_block_k": FLASH or None,
         "pallas": wf.forwards[0]._flash_pallas, "chunk": CHUNK,
+        "causal": CAUSAL,
         "step_time_ms": round(dt * 1e3, 3),
         "mfu": round(mfu, 4),
         "precision": str(root.common.precision_type),
