@@ -87,3 +87,4 @@ def test_unit_engages_flash_only_on_tpu(monkeypatch):
     FakeDev.platform = "cpu"
     FakeDev.device_kind = "TPU v5 lite"
     assert pallas_kernels.is_tpu_device(D())
+

@@ -207,6 +207,12 @@ class MultiHeadAttention(Forward):
                 block_k=self.flash_block_k)
         elif getattr(self, "_flash_pallas", False):
             from znicz_tpu.ops import pallas_attention
+            # (a head-major fast path — contracting the kernel's
+            # native (B, H, T, Dh) output directly with a reshaped
+            # W_out to skip the boundary transposes — was measured
+            # NEUTRAL within the ±2–4% run band and reverted per the
+            # decision rule: neutral keeps the simpler path.  PERF.md
+            # round 5.)
             o = pallas_attention.flash_attention(
                 q, k, v, causal=self.causal,
                 block_k=self.flash_block_k or pallas_attention.BLOCK_K,
