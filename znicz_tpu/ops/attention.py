@@ -162,17 +162,48 @@ class MultiHeadAttention(Forward):
         # jnp fold (it runs under shard_map across devices); shapes
         # the kernel's tiling cannot cover fall back to the XLA cores.
         from znicz_tpu.ops import pallas_attention, pallas_kernels
+        from znicz_tpu.parallel.mesh import kernel_shard_spec, \
+            spec_divides
         from znicz_tpu.utils.config import root
         flag = root.common.engine.get("flash_attention", "auto")
         if flag == "auto":
             flag = pallas_kernels.is_tpu_device(self.device)
+        # interpret-mode lever: lets the virtual CPU mesh run the REAL
+        # kernels (shard_map oracle tests / dryruns); never default
+        interpret = bool(root.common.engine.get("pallas_interpret",
+                                                False))
         bq = min(pallas_attention.BLOCK_Q, t)
         bk = min(self.flash_block_k or pallas_attention.BLOCK_K, t)
-        self._flash_pallas = (
+        dh = d // self.n_heads
+        engaged = (
             bool(flag)
-            and pallas_kernels.is_tpu_device(self.device)
+            and (pallas_kernels.is_tpu_device(self.device) or interpret)
             and not self._ring_active
-            and t % bq == 0 and t % bk == 0 and t % 8 == 0)
+            # T must tile evenly and the head dim must be lane-legal
+            # (dh % 8 — e.g. dh=1 via a to_sequence net would crash
+            # Mosaic at trace instead of falling back; ADVICE round 5)
+            and t % bq == 0 and t % bk == 0 and t % 8 == 0
+            and dh % 8 == 0)
+        self._flash_interpret = interpret
+        self._flash_mesh = None
+        self._flash_spec = None
+        if engaged and mesh is not None and mesh.size > 1:
+            # mesh-native path: the opaque pallas_call has no GSPMD
+            # sharding rule — un-shard_mapped on a multi-device mesh
+            # it would replicate-and-gather the batch-sharded operands
+            # onto every device.  Run it per-shard under shard_map
+            # with the batch riding the data axis instead;
+            # ``engine.pallas_shard_map = False`` restores the
+            # conservative single-device gate (kernel off on meshes —
+            # the safe fallback, mirroring _pallas_ln's old guard).
+            spec, _ = kernel_shard_spec(mesh, 4)
+            engaged = (
+                bool(root.common.engine.get("pallas_shard_map", True))
+                and getattr(self.input, "model_shard_dim", None) is None
+                and spec_divides(mesh, (b, t, self.n_heads, dh), spec))
+            if engaged:
+                self._flash_mesh, self._flash_spec = mesh, spec
+        self._flash_pallas = engaged
         self.init_vectors(self.input, self.output, self.weights,
                           self.bias, self.weights_out, self.bias_out)
 
@@ -216,7 +247,10 @@ class MultiHeadAttention(Forward):
             o = pallas_attention.flash_attention(
                 q, k, v, causal=self.causal,
                 block_k=self.flash_block_k or pallas_attention.BLOCK_K,
-                dot_dtype=dot_dtype)
+                dot_dtype=dot_dtype,
+                interpret=getattr(self, "_flash_interpret", False),
+                mesh=getattr(self, "_flash_mesh", None),
+                spec=getattr(self, "_flash_spec", None))
         elif self.flash_block_k:
             from znicz_tpu.parallel.ring_attention import \
                 local_attention_blocked

@@ -46,25 +46,47 @@ class LayerNorm(Forward):
         # fused Pallas layer norm (one VMEM pass vs the XLA
         # composition's materialized xhat + f32 upcasts): default ON
         # for real TPU devices per the round-5 in-graph A/B (PERF.md);
-        # opt out with engine.pallas_layer_norm = False.  Sharded
-        # inputs keep the XLA path (pallas_call under GSPMD would
-        # gather).
+        # opt out with engine.pallas_layer_norm = False.
         from znicz_tpu.ops import pallas_kernels
+        from znicz_tpu.parallel.mesh import kernel_shard_spec, \
+            spec_divides
         from znicz_tpu.utils.config import root
         flag = root.common.engine.get("pallas_layer_norm", "auto")
         if flag == "auto":
             flag = pallas_kernels.is_tpu_device(self.device)
-        # sharded inputs keep the XLA path: a pallas_call has no
-        # sharding rule, so under GSPMD it would gather the operand
-        # onto one replica — that covers BOTH model-sharded inputs
-        # and the batch-major data-axis sharding any multi-device
-        # mesh applies
+        interpret = bool(root.common.engine.get("pallas_interpret",
+                                                False))
         mesh = getattr(self.device, "mesh", None)
         multi_device = mesh is not None and mesh.size > 1
-        self._pallas_ln = (
-            bool(flag) and pallas_kernels.is_tpu_device(self.device)
-            and not multi_device
-            and getattr(self.input, "model_shard_dim", None) is None)
+        engaged = bool(flag) and (
+            pallas_kernels.is_tpu_device(self.device) or interpret)
+        self._ln_interpret = interpret
+        self._ln_mesh = None
+        self._ln_spec = None
+        msd = getattr(self.input, "model_shard_dim", None)
+        ndim = len(self.input.shape)
+        if engaged and multi_device:
+            # mesh-native path: a pallas_call has no GSPMD sharding
+            # rule — un-shard_mapped it would gather the sharded
+            # operand onto every device.  Run per-shard under
+            # shard_map instead: batch rides the data axis, a ring-
+            # sharded time axis (model_shard_dim) rides the model
+            # axis; γ/β grad sums psum in the backward.
+            # ``engine.pallas_shard_map = False`` restores the old
+            # conservative gate (kernel off on multi-device meshes).
+            spec, _ = kernel_shard_spec(mesh, ndim,
+                                        model_shard_dim=msd)
+            engaged = (
+                bool(root.common.engine.get("pallas_shard_map", True))
+                and msd != ndim - 1  # feature axis must stay whole
+                and spec_divides(mesh, self.input.shape, spec))
+            if engaged:
+                self._ln_mesh, self._ln_spec = mesh, spec
+        elif engaged:
+            # single device: plain kernel; a (trivially) model-sharded
+            # input keeps the XLA path as before
+            engaged = msd is None
+        self._pallas_ln = engaged
         self.init_vectors(self.input, self.output, self.weights,
                           self.bias)
 
@@ -98,7 +120,10 @@ class LayerNorm(Forward):
         if getattr(self, "_pallas_ln", False):
             from znicz_tpu.ops import pallas_kernels
             self.output.devmem = pallas_kernels.layer_norm_forward(
-                self.input.devmem, self.weights.devmem, beta, self.eps)
+                self.input.devmem, self.weights.devmem, beta, self.eps,
+                interpret=getattr(self, "_ln_interpret", False),
+                mesh=getattr(self, "_ln_mesh", None),
+                spec=getattr(self, "_ln_spec", None))
             return
         x = self.input.devmem.astype(jnp.float32)  # f32 statistics
         y, _, _ = self._forward(jnp, x, self.weights.devmem, beta)
@@ -162,10 +187,14 @@ class GDLayerNorm(GradientDescentBase):
         has_bias = self.bias is not None and self.bias
         if getattr(self.forward_unit, "_pallas_ln", False):
             from znicz_tpu.ops import pallas_kernels
+            fwd = self.forward_unit
             dx, grad_g, grad_b = pallas_kernels.layer_norm_backward(
                 self.input.devmem, self.err_output.devmem,
-                self.weights.devmem, self.forward_unit.eps,
-                with_beta=bool(has_bias))
+                self.weights.devmem, fwd.eps,
+                with_beta=bool(has_bias),
+                interpret=getattr(fwd, "_ln_interpret", False),
+                mesh=getattr(fwd, "_ln_mesh", None),
+                spec=getattr(fwd, "_ln_spec", None))
         else:
             dx, grad_g, grad_b = self._backward(
                 jnp, self.input.devmem.astype(jnp.float32),

@@ -55,6 +55,11 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+#: jax renamed ``TPUCompilerParams`` → ``CompilerParams``; accept both
+#: so the kernels run on 0.4.x and current jax alike
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 _NEG_INF = -1e30
 #: default tile sizes — chip-swept (PERF.md round 5): 1024×1024 beats
 #: 512×512 by ~1.2× (fewer grid revisits of the VMEM stats; the f32
@@ -146,7 +151,7 @@ def _fwd_call(q, k, v, causal, bq, bk, interpret):
         scratch_shapes=[pltpu.VMEM((bq, 128), jnp.float32),
                         pltpu.VMEM((bq, 128), jnp.float32),
                         pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -240,7 +245,7 @@ def _bwd_call(q, k, v, o, lse, do, causal, bq, bk, interpret):
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((b, h, t, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -261,7 +266,7 @@ def _bwd_call(q, k, v, o, lse, do, causal, bq, bk, interpret):
                    jax.ShapeDtypeStruct((b, h, tk, d), v.dtype)),
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary")),
         interpret=interpret,
@@ -294,7 +299,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention(q, k, v, causal: bool = False,
                     block_q: int = BLOCK_Q, block_k: int = BLOCK_K,
-                    dot_dtype=None, interpret: bool = False):
+                    dot_dtype=None, interpret: bool = False,
+                    mesh=None, spec=None):
     """Fused flash attention: (B, T, H, D) → (B, T, H, D) f32.
 
     ``dot_dtype`` casts q/k/v (the tile-GEMM operand dtype — bf16 in
@@ -303,6 +309,19 @@ def flash_attention(q, k, v, causal: bool = False,
     ``local_attention_blocked``).  Differentiable via the fused
     recompute backward — no (T, T) tensor ever reaches HBM in either
     direction.
+
+    ``mesh``/``spec`` is the mesh-native path: ``spec`` is a boundary-
+    layout (B, T, H, D) PartitionSpec (derive it with
+    :func:`znicz_tpu.parallel.mesh.kernel_shard_spec`) and the kernel
+    runs per-shard under ``shard_map`` — without it an opaque
+    ``pallas_call`` has no GSPMD sharding rule, so a multi-device mesh
+    would replicate-and-gather the operands onto every device.  Only
+    batch-like dims may shard (batch over ``data``; heads compose with
+    TP the same way); sharding T is the ring's job and is rejected
+    here, as is sharding the head dim.  Gradients flow through the
+    shard_map (the custom_vjp backward runs per-shard — attention is
+    independent per batch element and head, so no cross-shard
+    reduction exists).
     """
     b, t, h, d = q.shape
     tk = k.shape[1]
@@ -313,5 +332,21 @@ def flash_attention(q, k, v, causal: bool = False,
     if dot_dtype is not None:
         q, k, v = (a.astype(dot_dtype) for a in (q, k, v))
     qh, kh, vh = (a.transpose(0, 2, 1, 3) for a in (q, k, v))
-    out = _flash(qh, kh, vh, causal, bq, bk, interpret)
+    if mesh is not None and spec is not None \
+            and any(a is not None for a in spec):
+        if spec[1] is not None or spec[3] is not None:
+            raise ValueError(
+                f"flash_attention shard spec {spec} shards T or the "
+                f"head dim — only batch-like dims (batch, heads) may "
+                f"shard; time sharding rides the ring path")
+        from znicz_tpu.parallel.mesh import shard_map_unchecked
+        from jax.sharding import PartitionSpec as P
+        hspec = P(spec[0], spec[2], None, None)  # boundary → head-major
+        fn = shard_map_unchecked(
+            lambda a, b_, c: _flash(a, b_, c, causal, bq, bk,
+                                    interpret),
+            mesh, in_specs=(hspec, hspec, hspec), out_specs=hspec)
+        out = fn(qh, kh, vh)
+    else:
+        out = _flash(qh, kh, vh, causal, bq, bk, interpret)
     return out.transpose(0, 2, 1, 3).astype(jnp.float32)

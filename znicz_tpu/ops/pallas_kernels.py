@@ -29,10 +29,16 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 # THE window-sum definition (shared with the numpy oracle and the jnp
 # forward — one source of truth for the window/adjoint convention)
 from znicz_tpu.ops.normalization import _window_sum as _window_sum_xp
+
+#: jax renamed ``TPUCompilerParams`` → ``CompilerParams``; accept both
+#: so the kernels run on 0.4.x and current jax alike
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
 
 #: rows per grid step (sublane-aligned; channels ride the lane axis)
 _TILE_ROWS = 512
@@ -135,8 +141,6 @@ def _row_tiled_call(kernel, out_like, *inputs, interpret=False):
 # against the jax.random path by benchmarks/pallas_microbench.py)
 # ----------------------------------------------------------------------
 def _dropout_kernel(seed_ref, x_ref, o_ref, *, drop_ratio):
-    from jax.experimental.pallas import tpu as pltpu
-
     pltpu.prng_seed(seed_ref[0] + pl.program_id(0))
     bits = pltpu.prng_random_bits(x_ref.shape)
     threshold = jnp.uint32(int(drop_ratio * (2 ** 32 - 1)))
@@ -151,8 +155,6 @@ def dropout_apply(x, seed, drop_ratio: float, interpret: bool = False):
 
     ``seed``: int32 scalar array.  Inverted-dropout scaling matches
     ``ops/dropout.py`` (keep → ×1/(1−ratio))."""
-    from jax.experimental.pallas import tpu as pltpu
-
     shape = x.shape
     x2d = x.reshape(-1, shape[-1])
     m, c = x2d.shape
@@ -236,10 +238,49 @@ def _ln_bwd_kernel(*refs, eps, m, tile, has_beta):
             gb_ref[...] = gb_scr[...]
 
 
+def _row_shard_axes(spec) -> tuple[str, ...]:
+    """The mesh axes a kernel shard spec splits rows over — the psum
+    axes for cross-row reductions (γ/β gradient sums)."""
+    return tuple(
+        name for entry in spec if entry is not None
+        for name in ((entry,) if isinstance(entry, str)
+                     else tuple(entry)))
+
+
 def layer_norm_forward(x, gamma, beta, eps: float,
-                       interpret: bool = False):
+                       interpret: bool = False, mesh=None, spec=None):
     """Fused layer norm over (..., D): f32 statistics in VMEM, output
-    stored at the input dtype.  ``beta`` may be None (no-shift)."""
+    stored at the input dtype.  ``beta`` may be None (no-shift).
+
+    ``mesh``/``spec`` (a PartitionSpec over ``x``'s dims, from
+    :func:`znicz_tpu.parallel.mesh.kernel_shard_spec`) run the kernel
+    per-shard under ``shard_map`` — the mesh-native path; an opaque
+    ``pallas_call`` under GSPMD would gather the operand onto every
+    device.  The feature (last) axis must stay whole; row dims (batch
+    over ``data``, a ring-sharded time axis over ``model``) may
+    shard freely since every statistic is per-row.
+    """
+    if mesh is not None and spec is not None \
+            and any(a is not None for a in spec):
+        if spec[len(x.shape) - 1] is not None:
+            raise ValueError(
+                f"layer_norm shard spec {spec} shards the feature "
+                f"axis — statistics reduce over it; rows must stay "
+                f"whole")
+        from jax.sharding import PartitionSpec as P
+        from znicz_tpu.parallel.mesh import shard_map_unchecked
+        rep = P()
+        if beta is None:
+            fn = shard_map_unchecked(
+                lambda xs, g: layer_norm_forward(
+                    xs, g, None, eps, interpret=interpret),
+                mesh, in_specs=(spec, rep), out_specs=spec)
+            return fn(x, gamma)
+        fn = shard_map_unchecked(
+            lambda xs, g, bb: layer_norm_forward(
+                xs, g, bb, eps, interpret=interpret),
+            mesh, in_specs=(spec, rep, rep), out_specs=spec)
+        return fn(x, gamma, beta)
     shape = x.shape
     x2d = x.reshape(-1, shape[-1])
     m, d = x2d.shape
@@ -262,14 +303,48 @@ def layer_norm_forward(x, gamma, beta, eps: float,
 
 def layer_norm_backward(x, err, gamma, eps: float,
                         with_beta: bool = True,
-                        interpret: bool = False):
+                        interpret: bool = False, mesh=None, spec=None):
     """Fused layer-norm backward: per-row dx plus the cross-row γ (and
     β when ``with_beta``) gradient sums, one pass.  Returns
     (dx, grad_gamma, grad_beta-or-None) with the grads in f32 shape
-    (D,)."""
+    (D,).
+
+    ``mesh``/``spec``: the mesh-native path (same contract as
+    :func:`layer_norm_forward`); dx stays sharded like ``err`` while
+    the γ/β partial sums — per-shard rows only — are ``psum``'d over
+    every row-sharding mesh axis, landing replicated exactly like the
+    GSPMD reduction the XLA fallback path gets for free.
+    """
+    if mesh is not None and spec is not None \
+            and any(a is not None for a in spec):
+        if spec[len(x.shape) - 1] is not None:
+            raise ValueError(
+                f"layer_norm shard spec {spec} shards the feature "
+                f"axis — statistics reduce over it; rows must stay "
+                f"whole")
+        from jax.sharding import PartitionSpec as P
+        from znicz_tpu.parallel.mesh import shard_map_unchecked
+        reduce_axes = _row_shard_axes(spec)
+
+        def body(xs, es, g):
+            dx, gg, gb = layer_norm_backward(
+                xs, es, g, eps, with_beta=with_beta,
+                interpret=interpret)
+            gg = jax.lax.psum(gg, reduce_axes)
+            if gb is not None:
+                gb = jax.lax.psum(gb, reduce_axes)
+            return (dx, gg, gb) if with_beta else (dx, gg)
+
+        rep = P()
+        fn = shard_map_unchecked(
+            body, mesh, in_specs=(spec, spec, rep),
+            out_specs=(spec, rep, rep) if with_beta else (spec, rep))
+        if with_beta:
+            return fn(x, err, gamma)
+        dx, gg = fn(x, err, gamma)
+        return dx, gg, None
     shape = x.shape
     d = shape[-1]
-    from jax.experimental.pallas import tpu as pltpu
 
     x2d = x.reshape(-1, d)
     e2d = err.reshape(-1, d)
@@ -292,7 +367,7 @@ def layer_norm_backward(x, err, gamma, eps: float,
         out_specs=tuple(out_specs),
         out_shape=tuple(out_shape),
         scratch_shapes=scratch,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(x2d, e2d, gamma.reshape(1, d).astype(jnp.float32))

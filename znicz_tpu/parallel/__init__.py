@@ -21,5 +21,9 @@ from znicz_tpu.parallel.axis import (  # noqa: F401
 from znicz_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     batch_sharding,
+    kernel_shard_spec,
     replicated_sharding,
+    shard_map_fn,
+    shard_map_unchecked,
+    spec_divides,
 )

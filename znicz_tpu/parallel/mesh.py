@@ -3,6 +3,13 @@
 The recipe (scaling-book style): pick a mesh, annotate shardings on the
 batch and (replicated) parameters, let XLA insert the collectives, and
 keep collectives on ICI by making the ``data`` axis span the pod slice.
+
+This module is also the one home of the **kernel shard-spec
+derivation** (:func:`kernel_shard_spec`): an opaque ``pallas_call``
+has no GSPMD sharding rule, so on a multi-device mesh it must run
+per-shard under ``shard_map`` with an explicit PartitionSpec — the
+flash-attention and fused layer-norm kernels and the ring-attention
+entry all derive their specs here, one convention for all three.
 """
 
 from __future__ import annotations
@@ -13,6 +20,79 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from znicz_tpu.parallel.axis import DATA_AXIS, MODEL_AXIS
+
+
+def shard_map_fn():
+    """The ``shard_map`` entry point across jax versions (moved out of
+    ``jax.experimental`` in 0.8)."""
+    try:
+        from jax import shard_map  # jax >= 0.8
+    except ImportError:  # pragma: no cover - version-dependent
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def shard_map_unchecked(f, mesh: Mesh, in_specs, out_specs):
+    """``shard_map`` with the replication/varying-manual-axes check
+    OFF — an opaque ``pallas_call`` (and ``custom_vjp`` around one)
+    has no replication rule, so the checker would reject the body.
+    Handles the kwarg rename across jax versions (``check_rep`` →
+    ``check_vma``)."""
+    sm = shard_map_fn()
+    try:
+        return sm(f, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_rep=False)
+    except TypeError:  # pragma: no cover - version-dependent
+        return sm(f, mesh=mesh, in_specs=in_specs,
+                  out_specs=out_specs, check_vma=False)
+
+
+def kernel_shard_spec(mesh: Mesh | None, ndim: int,
+                      model_shard_dim: int | None = None,
+                      model_axis: str = MODEL_AXIS,
+                      ) -> tuple[P, tuple[str, ...]]:
+    """Derive the PartitionSpec for running a per-row kernel (flash
+    attention, fused layer norm, the ring body) under ``shard_map``.
+
+    Convention (matches ``XLADevice.sharding_for``): dim 0 is the
+    batch and rides the ``data`` axis; ``model_shard_dim`` (a Vector's
+    annotation — e.g. the time axis after a ring-attention unit) rides
+    ``model_axis``.  Feature axes are never sharded here — these
+    kernels reduce over the last axis per row, so rows must stay
+    whole.
+
+    Returns ``(spec, reduce_axes)``: ``reduce_axes`` are the mesh axes
+    that actually split rows (size > 1) — the axes a kernel's
+    cross-row reductions (γ/β gradient sums) must ``psum`` over.
+    Size-1 axes stay in the spec (harmless, keeps one code path) but
+    out of ``reduce_axes``.
+    """
+    spec: list = [None] * ndim
+    axes: list[str] = []
+    if mesh is not None:
+        if (model_shard_dim != 0 and model_axis != DATA_AXIS
+                and DATA_AXIS in mesh.shape):
+            spec[0] = DATA_AXIS
+            if mesh.shape[DATA_AXIS] > 1:
+                axes.append(DATA_AXIS)
+        if model_shard_dim is not None and model_axis in mesh.shape:
+            spec[model_shard_dim] = model_axis
+            if mesh.shape[model_axis] > 1:
+                axes.append(model_axis)
+    return P(*spec), tuple(axes)
+
+
+def spec_divides(mesh: Mesh, shape, spec) -> bool:
+    """True when every sharded dim of ``shape`` splits evenly over its
+    mesh axis — the shard_map shape-legality gate (an indivisible dim
+    falls back to the XLA path instead of erroring at trace)."""
+    for dim, axis in enumerate(spec):
+        if axis is None or dim >= len(shape):
+            continue
+        for name in (axis,) if isinstance(axis, str) else tuple(axis):
+            if shape[dim] % mesh.shape[name]:
+                return False
+    return True
 
 
 def make_mesh(n_data: int | None = None, n_model: int = 1,

@@ -57,13 +57,18 @@ def _exact_allgather(arr: np.ndarray) -> np.ndarray:
     canonicalization: 8-byte dtypes (float64/int64) ride the wire as
     uint32 pairs and are restored bit-exactly, so multi-process
     results cannot diverge numerically from single-process ones."""
+    import jax
     from jax.experimental import multihost_utils
     arr = np.ascontiguousarray(arr)
+    wire = arr.view(np.uint32) if arr.dtype.itemsize == 8 else arr
+    out = np.asarray(multihost_utils.process_allgather(wire))
+    # older jax returns the array UNCHANGED at process_count == 1 (no
+    # leading process axis; newer jax always stacks) — normalize so
+    # callers always see (process_count, *arr.shape)
+    out = out.reshape((jax.process_count(),) + wire.shape)
     if arr.dtype.itemsize == 8:
-        out = np.asarray(
-            multihost_utils.process_allgather(arr.view(np.uint32)))
         return out.view(arr.dtype)
-    return np.asarray(multihost_utils.process_allgather(arr))
+    return out
 
 
 def merge_sharded_scores(scores: np.ndarray, owner_stride: int

@@ -260,19 +260,15 @@ def sequence_sharded_attention(mesh, q, k, v, causal: bool = False,
     ring collectives), so data parallelism composes with sequence
     parallelism instead of being silently all-gathered away at the
     shard_map boundary."""
-    from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map  # jax >= 0.8
-    except ImportError:  # pragma: no cover - older jax
-        from jax.experimental.shard_map import shard_map
+    from znicz_tpu.parallel.mesh import kernel_shard_spec, \
+        shard_map_fn
 
-    from znicz_tpu.parallel.axis import DATA_AXIS
-    batch_axis = None
-    if DATA_AXIS in mesh.shape and mesh.shape[DATA_AXIS] > 1 \
-            and axis_name != DATA_AXIS:
-        batch_axis = DATA_AXIS
-    spec = P(batch_axis, axis_name, None, None)
-    fn = shard_map(
+    # one spec convention for the ring and the mesh-native Pallas
+    # kernels: batch rides the data axis, time (dim 1) rides the
+    # named sequence/model axis
+    spec, _ = kernel_shard_spec(mesh, 4, model_shard_dim=1,
+                                model_axis=axis_name)
+    fn = shard_map_fn()(
         functools.partial(ring_attention_block, axis_name=axis_name,
                           causal=causal, dot_dtype=dot_dtype,
                           block_k=block_k),
