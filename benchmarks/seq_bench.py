@@ -24,6 +24,13 @@ cores) for the A/B.  ``SEQ_INTERPRET=1`` records the arm on the
 virtual CPU mesh; without it the arm is the real-slice measurement
 hook.
 
+Sequence-parallel arm: ``SEQ_RING=<n>`` shards T over an (n_model=n)
+ring mesh; the ring hops fold through the flash kernel
+(``ring_fold="pallas"`` in the row) unless ``SEQ_RING_FOLD=0`` forces
+the scan fold — the committed A/B for the round-6 kernel-native ring.
+``SEQ_HEAD_PACK=1`` and ``SEQ_CBLOCK=<n|auto>`` are the head-packing
+and causal-block levers (PERF.md round 6 cont.).
+
 Timing note: through this environment's PJRT tunnel,
 ``block_until_ready`` on the per-step dispatch path returns before
 device execution completes (measured: a 500-GFLOP step "finished" in
@@ -69,6 +76,22 @@ CAUSAL = os.environ.get("SEQ_CAUSAL", "0") != "0"
 #: TPU slice run it as-is (this arm is the TPU measurement hook).
 DEVICES = int(os.environ.get("SEQ_DEVICES", "0"))
 SHARD_MAP = os.environ.get("SEQ_SHARD_MAP", "") != "0"
+#: SEQ_RING=<n> (n ≥ 2): the sequence-parallel arm — shard T over an
+#: (n_model=n) ring mesh (seq_parallel attention).  With the round-6
+#: kernel fold (default on TPU/interpret) each ring hop is a fused
+#: flash pass at its global offset; SEQ_RING_FOLD=0 forces the scan
+#: fold (the round-4-rate fallback) for the A/B this arm exists to
+#: record.  On the virtual CPU mesh pair it with SEQ_INTERPRET=1; on
+#: a real slice run it as-is (the TPU measurement hook, same pattern
+#: as SEQ_SHARD_MAP).
+RING = int(os.environ.get("SEQ_RING", "0"))
+RING_FOLD = os.environ.get("SEQ_RING_FOLD", "") != "0"
+#: SEQ_HEAD_PACK=1: pack head pairs into 128-lane kernel tiles
+#: (engine.flash_head_pack — the dh=64 half-MXU lever, PERF.md)
+HEAD_PACK = os.environ.get("SEQ_HEAD_PACK", "0") != "0"
+#: SEQ_CBLOCK=<n|auto>: causal block override/auto-pick
+#: (engine.flash_causal_block — the small-T causal grid-depth lever)
+CBLOCK = os.environ.get("SEQ_CBLOCK", "")
 #: SEQ_INTERPRET=1: run the Pallas kernels in interpret mode (CPU
 #: recording of the multi-device arm; meaningless on a real chip)
 INTERPRET = os.environ.get("SEQ_INTERPRET", "0") != "0"
@@ -114,6 +137,7 @@ def build():
         layers=[
             {"type": "attention",
              "->": {"n_heads": HEADS, "causal": CAUSAL,
+                    "seq_parallel": RING >= 2,
                     "flash_block_k": FLASH or None}, "<-": gd},
             {"type": "layer_norm", "->": {}, "<-": gd},
             {"type": "softmax", "->": {"output_sample_shape": 8},
@@ -151,12 +175,23 @@ def main() -> None:
     if PALLAS_LN_ENV:
         root.common.engine.pallas_layer_norm = PALLAS_LN_ENV != "0"
     root.common.engine.pallas_shard_map = SHARD_MAP
+    root.common.engine.ring_pallas_fold = \
+        RING_FOLD and "auto" or False
+    if HEAD_PACK:
+        root.common.engine.flash_head_pack = True
+    if CBLOCK:
+        root.common.engine.flash_causal_block = \
+            CBLOCK if CBLOCK == "auto" else int(CBLOCK)
     if INTERPRET:
         root.common.engine.pallas_interpret = True
     prng.seed_all(11)
     wf = build()
     import jax.numpy as jnp
-    if DEVICES >= 2:
+    if RING >= 2:
+        from znicz_tpu.parallel import make_mesh
+        device = XLADevice(mesh=make_mesh(n_data=max(1, DEVICES),
+                                          n_model=RING))
+    elif DEVICES >= 2:
         from znicz_tpu.parallel import make_mesh
         device = XLADevice(mesh=make_mesh(n_data=DEVICES))
     else:
@@ -197,7 +232,7 @@ def main() -> None:
     if PROFILE_DIR:
         import jax
         jax.profiler.stop_trace()
-    n_devices = max(1, DEVICES)
+    n_devices = max(1, DEVICES) * max(1, RING)
     tokens_per_sec = BATCH * SEQ_LEN / dt / n_devices
     mfu = attn_train_flops() / dt / (peak_tflops(device.jax_device)
                                      * 1e12) / n_devices
@@ -216,6 +251,16 @@ def main() -> None:
         # (SEQ_SHARD_MAP=0 → XLA cores — the fallback gate)
         "devices": n_devices,
         "shard_map": attn_unit._flash_mesh is not None,
+        # the SP arm: ring = model-axis size, ring_fold = which fold
+        # the hops actually ran ("pallas" = the round-6 kernel fold,
+        # "scan" = the XLA fallback; null = no ring)
+        "ring": RING or None,
+        "ring_fold": getattr(attn_unit, "_ring_fold", None),
+        "head_pack": max(getattr(attn_unit, "_flash_pack", 1),
+                         getattr(attn_unit, "_ring_pack", 1)),
+        "causal_block": (attn_unit._flash_block_k
+                         if attn_unit._flash_pallas and CAUSAL
+                         else None),
         "pallas_ln": bool(getattr(ln_unit, "_pallas_ln", False)),
         "interpret": INTERPRET,
         "step_time_ms": round(dt * 1e3, 3),

@@ -58,6 +58,22 @@ def test_ring_output_stays_sequence_sharded():
     assert spec[1] == "seq"  # time axis still sharded — composable
 
 
+def test_kernel_fold_output_stays_sequence_sharded():
+    """The round-6 kernel fold must preserve the ring's composability
+    contract: output still time-sharded (and the default request —
+    no pallas_fold — still resolves to the scan fold on CPU)."""
+    from znicz_tpu.parallel.ring_attention import ring_fold_choice
+    mesh = make_seq_mesh(4)
+    q, k, v = qkv(batch=1, time=32, heads=2, dim=8)
+    fold, _, _ = ring_fold_choice(mesh, q.shape, pallas_fold=False)
+    assert fold == "scan"        # the default stays the portable fold
+    out = sequence_sharded_attention(
+        mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+        causal=True, pallas_fold=True, pallas_interpret=True)
+    [spec] = {s.spec for s in [out.sharding]}
+    assert spec[1] == "seq"
+
+
 def test_ring_long_sequence_jit():
     """Jit-compiled, longer sequence, causal — the long-context
     configuration the design targets."""

@@ -207,14 +207,14 @@ def test_ring_per_hop_flash_on_data_model_mesh(causal):
 # ----------------------------------------------------------------------
 # unit gates: engagement, fallback switch, head-dim legality
 # ----------------------------------------------------------------------
-def _attention_unit(device, b=8, t=16, d=16, heads=2):
+def _attention_unit(device, b=8, t=16, d=16, heads=2, **kw):
     from znicz_tpu.ops import attention
     prng.seed_all(5)
     wf = DummyWorkflow()
     x = np.random.default_rng(0).normal(
         0, 0.5, size=(b, t, d)).astype(np.float32)
     src = DummyUnit(wf, output=Vector(np.asarray(x), name="x"))
-    unit = attention.MultiHeadAttention(wf, n_heads=heads)
+    unit = attention.MultiHeadAttention(wf, n_heads=heads, **kw)
     unit.link_attrs(src, ("input", "output"))
     unit.initialize(device=device)
     return unit
@@ -256,6 +256,95 @@ def test_flash_gate_rejects_illegal_head_dim(monkeypatch):
     unit = _attention_unit(XLADevice(), d=16, heads=4)    # dh = 4
     assert not unit._flash_pallas
     assert _attention_unit(XLADevice(), d=16, heads=2)._flash_pallas
+
+
+def test_ring_fold_gate_engages_kernel_on_capable_paths(monkeypatch):
+    """seq_parallel on a model-axis mesh: the ring's per-hop fold is
+    the flash KERNEL on TPU-capable paths (TPU device or interpret
+    mode), attested via `_ring_fold` — the dryrun asserts the same."""
+    _fake_tpu(monkeypatch)
+    unit = _attention_unit(
+        XLADevice(mesh=make_mesh(n_data=2, n_model=2)),
+        seq_parallel=True)
+    assert unit.ring_active
+    assert unit._ring_fold == "pallas"
+    assert unit._ring_block_q == 8          # t_local = 16/2
+
+
+def test_ring_fold_gate_fallback_switch(monkeypatch):
+    """engine.ring_pallas_fold=False restores the scan fold — the
+    gated fallback the equality tests pin."""
+    _fake_tpu(monkeypatch)
+    root.common.engine.ring_pallas_fold = False
+    unit = _attention_unit(
+        XLADevice(mesh=make_mesh(n_data=2, n_model=2)),
+        seq_parallel=True)
+    assert unit.ring_active and unit._ring_fold == "scan"
+
+
+def test_ring_fold_gate_rejects_kernel_illegal_shards(monkeypatch):
+    """Per-SHARD legality (mesh.shard_shape geometry): t_local=4 (not
+    lane-tileable) and dh=4 both fall back to the scan fold instead
+    of crashing Mosaic at trace."""
+    _fake_tpu(monkeypatch)
+    unit = _attention_unit(
+        XLADevice(mesh=make_mesh(n_data=1, n_model=4)),
+        seq_parallel=True)                   # t_local = 16/4 = 4
+    assert unit.ring_active and unit._ring_fold == "scan"
+    unit = _attention_unit(
+        XLADevice(mesh=make_mesh(n_data=2, n_model=2)),
+        seq_parallel=True, heads=4)          # dh = 4
+    assert unit.ring_active and unit._ring_fold == "scan"
+
+
+def test_ring_fold_gate_non_tpu_keeps_scan(monkeypatch):
+    """No TPU, no interpret: the ring keeps the portable scan fold
+    (the non-TPU fallback behind engine.ring_pallas_fold=auto)."""
+    unit = _attention_unit(
+        XLADevice(mesh=make_mesh(n_data=2, n_model=2)),
+        seq_parallel=True)
+    assert unit.ring_active and unit._ring_fold == "scan"
+
+
+def test_head_pack_gate(monkeypatch):
+    """engine.flash_head_pack resolves pack=2 only on pack-legal
+    geometry, for the local flash path and the ring fold alike —
+    default OFF (the chip A/B decides adoption)."""
+    _fake_tpu(monkeypatch)
+    unit = _attention_unit(XLADevice(), d=32, heads=2)   # dh = 16
+    assert unit._flash_pallas and unit._flash_pack == 1  # default off
+    root.common.engine.flash_head_pack = True
+    unit = _attention_unit(XLADevice(), d=32, heads=2)
+    assert unit._flash_pallas and unit._flash_pack == 2
+    unit = _attention_unit(
+        XLADevice(mesh=make_mesh(n_data=2, n_model=2)),
+        seq_parallel=True, d=32, heads=2)
+    assert unit._ring_fold == "pallas" and unit._ring_pack == 2
+    # odd head count degrades to 1, never raises
+    unit = _attention_unit(XLADevice(), d=48, heads=3)
+    assert unit._flash_pack == 1
+
+
+def test_causal_block_gate(monkeypatch):
+    """engine.flash_causal_block: "auto" deepens the causal grid via
+    causal_block_for, an int forces the block, default keeps the
+    chip-swept 1024 (the sweep's measurement hook)."""
+    _fake_tpu(monkeypatch)
+    # T=2048: the row the sweep targets (initialize never dispatches
+    # the kernel, so the big T costs nothing here)
+    unit = _attention_unit(XLADevice(), t=2048, causal=True)
+    assert unit._flash_block_q == 1024       # chip-swept default
+    root.common.engine.flash_causal_block = "auto"
+    unit = _attention_unit(XLADevice(), t=2048, causal=True)
+    assert unit._flash_block_q == 512        # 2048//512 = 4-deep grid
+    assert unit._flash_pallas                # still kernel-legal
+    root.common.engine.flash_causal_block = 256
+    unit = _attention_unit(XLADevice(), t=2048, causal=True)
+    assert unit._flash_block_q == 256
+    # non-causal units never touch the causal block lever
+    root.common.engine.flash_causal_block = "auto"
+    unit = _attention_unit(XLADevice(), t=2048)
+    assert unit._flash_block_q == 1024
 
 
 def _ln_unit(device, shape=(8, 16), model_shard_dim=None):

@@ -158,9 +158,11 @@ class MultiHeadAttention(Forward):
         # 2.51M vs 1.63M tokens/s at T=2048, and the only form that
         # runs T≥8k on one chip at speed).  Opt out with
         # ``root.common.engine.flash_attention = False``; resolved
-        # ONCE here like every engine flag.  The ring path keeps the
-        # jnp fold (it runs under shard_map across devices); shapes
-        # the kernel's tiling cannot cover fall back to the XLA cores.
+        # ONCE here like every engine flag.  Since round 6 the RING
+        # path folds with the same kernel per hop
+        # (``engine.ring_pallas_fold``, auto = TPU/interpret); shapes
+        # the kernel's tiling cannot cover fall back to the XLA cores
+        # (local) or the scan fold (ring).
         from znicz_tpu.ops import pallas_attention, pallas_kernels
         from znicz_tpu.parallel.mesh import kernel_shard_spec, \
             spec_divides
@@ -172,18 +174,61 @@ class MultiHeadAttention(Forward):
         # kernels (shard_map oracle tests / dryruns); never default
         interpret = bool(root.common.engine.get("pallas_interpret",
                                                 False))
+        dh = d // self.n_heads
+        tpu_capable = (pallas_kernels.is_tpu_device(self.device)
+                       or interpret)
+        # head packing (round 6, ``engine.flash_head_pack``): pairs of
+        # dh≤64 heads ride one 128-lane kernel program — exact
+        # per-head math, kernel-boundary reshape only.  OPT-IN pending
+        # the chip A/B (the decision rule: kept only if it moves
+        # toward the head_dim-128 MFU-0.405 ceiling — PERF.md).
+        head_pack = pallas_attention.resolve_head_pack(
+            root.common.engine.get("flash_head_pack", False),
+            self.n_heads, dh)
+        #: which fold the ring runs ("pallas"/"scan"; None = no ring)
+        #: — the multichip dryrun attests this
+        self._ring_fold = None
+        self._ring_block_q = None
+        self._ring_block_k = self.flash_block_k
+        self._ring_pack = 1
+        if self._ring_active:
+            from znicz_tpu.parallel.ring_attention import \
+                ring_fold_choice
+            rflag = root.common.engine.get("ring_pallas_fold", "auto")
+            if rflag == "auto":
+                rflag = tpu_capable
+            self._ring_fold, self._ring_block_q, self._ring_block_k \
+                = ring_fold_choice(
+                    mesh, (b, t, self.n_heads, dh),
+                    axis_name=MODEL_AXIS, block_k=self.flash_block_k,
+                    pallas_fold=bool(rflag), head_pack=head_pack)
+            self._ring_pack = (head_pack
+                               if self._ring_fold == "pallas" else 1)
         bq = min(pallas_attention.BLOCK_Q, t)
         bk = min(self.flash_block_k or pallas_attention.BLOCK_K, t)
-        dh = d // self.n_heads
+        if self.causal and not self._ring_active:
+            # causal block auto-pick (round 6, verdict item 3): at
+            # small T the default 1024² tiles leave a 2×2 grid with
+            # one skippable tile, so causal paid non-causal step time.
+            # ``engine.flash_causal_block``: "auto" = deepen the grid
+            # to ≥4 K-tiles (causal_block_for), int = force that
+            # block.  Default OFF until the chip A/B lands (no chip in
+            # this container — the SEQ_CBLOCK bench arm is the hook).
+            cblk = root.common.engine.get("flash_causal_block", None)
+            if cblk == "auto":
+                bq, bk = pallas_attention.causal_block_for(t, bq, bk)
+            elif cblk and t % int(cblk) == 0:
+                bq = bk = min(int(cblk), t)
+        self._flash_pack = head_pack
+        self._flash_block_q, self._flash_block_k = bq, bk
         engaged = (
             bool(flag)
-            and (pallas_kernels.is_tpu_device(self.device) or interpret)
+            and tpu_capable
             and not self._ring_active
             # T must tile evenly and the head dim must be lane-legal
             # (dh % 8 — e.g. dh=1 via a to_sequence net would crash
             # Mosaic at trace instead of falling back; ADVICE round 5)
-            and t % bq == 0 and t % bk == 0 and t % 8 == 0
-            and dh % 8 == 0)
+            and pallas_attention.kernel_legal(t, t, dh, bq, bk))
         self._flash_interpret = interpret
         self._flash_mesh = None
         self._flash_spec = None
@@ -235,7 +280,16 @@ class MultiHeadAttention(Forward):
             o = sequence_sharded_attention(
                 self.device.mesh, q, k, v, causal=self.causal,
                 axis_name=MODEL_AXIS, dot_dtype=dot_dtype,
-                block_k=self.flash_block_k)
+                block_k=self.flash_block_k,
+                # round 6: the per-hop fold is the flash KERNEL when
+                # the gate resolved it legal (initialize); the scan
+                # fold is the gated fallback
+                pallas_fold=(getattr(self, "_ring_fold", None)
+                             == "pallas"),
+                pallas_interpret=getattr(self, "_flash_interpret",
+                                         False),
+                pallas_block_q=getattr(self, "_ring_block_q", None),
+                head_pack=getattr(self, "_ring_pack", 1))
         elif getattr(self, "_flash_pallas", False):
             from znicz_tpu.ops import pallas_attention
             # (a head-major fast path — contracting the kernel's
@@ -246,11 +300,16 @@ class MultiHeadAttention(Forward):
             # round 5.)
             o = pallas_attention.flash_attention(
                 q, k, v, causal=self.causal,
-                block_k=self.flash_block_k or pallas_attention.BLOCK_K,
+                block_q=getattr(self, "_flash_block_q",
+                                pallas_attention.BLOCK_Q),
+                block_k=getattr(self, "_flash_block_k",
+                                self.flash_block_k
+                                or pallas_attention.BLOCK_K),
                 dot_dtype=dot_dtype,
                 interpret=getattr(self, "_flash_interpret", False),
                 mesh=getattr(self, "_flash_mesh", None),
-                spec=getattr(self, "_flash_spec", None))
+                spec=getattr(self, "_flash_spec", None),
+                head_pack=getattr(self, "_flash_pack", 1))
         elif self.flash_block_k:
             from znicz_tpu.parallel.ring_attention import \
                 local_attention_blocked

@@ -95,6 +95,22 @@ def spec_divides(mesh: Mesh, shape, spec) -> bool:
     return True
 
 
+def shard_shape(mesh: Mesh, shape, spec) -> tuple:
+    """The per-device shard shape of ``shape`` under ``spec`` — the
+    shapes a ``shard_map`` body actually sees.  Kernel-legality gates
+    (the ring's Pallas fold, the unit gates) must reason about THESE,
+    not the global shape: T=2048 over an 8-way seq axis hands each
+    device 256 rows, and that 256 is what the tiling must divide.
+    Assumes :func:`spec_divides` holds."""
+    out = list(shape)
+    for dim, axis in enumerate(spec):
+        if axis is None or dim >= len(out):
+            continue
+        for name in (axis,) if isinstance(axis, str) else tuple(axis):
+            out[dim] //= mesh.shape[name]
+    return tuple(out)
+
+
 def make_mesh(n_data: int | None = None, n_model: int = 1,
               devices=None) -> Mesh:
     """Build a (data, model) mesh over the available devices.

@@ -16,6 +16,12 @@ entry — it ``shard_map``'s :func:`ring_attention_block` over the mesh
 and is validated on the virtual CPU mesh against
 :func:`local_attention` (the single-device oracle).  Causal masking
 uses global positions, so it is exact across shard boundaries.
+
+Since round 6 the production TPU fold is the fused flash KERNEL: each
+hop is one :func:`znicz_tpu.ops.pallas_attention.ring_hop` pass at
+the hop's global offset (:func:`_ring_kernel_fold`), and the XLA scan
+fold below is the portable fallback (non-TPU backends,
+kernel-illegal shard geometry — :func:`ring_fold_choice` resolves).
 """
 
 from __future__ import annotations
@@ -165,20 +171,128 @@ def _fold_block(carry, q, k_blk, v_blk, s_mask, dot_dtype=None):
     return m_new, denom, acc
 
 
-def ring_attention_block(q, k, v, axis_name: str = SEQ_AXIS,
+def _ring_kernel_fold(q, k, v, offs, axis_name: str, causal: bool,
+                      dot_dtype, block_q: int | None,
+                      block_k: int | None, interpret: bool,
+                      head_pack: int):
+    """The round-6 ring fold: each hop IS one fused flash-kernel pass
+    (:func:`znicz_tpu.ops.pallas_attention.ring_hop`) over the
+    arriving K/V shard at its GLOBAL offset, and the hops compose
+    through the same online-softmax (m, l, acc) algebra the scan fold
+    carries — expressed as the numerically identical (out, lse) pair:
+    ``combine((o₁, lse₁), (o₂, lse₂)) = ((o₁·w₁ + o₂·w₂)/(w₁+w₂),
+    m + log(w₁+w₂))`` with ``wᵢ = exp(lseᵢ − m)``.  The backward
+    differentiates through the combination and the per-hop custom_vjp
+    (recompute-from-lse kernels, the lse cotangent folded into delta),
+    so sequence-parallel training runs kernel-rate in BOTH directions.
+    Causal hops entirely above the diagonal skip every tile via the
+    kernel's offset-aware ``pl.when`` (they contribute lse ≈ −1e30 and
+    weight 0 here).
+
+    Operands stay head-major (and head-packed) around the whole ring —
+    K/V rotate in kernel layout, so the per-hop cost is exactly one
+    kernel dispatch, no re-transposes.
+
+    ``offs`` is this device's (1, 1) int32 global row offset, handed
+    in as a SEQUENCE-SHARDED OPERAND (not ``axis_index``), and the
+    arriving block's offset ROTATES with K/V via ``ppermute``.  This
+    is load-bearing, not style: the offsets become custom_vjp
+    residuals, i.e. shard_map OUTPUTS of the forward — and the GSPMD
+    partitioner refuses a partition-id-derived value crossing that
+    boundary ("PartitionId instruction is not supported for SPMD
+    partitioning … ambiguous").  Deriving them from a sharded operand
+    keeps the whole fold partition-id-free."""
+    from znicz_tpu.ops import pallas_attention as pa
+
+    axis_size = jax.lax.psum(1, axis_name)
+    b, tq, h, dh = q.shape
+    tk = k.shape[1]
+    if dot_dtype is not None:
+        q, k, v = (a.astype(dot_dtype) for a in (q, k, v))
+    pack = head_pack or 1
+    qh, kh, vh = (pa.pack_heads(a, pack) for a in (q, k, v))
+    bq = min(block_q or pa.BLOCK_Q, tq)
+    bk = min(block_k or pa.BLOCK_K, tk)
+    q_off = offs[0, 0]                   # this device's global row 0
+    dhp = pack * dh                      # packed head width
+
+    def hop(k_t, v_t, k_off):
+        return pa.ring_hop(qh, k_t, v_t, q_off, k_off, causal,
+                           bq, bk, interpret, pack)
+
+    def combine(state, o_h, lse_h):
+        o, lse = state                   # o f32, lse f32 (B,Hp,Tq,pack)
+        m = jnp.maximum(lse, lse_h)
+        w1, w2 = jnp.exp(lse - m), jnp.exp(lse_h - m)
+        l = w1 + w2
+        o = o * jnp.repeat(w1 / l, dh, axis=-1) \
+            + o_h.astype(jnp.float32) * jnp.repeat(w2 / l, dh, axis=-1)
+        return o, m + jnp.log(l)
+
+    # fold the local block first (it holds the causal diagonal, so
+    # lse starts finite), then rotate-then-fold — the final iteration
+    # folds without a trailing (wasted) ppermute
+    o0, lse0 = hop(kh, vh, q_off)
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def step(i, loop_state):
+        o, lse, k_cur, v_cur, off_cur = loop_state
+        k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
+        # the arriving block's global offset travels WITH the block
+        off_cur = jax.lax.ppermute(off_cur, axis_name, perm)
+        o, lse = combine((o, lse), *hop(k_cur, v_cur, off_cur[0, 0]))
+        return o, lse, k_cur, v_cur, off_cur
+
+    o, _, _, _, _ = jax.lax.fori_loop(
+        1, axis_size, step, (o0.astype(jnp.float32), lse0, kh, vh,
+                             offs))
+    assert o.shape == (b, h // pack, tq, dhp)
+    return pa.unpack_heads(o.astype(q.dtype), pack, h)  # (B,Tq,H,Dh)
+
+
+def ring_attention_block(q, k, v, seq_offsets=None,
+                         axis_name: str = SEQ_AXIS,
                          causal: bool = False, dot_dtype=None,
-                         block_k: int | None = None):
+                         block_k: int | None = None,
+                         pallas_fold: bool = False,
+                         pallas_interpret: bool = False,
+                         pallas_block_q: int | None = None,
+                         head_pack: int = 1):
     """The per-device body (call under ``shard_map``): q/k/v are THIS
     device's sequence shards; K/V rotate the full ring.
 
+    ``pallas_fold`` makes each hop a fused flash-kernel pass (the
+    round-6 production TPU path — see :func:`_ring_kernel_fold`);
+    ``pallas_interpret`` runs those kernels in interpret mode (the
+    virtual-CPU-mesh testing lever), ``pallas_block_q`` overrides the
+    kernel's q tile and ``head_pack`` is the lane-packing factor
+    resolved by the unit gate.  Legality (tiling/dh) is the CALLER's
+    job — :func:`sequence_sharded_attention` gates on the per-shard
+    shapes and falls back to the scan fold.
+
     ``block_k`` composes the flash-style K/V-block fold INTO each ring
-    step: the arriving (tq × tk_local) tile is folded sub-block by
-    sub-block under ``jax.checkpoint``, so a device never materializes
-    even its per-step local score tile — the single-chip
+    step of the SCAN fold: the arriving (tq × tk_local) tile is folded
+    sub-block by sub-block under ``jax.checkpoint``, so a device never
+    materializes even its per-step local score tile — the single-chip
     ``local_attention_blocked`` memory behavior, per ring hop.
     Without it, large per-device T_local hits the same (tq, tk) HBM
     wall on every hop that the blocked form was built to remove
-    (round-4 verdict item 6)."""
+    (round-4 verdict item 6).  On the kernel fold, ``block_k`` is the
+    kernel's K tile instead.  The scan fold remains the portable
+    fallback (non-TPU backends, kernel-illegal shapes).
+
+    ``seq_offsets`` (kernel fold only): this device's (1, 1) int32
+    global row offset as a sequence-sharded operand — see
+    :func:`_ring_kernel_fold` for why it cannot be ``axis_index``."""
+    if pallas_fold:
+        if seq_offsets is None:
+            raise ValueError("the kernel fold needs the sharded "
+                             "seq_offsets operand (see "
+                             "sequence_sharded_attention)")
+        return _ring_kernel_fold(q, k, v, seq_offsets, axis_name,
+                                 causal, dot_dtype, pallas_block_q,
+                                 block_k, pallas_interpret, head_pack)
     axis_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     b, tq, h, dim = q.shape
@@ -248,12 +362,50 @@ def ring_attention_block(q, k, v, axis_name: str = SEQ_AXIS,
     return jnp.transpose(out, (0, 2, 1, 3))      # → (B, Tq, H, D)
 
 
+def ring_fold_choice(mesh, shape, axis_name: str = SEQ_AXIS,
+                     block_k: int | None = None,
+                     pallas_fold: bool = False,
+                     pallas_block_q: int | None = None,
+                     head_pack: int = 1):
+    """Resolve which fold the ring will actually run for a GLOBAL
+    (B, T, H, Dh) shape: ``("pallas", bq, bk)`` when the kernel fold
+    is requested AND the per-shard geometry is kernel-legal, else
+    ``("scan", None, block_k)``.  One place for the unit gate, the
+    entry below and the dryrun attestation to agree on."""
+    from znicz_tpu.ops import pallas_attention as pa
+    from znicz_tpu.parallel.mesh import kernel_shard_spec, \
+        shard_shape, spec_divides
+
+    spec, _ = kernel_shard_spec(mesh, 4, model_shard_dim=1,
+                                model_axis=axis_name)
+    if not pallas_fold or not spec_divides(mesh, shape, spec):
+        return "scan", None, block_k
+    _, t_local, h, dh = shard_shape(mesh, shape, spec)
+    bq = min(pallas_block_q or pa.BLOCK_Q, t_local)
+    bk = min(block_k or pa.BLOCK_K, t_local)
+    pack = head_pack or 1
+    if h % pack or not pa.kernel_legal(t_local, t_local, dh * pack,
+                                       bq, bk):
+        return "scan", None, block_k
+    return "pallas", bq, bk
+
+
 def sequence_sharded_attention(mesh, q, k, v, causal: bool = False,
                                axis_name: str = SEQ_AXIS,
                                dot_dtype=None,
-                               block_k: int | None = None):
+                               block_k: int | None = None,
+                               pallas_fold: bool = False,
+                               pallas_interpret: bool = False,
+                               pallas_block_q: int | None = None,
+                               head_pack: int = 1):
     """Shard the time axis of q/k/v over ``mesh[axis_name]`` and run
     ring attention; returns output with the same sharding as q.
+
+    ``pallas_fold=True`` requests the round-6 kernel fold (each hop a
+    fused flash pass at its global offset); shapes the kernel's tiling
+    cannot cover fall back to the scan fold silently — the same
+    fallback philosophy as the unit gates.  ``pallas_interpret`` is
+    the virtual-CPU-mesh lever (the REAL kernels, emulated).
 
     When the mesh also has a ``data`` axis, the BATCH dim shards over
     it — the ring runs per batch shard (the batch dim never enters the
@@ -261,18 +413,47 @@ def sequence_sharded_attention(mesh, q, k, v, causal: bool = False,
     parallelism instead of being silently all-gathered away at the
     shard_map boundary."""
     from znicz_tpu.parallel.mesh import kernel_shard_spec, \
-        shard_map_fn
+        shard_map_fn, shard_map_unchecked
 
     # one spec convention for the ring and the mesh-native Pallas
     # kernels: batch rides the data axis, time (dim 1) rides the
     # named sequence/model axis
     spec, _ = kernel_shard_spec(mesh, 4, model_shard_dim=1,
                                 model_axis=axis_name)
-    fn = shard_map_fn()(
-        functools.partial(ring_attention_block, axis_name=axis_name,
-                          causal=causal, dot_dtype=dot_dtype,
-                          block_k=block_k),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    fold, bq, bk = ring_fold_choice(
+        mesh, q.shape, axis_name=axis_name, block_k=block_k,
+        pallas_fold=pallas_fold, pallas_block_q=pallas_block_q,
+        head_pack=head_pack)
+    body = functools.partial(ring_attention_block,
+                             axis_name=axis_name, causal=causal,
+                             dot_dtype=dot_dtype, block_k=bk,
+                             pallas_fold=(fold == "pallas"),
+                             pallas_interpret=pallas_interpret,
+                             pallas_block_q=bq,
+                             head_pack=head_pack if fold == "pallas"
+                             else 1)
+    if fold == "pallas":
+        from jax.sharding import PartitionSpec as P
+
+        # per-device global row offsets as a SEQ-SHARDED operand (each
+        # shard sees its own (1, 1) scalar) — axis_index would leave a
+        # partition-id in the custom_vjp residuals, which the GSPMD
+        # partitioner rejects at the shard_map boundary
+        n_seq = mesh.shape[axis_name]
+        t_local = q.shape[1] // n_seq
+        offs = (jnp.arange(n_seq, dtype=jnp.int32)
+                * t_local).reshape(n_seq, 1)
+        # the opaque pallas_call (and its custom_vjp) has no
+        # replication rule — same unchecked wrapper as the
+        # batch-sharded flash path
+        fn = shard_map_unchecked(
+            body, mesh,
+            in_specs=(spec, spec, spec, P(axis_name, None)),
+            out_specs=spec)
+        return fn(q, k, v, offs)
+    fn = shard_map_fn()(body, mesh=mesh,
+                        in_specs=(spec, spec, spec),
+                        out_specs=spec)
     return fn(q, k, v)
 
 
