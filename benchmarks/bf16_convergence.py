@@ -24,6 +24,13 @@ bf16 trajectory tracks f32 within both bands.
 
 Run: ``python benchmarks/bf16_convergence.py`` (env: BF16_EPOCHS,
 BF16_BATCH, BF16_CLASSES, BF16_IMAGE_SIZE).
+
+``BF16_GRADCOMMS=1`` adds the 4th arm (round 7): ZeRO-1 data-axis
+optimizer sharding with **bf16 gradient reduce-scatter**
+(``engine.zero1`` + ``engine.bf16_grad_comms``) on a data mesh over
+every visible device.  Needs ≥ 2 devices — on the single-chip bench
+container the arm stays a queued measurement and the artifact records
+it under ``pending_arms`` instead of fabricating a curve.
 """
 
 from __future__ import annotations
@@ -45,6 +52,7 @@ IMAGE_SIZE = int(os.environ.get("BF16_IMAGE_SIZE", "227"))
 #: classes OVERLAP and validation error floors well above zero (the
 #: non-degeneracy the artifact exists to provide) yet far below chance
 NOISE = float(os.environ.get("BF16_NOISE", "100"))
+GRADCOMMS = os.environ.get("BF16_GRADCOMMS", "0") == "1"
 STEPS_PER_EPOCH = 8
 VALID_STEPS = 2
 
@@ -95,7 +103,8 @@ def build(precision: str):
     return wf
 
 
-def train_curve(precision: str, bf16_opt_state: bool = False) -> dict:
+def train_curve(precision: str, bf16_opt_state: bool = False,
+                grad_comms: bool = False) -> dict:
     from znicz_tpu.backends import XLADevice
     from znicz_tpu.utils import prng
     from znicz_tpu.utils.config import reset_root, root
@@ -103,11 +112,26 @@ def train_curve(precision: str, bf16_opt_state: bool = False) -> dict:
     reset_root()
     prng.seed_all(4242)
     # the optimizer-state arm is what's under test: pin the flag per
-    # curve so the artifact's three arms are f32 / bf16+f32-state /
-    # bf16+bf16-state regardless of the engine default
+    # curve so the artifact's arms are f32 / bf16+f32-state /
+    # bf16+bf16-state (/ + zero1-bf16-grad-comms) regardless of the
+    # engine defaults
     root.common.engine.bf16_optimizer_state = bf16_opt_state
+    device = XLADevice()
+    if grad_comms:
+        import jax
+
+        from znicz_tpu.parallel import make_mesh
+        if len(jax.devices()) < 2:
+            raise SystemExit("BF16_GRADCOMMS needs ≥ 2 devices "
+                             "(a data mesh to reduce-scatter over)")
+        root.common.engine.zero1 = "auto"
+        root.common.engine.bf16_grad_comms = True
+        device = XLADevice(mesh=make_mesh())
     wf = build(precision)
-    wf.initialize(device=XLADevice())
+    wf.initialize(device=device)
+    if grad_comms:
+        assert any(getattr(g, "_grad_comms_bf16", False)
+                   for g in wf.gds), "bf16 grad comms did not engage"
 
     losses, errors, valid_errors = [], [], []
     orig = wf.decision.on_epoch_ended
@@ -126,6 +150,7 @@ def train_curve(precision: str, bf16_opt_state: bool = False) -> dict:
     wf.run_chunked(steps_per_dispatch=STEPS_PER_EPOCH)
     return {"precision": precision,
             "bf16_opt_state": bool(bf16_opt_state),
+            "zero1_bf16_grad_comms": bool(grad_comms),
             "loss": losses, "n_err": errors,
             "valid_n_err": valid_errors}
 
@@ -172,6 +197,21 @@ def main() -> None:
               "bfloat16_optstate": bf16_opt}
     verdicts = {"bfloat16": bands(bf16),
                 "bfloat16_optstate": bands(bf16_opt)}
+    pending = []
+    if GRADCOMMS:
+        # arm 4 (round 7): ZeRO-1 sharded update + bf16 gradient
+        # reduce-scatter on a data mesh — the gate stays default-off
+        # until this band holds on a real multi-chip slice
+        bf16_gc = train_curve("bfloat16", bf16_opt_state=True,
+                              grad_comms=True)
+        curves["bfloat16_gradcomms"] = bf16_gc
+        verdicts["bfloat16_gradcomms"] = bands(bf16_gc)
+    else:
+        pending.append(
+            "bfloat16_gradcomms (engine.zero1 + engine.bf16_grad_comms:"
+            " bf16 gradient reduce-scatter) — run with BF16_GRADCOMMS=1"
+            " on a multi-chip slice; gate stays default-off until the"
+            " band holds there")
     ok = all(v["band_ok"] for v in verdicts.values())
     artifact = {
         "model": "alexnet", "image_size": IMAGE_SIZE, "batch": BATCH,
@@ -183,6 +223,7 @@ def main() -> None:
         "valid_err_best_f32": err_final_f32,
         "verdicts": verdicts,
         "band_ok": bool(ok),
+        "pending_arms": pending,
         "curves": curves,
     }
     with open(os.path.join(REPO, "BF16_CONVERGENCE.json"), "w") as fh:

@@ -5,6 +5,14 @@ Usage: ``python benchmarks/trace_top.py <profile_dir_or_trace.json.gz>
 directory, sums durations of device-lane events by name, and prints
 the top entries (total ms, ms/step when ``n_steps`` given, % of
 device total).  This is how PERF.md's "named sinks" tables are made.
+
+Collective ops (all-reduce / reduce-scatter / all-gather /
+collective-permute/ppermute and their async start/done halves) are
+additionally rolled into a **comms** bucket, printed as one
+comm-vs-compute split line — the attribution needed to read the
+ZeRO-1 (round 7) update-path traces: the reduce-scatter + all-gather
+pair must show up as comm time halved against the replicated
+all-reduce, not smeared into the fusion names.
 """
 
 from __future__ import annotations
@@ -26,6 +34,22 @@ def find_trace(path: str) -> str:
     if not hits:
         raise SystemExit(f"no *.trace.json.gz under {path}")
     return hits[-1]
+
+
+#: substrings classifying a device event as a cross-chip collective
+#: (async halves included: "all-reduce-start"/"-done", fusion-wrapped
+#: names keep the op substring)
+_COMM_OPS = ("all-reduce", "reduce-scatter", "all-gather",
+             "collective-permute", "ppermute", "all-to-all",
+             "collective-broadcast", "partition-id", "replica-id")
+
+
+def classify(name: str) -> str:
+    low = name.lower()
+    for op in _COMM_OPS:
+        if op in low:
+            return "comms"
+    return "compute"
 
 
 def main() -> None:
@@ -71,6 +95,26 @@ def main() -> None:
     print(f"trace: {trace}")
     print(f"device busy: {total:.1f} ms"
           + (f" ({total / n_steps:.3f} ms/step)" if n_steps else ""))
+    # comm-vs-compute attribution (the zero1/ring trace reader)
+    buckets: collections.Counter = collections.Counter()
+    comm_by_op: collections.Counter = collections.Counter()
+    for name, ms in by_name.items():
+        bucket = classify(name)
+        buckets[bucket] += ms
+        if bucket == "comms":
+            low = name.lower()
+            op = next(o for o in _COMM_OPS if o in low)
+            comm_by_op[op] += ms
+    comms = buckets["comms"]
+    if total:
+        line = (f"comms: {comms:.1f} ms ({100 * comms / total:.1f}%)  "
+                f"compute: {buckets['compute']:.1f} ms "
+                f"({100 * buckets['compute'] / total:.1f}%)")
+        if n_steps:
+            line += f"  [{comms / n_steps:.3f} comm ms/step]"
+        print(line)
+    for op, ms in comm_by_op.most_common():
+        print(f"    {ms:9.1f} ms  {100 * ms / total:5.1f}%  {op}")
     n_events: collections.Counter = collections.Counter()
     for ev in events:
         if ev.get("ph") == "X" and ev.get("pid") in device_pids:

@@ -138,12 +138,28 @@ class XLADevice(Device):
         if vector is None:
             return replicated_sharding(self.mesh)
         model_dim = getattr(vector, "model_shard_dim", None)
-        if not vector.batch_major and model_dim is None:
+        data_dim = getattr(vector, "data_shard_dim", None)
+        if not vector.batch_major and model_dim is None \
+                and data_dim is None:
             return replicated_sharding(self.mesh)
         ndim = len(vector.shape)
         spec: list = [None] * ndim
         if vector.batch_major and ndim:
+            if data_dim is not None:
+                raise ValueError(
+                    f"Vector '{vector.name}': batch-major buffers "
+                    f"already ride the data axis on dim 0 — "
+                    f"data_shard_dim is for persistent (ZeRO-1) state")
             spec[0] = DATA_AXIS
+        if data_dim is not None:
+            # ZeRO-1 optimizer state: each chip stores 1/N of the
+            # accumulator along this dim (nn_units pads the dim to a
+            # multiple of the data-axis size at allocation)
+            if data_dim == model_dim:
+                raise ValueError(
+                    f"Vector '{vector.name}': dim {data_dim} cannot "
+                    f"carry both the data and the model axis")
+            spec[data_dim] = DATA_AXIS
         if model_dim is not None:
             if model_dim == 0 and vector.batch_major:
                 raise ValueError(

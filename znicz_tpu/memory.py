@@ -54,11 +54,13 @@ class Vector:
     """Host-mirrored device buffer with explicit sync points."""
 
     __slots__ = ("_mem", "_devmem", "_state", "_device", "_tracing", "name",
-                 "batch_major", "model_shard_dim")
+                 "batch_major", "model_shard_dim", "data_shard_dim",
+                 "data_shard_pad")
 
     def __init__(self, mem: np.ndarray | None = None,
                  name: str = "", batch_major: bool = False,
-                 model_shard_dim: int | None = None) -> None:
+                 model_shard_dim: int | None = None,
+                 data_shard_dim: int | None = None) -> None:
         self._mem: np.ndarray | None = None
         self._devmem = None
         self._state = _State.EMPTY
@@ -73,6 +75,18 @@ class Vector:
         #: None = replicated over model.  Set before ``initialize`` —
         #: the device reads it when placing the buffer
         self.model_shard_dim = model_shard_dim
+        #: dim sharded over the mesh's DATA axis for NON-batch-major
+        #: persistent state (ZeRO-1 optimizer sharding: each chip owns
+        #: 1/N of the momentum accumulators).  Composes with
+        #: ``model_shard_dim`` (a different dim) so bf16 optimizer
+        #: state + TP weights + data-sharded momentum all stack.
+        self.data_shard_dim = data_shard_dim
+        #: rows of zero padding appended along ``data_shard_dim`` when
+        #: the logical dim does not divide the data-axis size (jax
+        #: shardings must divide evenly).  Snapshots slice the padding
+        #: off on save and re-pad on load, so checkpoints stay
+        #: layout-independent (``Unit.state_dict``/``load_state``).
+        self.data_shard_pad = 0
         if mem is not None:
             self.reset(mem)
 
@@ -260,6 +274,34 @@ class Vector:
         frequent ``size // shape[0]`` idiom)."""
         shape = self.shape
         return int(np.prod(shape[1:])) if len(shape) > 1 else 1
+
+    # -- ZeRO-1 padding helpers (snapshot layout independence) ---------
+    def strip_data_pad(self, arr: np.ndarray) -> np.ndarray:
+        """Remove the ``data_shard_pad`` zero rows — the LOGICAL
+        content a snapshot stores, independent of the mesh size the
+        padding was computed for."""
+        if not self.data_shard_pad or self.data_shard_dim is None:
+            return arr
+        dim = self.data_shard_dim
+        idx = [slice(None)] * arr.ndim
+        idx[dim] = slice(0, arr.shape[dim] - self.data_shard_pad)
+        return arr[tuple(idx)]
+
+    def apply_data_pad(self, arr: np.ndarray) -> np.ndarray:
+        """Re-pad a logical (snapshot) array to THIS Vector's padded
+        storage shape — the inverse of :meth:`strip_data_pad` under the
+        CURRENT mesh (a restore may re-shard onto a different mesh size
+        than the one that saved)."""
+        if not self.data_shard_pad or self.data_shard_dim is None:
+            return arr
+        dim = self.data_shard_dim
+        want = self.shape[dim]
+        have = arr.shape[dim]
+        if have == want:
+            return arr
+        widths = [(0, 0)] * arr.ndim
+        widths[dim] = (0, want - have)
+        return np.pad(arr, widths)
 
     def __bool__(self) -> bool:
         return self._state != _State.EMPTY

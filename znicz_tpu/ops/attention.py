@@ -396,12 +396,15 @@ class GDMultiHeadAttention(GradientDescentBase):
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
         fwd = self.forward_unit
+        # the shared allocator (not a bare reset) so the output
+        # projection's momentum gets the same bf16-storage + ZeRO-1
+        # data-sharding treatment as the base pair
         if self.gradient_moment:
-            self.accumulated_gradient_weights_out.reset(
-                np.zeros(fwd.weights_out.shape, self.opt_state_dtype))
+            self._alloc_accumulator(self.accumulated_gradient_weights_out,
+                                    fwd.weights_out)
         if self.gradient_moment_bias and fwd.include_bias:
-            self.accumulated_gradient_bias_out.reset(
-                np.zeros(fwd.bias_out.shape, self.opt_state_dtype))
+            self._alloc_accumulator(self.accumulated_gradient_bias_out,
+                                    fwd.bias_out)
         self.init_vectors(self.err_input, self.err_output, self.input,
                           self.output, self.weights, self.bias,
                           fwd.weights_out, fwd.bias_out,

@@ -10,10 +10,14 @@ Math (weights stored ``(in, out)``; see ``nn_units.py``):
     dL/dW       = xᵀ @ δ_act          (GEMM on MXU)
     dL/db       = Σ_batch δ_act
 
-followed by the shared momentum/decay update in
+followed by the shared momentum/decay/clip update in
 :class:`~znicz_tpu.ops.nn_units.GradientDescentBase`.  The evaluator
 emits ``err_output`` already normalized by batch size, so no ``1/N``
-appears here.
+appears here.  On data-parallel meshes the update path (gradient fold
+included) runs ZeRO-1 sharded over the data axis by default — the
+family units only PRODUCE ``dL/dW``; the reduce-scatter / sharded
+momentum / all-gather plumbing lives entirely in the base's
+``_apply_param_xla``.
 
 ``GDSoftmax`` is the linear case: ``EvaluatorSoftmax`` produces the
 combined softmax+cross-entropy derivative (``p − t``), exactly as the

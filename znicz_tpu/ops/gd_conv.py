@@ -10,6 +10,12 @@ from the forward unit's saved output, like the numpy oracle's.  The
 oracle is the explicit im2col/col2im math, independently implemented,
 so the transpose path is *tested against* the reference-style
 computation.
+
+The weight/bias gradients feed the shared base update
+(``GradientDescentBase._apply_param_xla``) — on data-parallel meshes
+that means the ZeRO-1 reduce-scatter → sharded-momentum → all-gather
+form; conv kernels pick their data-shard dim like any other parameter
+(largest non-model dim, usually ``n_kernels``).
 """
 
 from __future__ import annotations

@@ -7,6 +7,11 @@ forward re-evaluation; same design as ``gd_conv``).  For a transposed
 conv that is again a plain conv, lowered natively by XLA.  Numpy
 oracle: the explicit transpose math (im2col of the incoming error),
 independently implemented.
+
+Like every GD family, the gradients here only get PRODUCED — the
+momentum/decay/clip update (and, on data-parallel meshes, its ZeRO-1
+reduce-scatter / sharded-state / all-gather form) is the shared base
+path in ``GradientDescentBase._apply_param_xla``.
 """
 
 from __future__ import annotations

@@ -33,6 +33,7 @@ from typing import Type
 
 import numpy as np
 
+import jax
 import jax.numpy as jnp
 
 from znicz_tpu.accelerated_units import AcceleratedUnit
@@ -158,17 +159,25 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
     """Base backward unit (reference: ``znicz/nn_units.py``
     GradientDescentBase).
 
-    Update rule (matching the reference's momentum + L1/L2 decay):
+    Update rule (matching the reference's momentum + L1/L2 decay, plus
+    optional per-tensor gradient-norm clipping):
 
     .. code-block:: text
 
-        g   = dL/dW + weights_decay·((1−l1_vs_l2)·W + ½·l1_vs_l2·sign(W))
+        ĝ   = dL/dW · min(1, gradient_clip / ‖dL/dW‖₂)      (clip > 0)
+        g   = ĝ + weights_decay·((1−l1_vs_l2)·W + ½·l1_vs_l2·sign(W))
         acc = gradient_moment·acc − learning_rate·g
         W  += acc
 
-    In data-parallel runs ``dL/dW`` is ``pmean``-folded over the
-    ``data`` mesh axis before the update — the synchronous SPMD
-    replacement for the reference's master-side gradient fold.
+    In data-parallel runs ``dL/dW`` is folded over the ``data`` mesh
+    axis before the update — the synchronous SPMD replacement for the
+    reference's master-side gradient fold.  On meshes with a data axis
+    of size > 1 the fold+update pair runs **ZeRO-1 sharded** by
+    default (``root.common.engine.zero1``, auto): gradients are
+    reduce-scattered, the update and the STORED momentum state live on
+    each chip's 1/N shard, and updated params are all-gathered back —
+    same math, half the update-path comm bytes, optimizer memory cut
+    by the mesh size (:meth:`_apply_param_zero1`).
     """
 
     MATCHES: tuple = ()
@@ -185,6 +194,7 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
                  l1_vs_l2: float = 0.0,
                  gradient_moment: float = 0.0,
                  gradient_moment_bias: float | None = None,
+                 gradient_clip: float = 0.0,
                  need_err_input: bool = True,
                  **kwargs) -> None:
         super().__init__(workflow, name=name, **kwargs)
@@ -198,7 +208,15 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         self.gradient_moment_bias = (gradient_moment
                                      if gradient_moment_bias is None
                                      else gradient_moment_bias)
+        #: max L2 norm per parameter tensor for the (mesh-folded) raw
+        #: gradient; 0 disables.  Applied before decay, so the clip
+        #: bounds the DATA term only — the regularizer stays exact.
+        self.gradient_clip = gradient_clip
         self.need_err_input = need_err_input
+        #: resolved at initialize (parallel.mesh.zero1_choice): True =
+        #: the update runs ZeRO-1 sharded over the mesh's data axis
+        self._zero1 = False
+        self._grad_comms_bf16 = False
         # linked from the paired forward unit by StandardWorkflow:
         self.input: Vector | None = None
         self.output: Vector | None = None
@@ -247,21 +265,47 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             # and jit region both honor gate_skip)
             from znicz_tpu.mutable import Bool
             self.gate_skip = Bool(True)
+        from znicz_tpu.parallel.mesh import zero1_choice
+        from znicz_tpu.utils.config import root
+        self._zero1 = zero1_choice(self.device)
+        # second convergence-gated comms lever: reduce-scatter the
+        # weight gradients in bf16 (half the ICI bytes again).
+        # Default OFF until a multi-chip A/B + convergence band lands
+        # (BF16_CONVERGENCE.json, `bfloat16_gradcomms` arm).
+        self._grad_comms_bf16 = (
+            self._zero1
+            and bool(root.common.engine.get("bf16_grad_comms", False)))
         if self.gradient_moment or self.gradient_moment_bias:
-            acc_dtype = self.opt_state_dtype
             if self.weights is not None and self.weights:
-                self.accumulated_gradient_weights.reset(
-                    np.zeros(self.weights.shape, dtype=acc_dtype))
-                self.accumulated_gradient_weights.model_shard_dim = \
-                    getattr(self.weights, "model_shard_dim", None)
+                self._alloc_accumulator(self.accumulated_gradient_weights,
+                                        self.weights)
             if (self.bias is not None and self.bias
                     and self.gradient_moment_bias):
-                self.accumulated_gradient_bias.reset(
-                    np.zeros(self.bias.shape, dtype=acc_dtype))
-                self.accumulated_gradient_bias.model_shard_dim = \
-                    getattr(self.bias, "model_shard_dim", None)
+                self._alloc_accumulator(self.accumulated_gradient_bias,
+                                        self.bias)
             self.init_vectors(self.accumulated_gradient_weights,
                               self.accumulated_gradient_bias)
+
+    def _alloc_accumulator(self, acc_vec: Vector, param_vec: Vector) -> None:
+        """Allocate a momentum accumulator for ``param_vec``: storage
+        dtype from the bf16-optimizer-state policy, model-axis sharding
+        inherited, and — under ZeRO-1 — a ``data_shard_dim`` annotation
+        (plus zero padding up to a multiple of the data-axis size) so
+        each chip STORES only 1/N of the state.  Units with extra
+        parameter pairs (attention's output projection) call this for
+        their own accumulators so every lever composes identically."""
+        from znicz_tpu.parallel.mesh import zero1_partition
+        shape = list(param_vec.shape)
+        acc_vec.model_shard_dim = getattr(param_vec, "model_shard_dim",
+                                          None)
+        if self._zero1:
+            dim, pad = zero1_partition(shape, self.device.n_data_shards,
+                                       acc_vec.model_shard_dim)
+            if dim is not None:
+                shape[dim] += pad
+                acc_vec.data_shard_dim = dim
+                acc_vec.data_shard_pad = pad
+        acc_vec.reset(np.zeros(tuple(shape), dtype=self.opt_state_dtype))
 
     @property
     def opt_state_dtype(self) -> np.dtype:
@@ -310,16 +354,31 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             reg = reg + 0.5 * l1 * xp.sign(weights)
         return grad + decay * reg
 
+    def _clipped(self, xp, grad):
+        """Per-tensor L2 gradient-norm clipping (``gradient_clip``).
+        The norm is a full-tensor reduction: under ZeRO-1 it runs on
+        the scattered shard (partial sums + one scalar all-reduce),
+        so clipping does not resurrect the full-gradient all-reduce."""
+        clip = self.gradient_clip
+        if not clip:
+            return grad
+        g32 = grad.astype(np.float32) if xp is np \
+            else grad.astype(jnp.float32)
+        norm = xp.sqrt(xp.sum(g32 * g32))
+        scale = xp.minimum(1.0, clip / xp.maximum(norm, 1e-30))
+        return grad * scale
+
     # ``vec``/``acc`` parameters let units with EXTRA parameter pairs
     # (e.g. attention's output projection) reuse the exact update rule
-    # instead of copy-pasting the momentum/decay math
+    # instead of copy-pasting the momentum/decay/clip math
     def _apply_weights_np(self, grad_w: np.ndarray, vec=None,
                           acc_vec=None) -> None:
         vec = vec if vec is not None else self.weights
         acc_vec = acc_vec if acc_vec is not None \
             else self.accumulated_gradient_weights
         w = vec.mem
-        g = self._regularized(np, grad_w, w, self.weights_decay)
+        g = self._regularized(np, self._clipped(np, grad_w), w,
+                              self.weights_decay)
         lr = self._lr(xla=False)
         if self.gradient_moment:
             acc = acc_vec.mem
@@ -337,7 +396,8 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         if vec is None or not vec:
             return
         b = vec.mem
-        g = self._regularized(np, grad_b, b, self.weights_decay_bias)
+        g = self._regularized(np, self._clipped(np, grad_b), b,
+                              self.weights_decay_bias)
         lr = self._lr_bias(xla=False)
         if self.gradient_moment_bias:
             acc = acc_vec.mem
@@ -351,20 +411,8 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         vec = vec if vec is not None else self.weights
         acc_vec = acc_vec if acc_vec is not None \
             else self.accumulated_gradient_weights
-        grad_w = maybe_pmean(grad_w)
-        w = vec.devmem
-        g = self._regularized(jnp, grad_w, w, self.weights_decay)
-        lr = self._lr(xla=True)
-        if self.gradient_moment:
-            # momentum math in f32 regardless of the accumulator's
-            # STORAGE dtype (opt_state_dtype); the setter rounds the
-            # store back down
-            acc = self.gradient_moment \
-                * acc_vec.devmem.astype(jnp.float32) - lr * g
-            acc_vec.devmem = acc
-            vec.devmem = w + acc
-        else:
-            vec.devmem = w - lr * g
+        self._apply_param_xla(grad_w, vec, acc_vec, self.weights_decay,
+                              self._lr(xla=True), self.gradient_moment)
 
     def _apply_bias_xla(self, grad_b, vec=None, acc_vec=None) -> None:
         vec = vec if vec is not None else self.bias
@@ -372,17 +420,115 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             else self.accumulated_gradient_bias
         if vec is None or not vec:
             return
-        grad_b = maybe_pmean(grad_b)
-        b = vec.devmem
-        g = self._regularized(jnp, grad_b, b, self.weights_decay_bias)
-        lr = self._lr_bias(xla=True)
-        if self.gradient_moment_bias:
-            acc = self.gradient_moment_bias \
-                * acc_vec.devmem.astype(jnp.float32) - lr * g
+        self._apply_param_xla(grad_b, vec, acc_vec,
+                              self.weights_decay_bias,
+                              self._lr_bias(xla=True),
+                              self.gradient_moment_bias)
+
+    def _apply_param_xla(self, grad, vec: Vector, acc_vec, decay: float,
+                         lr, moment: float) -> None:
+        """One parameter tensor's update on the XLA path.
+
+        Two forms, same math (``tests/test_zero1.py`` pins parity):
+
+        - replicated (the historical path): the gradient is all-reduced
+          (implicitly by GSPMD from the data-sharded contraction, or by
+          ``maybe_pmean`` under an explicit mapped axis) and the
+          identical momentum/decay/clip update runs on every chip;
+        - ZeRO-1 (``engine.zero1``, auto-on for data axes > 1): see
+          :meth:`_apply_param_zero1`.
+        """
+        from znicz_tpu.parallel.axis import current_data_axis
+        grad = maybe_pmean(grad)
+        if self._zero1 and current_data_axis() is None:
+            self._apply_param_zero1(grad, vec, acc_vec, decay, lr, moment)
+            return
+        w = vec.devmem
+        g = self._regularized(jnp, self._clipped(jnp, grad), w, decay)
+        if moment:
+            # momentum math in f32 regardless of the accumulator's
+            # STORAGE dtype (opt_state_dtype); the setter rounds the
+            # store back down
+            acc = moment * acc_vec.devmem.astype(jnp.float32) - lr * g
             acc_vec.devmem = acc
-            vec.devmem = b + acc
+            vec.devmem = w + acc
         else:
-            vec.devmem = b - lr * g
+            vec.devmem = w - lr * g
+
+    def _apply_param_zero1(self, grad, vec: Vector, acc_vec,
+                           decay: float, lr, moment: float) -> None:
+        """ZeRO-1 form of the update (Rajbhandari et al., 2020, stage
+        1), expressed as GSPMD sharding constraints on the existing
+        math so XLA derives the collectives:
+
+        1. the weight gradient is constrained to the data-axis-sharded
+           layout — GSPMD fuses the data-parallel reduction with the
+           constraint into a reduce-scatter (half the bytes of the
+           replicated path's all-reduce);
+        2. momentum/decay/clip run on each chip's 1/N shard, and the
+           momentum accumulator is STORED sharded (its Vector carries
+           ``data_shard_dim`` — per-chip optimizer state shrinks by
+           the data-axis size);
+        3. the updated shard is constrained back to the gathered
+           layout — one all-gather returns the params every forward
+           expects.
+
+        Indivisible dims are zero-padded to a multiple of the axis
+        size (the accumulator is stored padded; grads/params pad and
+        slice in flight — pad rows carry exact zeros through every
+        step).  Model-axis sharding (TP) composes: the spec pair keeps
+        ``model_shard_dim`` on the model axis in both layouts.
+        """
+        from jax.sharding import NamedSharding
+        from znicz_tpu.parallel.mesh import zero1_partition, zero1_specs
+        mesh = self.device.mesh
+        model_dim = getattr(vec, "model_shard_dim", None)
+        if acc_vec is not None and acc_vec \
+                and acc_vec.data_shard_dim is not None:
+            dim, pad = acc_vec.data_shard_dim, acc_vec.data_shard_pad
+        else:
+            dim, pad = zero1_partition(vec.shape,
+                                       self.device.n_data_shards,
+                                       model_dim)
+        if dim is None:  # nothing shardable: keep the replicated form
+            w = vec.devmem
+            g = self._regularized(jnp, self._clipped(jnp, grad), w, decay)
+            if moment:
+                acc = moment * acc_vec.devmem.astype(jnp.float32) - lr * g
+                acc_vec.devmem = acc
+                vec.devmem = w + acc
+            else:
+                vec.devmem = w - lr * g
+            return
+        sharded_spec, gathered_spec = zero1_specs(
+            mesh, len(vec.shape), dim, model_dim)
+        sharded = NamedSharding(mesh, sharded_spec)
+        gathered = NamedSharding(mesh, gathered_spec)
+        w = vec.devmem
+        if self._grad_comms_bf16:
+            # the reduce-scatter moves bf16 bytes; shard math upcasts
+            grad = grad.astype(jnp.bfloat16)
+        if pad:
+            widths = [(0, 0)] * grad.ndim
+            widths[dim] = (0, pad)
+            grad = jnp.pad(grad, widths)
+            w = jnp.pad(w, widths)
+        g = jax.lax.with_sharding_constraint(grad, sharded)
+        g = g.astype(jnp.float32)
+        w_shard = jax.lax.with_sharding_constraint(w, sharded)
+        g = self._regularized(jnp, self._clipped(jnp, g), w_shard, decay)
+        if moment:
+            acc = moment * acc_vec.devmem.astype(jnp.float32) - lr * g
+            acc_vec.devmem = jax.lax.with_sharding_constraint(acc, sharded)
+            new_w = w_shard + acc
+        else:
+            new_w = w_shard - lr * g
+        new_w = jax.lax.with_sharding_constraint(new_w, gathered)
+        if pad:
+            idx = [slice(None)] * new_w.ndim
+            idx[dim] = slice(0, vec.shape[dim])
+            new_w = new_w[tuple(idx)]
+        vec.devmem = new_w
 
 
 # ----------------------------------------------------------------------

@@ -26,4 +26,7 @@ from znicz_tpu.parallel.mesh import (  # noqa: F401
     shard_map_fn,
     shard_map_unchecked,
     spec_divides,
+    zero1_choice,
+    zero1_partition,
+    zero1_specs,
 )

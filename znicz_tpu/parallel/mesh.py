@@ -111,6 +111,79 @@ def shard_shape(mesh: Mesh, shape, spec) -> tuple:
     return tuple(out)
 
 
+# ----------------------------------------------------------------------
+# ZeRO-1 data-axis optimizer sharding (Rajbhandari et al., 2020, stage 1)
+# ----------------------------------------------------------------------
+def zero1_choice(device) -> bool:
+    """Resolve the ``root.common.engine.zero1`` gate against a device.
+
+    Auto (the default) engages whenever the device's mesh has a data
+    axis of size > 1 — the regime where the replicated update wastes
+    both HBM (N identical momentum copies) and ICI (an all-reduce
+    moving 2× the bytes of the reduce-scatter + all-gather pair).
+    ``root.common.engine.zero1 = False`` is the conservative opt-out;
+    host-only and single-data-shard devices always keep the replicated
+    update (nothing to shard over).
+    """
+    from znicz_tpu.utils.config import root
+    if device is None or device.is_host_only:
+        return False
+    mesh = getattr(device, "mesh", None)
+    if mesh is None or DATA_AXIS not in mesh.shape \
+            or mesh.shape[DATA_AXIS] < 2:
+        return False
+    gate = root.common.engine.get("zero1", "auto")
+    return gate not in (False, 0, "off", "false")
+
+
+def zero1_partition(shape, n_shards: int,
+                    model_shard_dim: int | None = None,
+                    ) -> tuple[int | None, int]:
+    """Pick ``(dim, pad)`` for sharding a parameter-shaped tensor over
+    the data axis in the ZeRO-1 update.
+
+    Preference order: the largest dim that divides evenly over
+    ``n_shards`` (pad 0); otherwise the largest dim overall, padded up
+    to the next multiple (jax shardings must divide evenly — the pad
+    rows are zeros, invisible to the update math, and snapshots slice
+    them off).  ``model_shard_dim`` is excluded — that dim already
+    rides the model axis and the two compose as a 2-D sharding.
+    Returns ``(None, 0)`` when there is nothing to shard (0-d, or
+    every dim is the model dim).
+    """
+    if n_shards < 2:
+        return None, 0
+    candidates = [(size, d) for d, size in enumerate(shape)
+                  if d != model_shard_dim and size > 0]
+    if not candidates:
+        return None, 0
+    even = [(size, d) for size, d in candidates if size % n_shards == 0]
+    if even:
+        size, dim = max(even, key=lambda t: (t[0], -t[1]))
+        return dim, 0
+    size, dim = max(candidates, key=lambda t: (t[0], -t[1]))
+    return dim, (-size) % n_shards
+
+
+def zero1_specs(mesh: Mesh, ndim: int, data_shard_dim: int,
+                model_shard_dim: int | None = None) -> tuple[P, P]:
+    """The (sharded, gathered) PartitionSpec pair for one ZeRO-1
+    parameter update: ``sharded`` places ``data_shard_dim`` on the
+    data axis (the reduce-scatter target and the stored layout of the
+    momentum), ``gathered`` keeps only the model axis (the layout
+    every forward expects back).  Constraining grad→sharded and
+    updated-param→gathered inside the jit region is what lets GSPMD
+    fuse the all-reduce into a reduce-scatter + all-gather pair at
+    half the bytes."""
+    sharded: list = [None] * ndim
+    gathered: list = [None] * ndim
+    sharded[data_shard_dim] = DATA_AXIS
+    if model_shard_dim is not None and model_shard_dim != data_shard_dim:
+        sharded[model_shard_dim] = MODEL_AXIS
+        gathered[model_shard_dim] = MODEL_AXIS
+    return P(*sharded), P(*gathered)
+
+
 def make_mesh(n_data: int | None = None, n_model: int = 1,
               devices=None) -> Mesh:
     """Build a (data, model) mesh over the available devices.

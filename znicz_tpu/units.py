@@ -200,7 +200,12 @@ class Unit(Logger):
                     # lockstep: map_read → device.get →
                     # process_allgather reassembles the full array
                 val.map_read()
-                out[name] = _np.array(val.mem, copy=True)
+                # ZeRO-1 state is stored data-axis-sharded and possibly
+                # zero-padded; the read above gathered the full array —
+                # slice the padding so the checkpoint holds the LOGICAL
+                # tensor, independent of the mesh size that wrote it
+                out[name] = _np.array(val.strip_data_pad(val.mem),
+                                      copy=True)
         for name in self.SNAPSHOT_ATTRS:
             out[name] = getattr(self, name)
         return out
@@ -211,7 +216,13 @@ class Unit(Logger):
         for name, val in state.items():
             cur = self.__dict__.get(name)
             if isinstance(cur, Vector):
-                cur.reset(_np.array(val, copy=True))
+                arr = _np.array(val, copy=True)
+                if cur and cur.data_shard_dim is not None:
+                    # re-shard for the CURRENT mesh: the live Vector's
+                    # padding (computed at initialize for this run's
+                    # data-axis size) may differ from the writer's
+                    arr = cur.apply_data_pad(arr)
+                cur.reset(arr)
             else:
                 setattr(self, name, val)
 

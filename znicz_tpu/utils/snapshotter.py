@@ -12,6 +12,16 @@ workflow from code, then :meth:`Workflow.load_state` — trajectory
 fidelity (epoch counters, best-error, RNG streams) is covered by
 tests.
 
+Checkpoints are **layout-independent**: sharded leaves are gathered on
+save (model-axis/TP shards via the lockstep collective read;
+data-axis/ZeRO-1 optimizer shards via the same read, with their
+divisibility zero-padding sliced off — ``Vector.strip_data_pad``) and
+re-sharded on load for whatever mesh the restoring run uses
+(``Unit.load_state`` re-pads to the live Vector's annotations, then
+the next upload re-places per ``XLADevice.sharding_for``).  A snapshot
+written by an 8-way ZeRO-1 run restores bitwise onto a 2-way mesh or
+a single device — ``tests/test_zero1.py`` pins this.
+
 Trigger semantics preserved: fires when the Decision unit raises
 ``improved`` (best-on-validation naming via ``snapshot_suffix``).
 """
