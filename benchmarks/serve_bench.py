@@ -1,0 +1,289 @@
+"""Serving A/B: seed per-exact-size path vs the bucketed AOT engine.
+
+Replays ragged open-loop traffic (Poisson arrivals, mixed request
+sizes) against the same exported forward chain twice:
+
+- **seed arm** — the pre-round-8 ``ExportedModel`` behavior
+  (``bucketing=False``): a synchronous, single-request server whose
+  program cache is keyed on the *exact* batch size, so every distinct
+  size in the stream pays a fresh trace+compile inline, while later
+  arrivals queue behind it (their latency includes the wait — the
+  queued measurement);
+- **bucketed arm** — :class:`znicz_tpu.serving.ServingEngine`: the
+  power-of-two bucket ladder is AOT-warmed before the first request,
+  the continuous batcher coalesces whatever is pending, and on a
+  multi-device backend the coalesced batch shards across the data
+  axis.
+
+Reports per arm: req/s over the replay window, enqueue→reply latency
+p50/p95/p99, programs compiled, and (bucketed) per-bucket occupancy.
+Writes SERVE_BENCH.json.  The claim to check on any platform:
+bucketed compiles ≤ ``log2(max_batch)+1`` programs vs
+one-per-distinct-size for the seed, with ≥ 2× req/s on the mixed-size
+replay from compile amortization alone.  CPU-container caveat: chip
+p99 numbers are the queued measurement through the tunnel — re-run on
+a real slice for serving latency truth.
+
+Run: ``python benchmarks/serve_bench.py`` (env: SERVE_N=240
+SERVE_RATE=400 SERVE_MAX_BATCH=64 SERVE_DELAY_MS=5 SERVE_DEVICES=0
+SERVE_SEED_ARM=1 SERVE_EPOCHS=2; SERVE_DEVICES=N forces an N-way
+virtual mesh, SERVE_TPU=1 keeps the ambient platform).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+N_REQUESTS = int(os.environ.get("SERVE_N", "240"))
+RATE = float(os.environ.get("SERVE_RATE", "400"))  # offered req/s
+MAX_BATCH = int(os.environ.get("SERVE_MAX_BATCH", "64"))
+DELAY_MS = float(os.environ.get("SERVE_DELAY_MS", "5"))
+N_DEVICES = int(os.environ.get("SERVE_DEVICES", "0"))  # 0 = single
+SEED_ARM = os.environ.get("SERVE_SEED_ARM", "1") == "1"
+EPOCHS = int(os.environ.get("SERVE_EPOCHS", "2"))
+
+
+def _ensure_platform() -> None:
+    import jax
+    if os.environ.get("SERVE_TPU") != "1":
+        n = max(1, N_DEVICES)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+        for opt, val in (("jax_platforms", "cpu"),
+                         ("jax_num_cpu_devices", n)):
+            try:
+                jax.config.update(opt, val)
+            except (RuntimeError, AttributeError):
+                pass
+
+
+def train_and_export(path: str, dim: int = 16, n_classes: int = 5,
+                     epochs: int = EPOCHS) -> str:
+    """A small FC net on gaussian blobs — trains in seconds on CPU,
+    enough model to make per-size compiles visible."""
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils import prng
+
+    rng = np.random.default_rng(7)
+    centers = rng.normal(0, 1, size=(n_classes, dim))
+    data = np.concatenate([
+        c + 0.3 * rng.normal(size=(96, dim)) for c in centers
+    ]).astype(np.float32)
+    labels = np.repeat(np.arange(n_classes), 96).astype(np.int32)
+    order = rng.permutation(len(data))
+    data, labels = data[order], labels[order]
+    prng.seed_all(71)
+    wf = StandardWorkflow(
+        name="serve_bench",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:384], train_labels=labels[:384],
+            valid_data=data[384:], valid_labels=labels[384:],
+            minibatch_size=64),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 64},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "softmax",
+             "->": {"output_sample_shape": n_classes},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        ],
+        decision_config={"max_epochs": epochs})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    wf.export_forward(path)
+    return path
+
+
+def make_trace(n: int, rate: float, max_batch: int, dim: int,
+               seed: int = 23):
+    """Open-loop ragged traffic: Poisson arrivals (exponential gaps at
+    ``rate`` req/s), request sizes mixed — 40% uniform 1..max (the
+    ragged tail that kills an exact-size cache), 35% full buckets, 25%
+    singles (interactive traffic)."""
+    rng = np.random.default_rng(seed)
+    gaps = rng.exponential(1.0 / rate, size=n)
+    arrivals = np.cumsum(gaps)
+    sizes = np.where(
+        rng.random(n) < 0.40,
+        rng.integers(1, max_batch + 1, size=n),
+        np.where(rng.random(n) < 0.58, max_batch, 1))
+    payloads = [rng.normal(0, 1, size=(int(s), dim)).astype(np.float32)
+                for s in sizes]
+    return list(zip(arrivals.tolist(),
+                    [int(s) for s in sizes], payloads))
+
+
+def _percentiles(lat_s: list[float]) -> dict:
+    if not lat_s:
+        return {}
+    arr = np.sort(np.asarray(lat_s))
+
+    def pct(q):
+        return round(1e3 * float(
+            arr[min(len(arr) - 1, int(round(q / 100 * (len(arr) - 1))))]
+        ), 3)
+
+    return {"p50": pct(50), "p95": pct(95), "p99": pct(99),
+            "mean": round(1e3 * float(arr.mean()), 3)}
+
+
+def replay_seed(model, trace) -> tuple:
+    """The seed serving story: one synchronous call per request, FIFO.
+    Latency counts from the request's ARRIVAL time — a request stuck
+    behind someone else's compile pays for it (queued measurement)."""
+    lat = []
+    outputs = []
+    t0 = time.monotonic()
+    done = t0
+    for arrival, _n, x in trace:
+        now = time.monotonic()
+        t_arr = t0 + arrival
+        if now < t_arr:
+            time.sleep(t_arr - now)
+        outputs.append(np.asarray(model(x)))
+        done = time.monotonic()
+        lat.append(done - max(t_arr, t0))
+    wall = done - (t0 + trace[0][0])
+    return {
+        "arm": "seed-exact-size",
+        "requests": len(trace),
+        "req_per_s": round(len(trace) / wall, 2),
+        "rows_per_s": round(sum(n for _, n, _ in trace) / wall, 1),
+        "latency_ms": _percentiles(lat),
+        "programs_compiled": model.compile_count,
+        "programs_live": len(model._programs),
+        "distinct_sizes": len({n for _, n, _ in trace}),
+        "wall_s": round(wall, 3),
+    }, outputs
+
+
+def replay_engine(engine, trace) -> tuple:
+    """Open-loop replay through the continuous batcher."""
+    from znicz_tpu.serving import QueueFull
+
+    futures = []
+    rejects = 0
+    t0 = time.monotonic()
+    for arrival, _n, x in trace:
+        now = time.monotonic()
+        t_arr = t0 + arrival
+        if now < t_arr:
+            time.sleep(t_arr - now)
+        while True:
+            try:
+                futures.append(engine.submit(x))
+                break
+            except QueueFull:  # open loop with bounded retry
+                rejects += 1
+                time.sleep(0.002)
+    outputs = [np.asarray(f.result(timeout=300)) for f in futures]
+    wall = time.monotonic() - (t0 + trace[0][0])
+    stats = engine.stats()
+    return {
+        "arm": "bucketed-aot",
+        "requests": len(trace),
+        "req_per_s": round(len(trace) / wall, 2),
+        "rows_per_s": round(sum(n for _, n, _ in trace) / wall, 1),
+        "latency_ms": stats.get("latency_ms", {}),
+        "programs_compiled": stats["programs_compiled"],
+        "programs_live": stats["programs_live"],
+        "warmup_seconds": stats["warmup_seconds"],
+        "replicas": stats["replicas"],
+        "buckets": stats["buckets"],
+        "backpressure_retries": rejects,
+        "wall_s": round(wall, 3),
+    }, outputs
+
+
+def run(n_requests: int = N_REQUESTS, rate: float = RATE,
+        max_batch: int = MAX_BATCH, delay_ms: float = DELAY_MS,
+        n_devices: int = N_DEVICES, seed_arm: bool = SEED_ARM,
+        bundle: str | None = None) -> dict:
+    import jax
+
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.export import ExportedModel
+    from znicz_tpu.serving import ServingEngine
+
+    dim = 16
+    if bundle is None:
+        bundle = os.path.join("/tmp", f"serve_bench_{os.getpid()}.npz")
+        train_and_export(bundle, dim=dim)
+    trace = make_trace(n_requests, rate, max_batch, dim)
+
+    report: dict = {
+        "bench": "serve_bench",
+        "date": time.strftime("%Y-%m-%d"),
+        "platform": jax.devices()[0].platform,
+        "config": {
+            "n_requests": n_requests, "offered_rate_req_s": rate,
+            "max_batch": max_batch, "max_delay_ms": delay_ms,
+            "n_devices": n_devices or 1,
+        },
+    }
+
+    seed_out = None
+    if seed_arm:
+        seed_model = ExportedModel.load(bundle, device=XLADevice(),
+                                        bucketing=False)
+        report["seed"], seed_out = replay_seed(seed_model, trace)
+
+    if n_devices > 1:
+        from znicz_tpu.parallel import make_mesh
+        device = XLADevice(mesh=make_mesh(
+            n_data=n_devices, n_model=1,
+            devices=jax.devices()[:n_devices]))
+    else:
+        device = XLADevice()
+    engine = ServingEngine(bundle, max_batch=max_batch,
+                           max_delay_ms=delay_ms, device=device)
+    engine.start()
+    report["bucketed"], eng_out = replay_engine(engine, trace)
+    engine.shutdown()
+
+    cap = int(math.log2(max_batch)) + 1
+    report["bucketed"]["compile_cap_log2"] = cap
+    assert report["bucketed"]["programs_compiled"] <= cap, report
+    if seed_arm and seed_out is not None:
+        for i in range(0, len(trace), max(1, len(trace) // 16)):
+            np.testing.assert_allclose(
+                np.asarray(eng_out[i], dtype=np.float32),
+                np.asarray(seed_out[i], dtype=np.float32),
+                atol=1e-4, err_msg=f"request {i} diverged between arms")
+        report["ab"] = {
+            "req_per_s_ratio": round(
+                report["bucketed"]["req_per_s"]
+                / report["seed"]["req_per_s"], 2),
+            "compiles_seed": report["seed"]["programs_compiled"],
+            "compiles_bucketed": report["bucketed"]["programs_compiled"],
+            "outputs_checked": "allclose(atol=1e-4) on sampled requests",
+        }
+    return report
+
+
+def main() -> None:
+    _ensure_platform()
+    report = run()
+    out = os.path.join(REPO, "SERVE_BENCH.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

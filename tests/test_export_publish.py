@@ -173,16 +173,112 @@ def test_publisher_fires_on_completion(tmp_path):
 
 
 def test_export_ragged_batches_cached_xla(tmp_path):
-    """Alternating batch sizes reuse cached per-size programs and
-    keep producing identical outputs."""
+    """Ragged batch sizes round up to the power-of-two bucket ladder,
+    reuse cached AOT programs, and keep producing identical outputs
+    (the padded rows never leak)."""
     wf = train_wine(XLADevice())
     path = str(tmp_path / "wine.npz")
     export_forward(wf, path)
     model = ExportedModel.load(path, device=XLADevice())
     data, _ = make_data()
     a = model(data[:8])
-    b = model(data[:3])
-    a2 = model(data[:8])  # cache hit for size 8
+    b = model(data[:3])   # bucket 4, tail row padded
+    a2 = model(data[:8])  # cache hit for bucket 8
     np.testing.assert_allclose(a, a2, atol=1e-6)
     np.testing.assert_allclose(a[:3], b, atol=1e-4)
-    assert set(model._by_batch) == {8, 3}
+    assert set(model._programs) == {8, 4}
+    assert model.compile_count == 2
+    assert model.program_hits[8] == 1
+    c = model(data[:6])   # size 6 shares bucket 8 — no new program
+    assert model.compile_count == 2
+    np.testing.assert_allclose(c, a[:6], atol=1e-4)
+
+
+def test_export_compile_cache_lru_bounded(tmp_path):
+    """Round-8 regression: a 100-distinct-size request stream keeps at
+    most ``log2(max_batch)+1`` live programs (the seed cached one
+    program per exact size, forever)."""
+    import math
+
+    wf = train_wine(XLADevice())
+    path = str(tmp_path / "wine.npz")
+    export_forward(wf, path)
+    model = ExportedModel.load(path, device=XLADevice())
+    data, _ = make_data()
+    cap = int(math.log2(model.max_batch)) + 1
+    for n in range(1, 101):
+        out = model(data[:n] if n <= len(data)
+                    else np.tile(data, (2, 1))[:n])
+        assert out.shape[0] == n
+        assert len(model._programs) <= cap
+    # 100 sizes share the pow2 buckets: compiles ≤ cap, not 100
+    assert model.compile_count <= cap
+    # oversized one-offs (> max_batch) pass through the LRU without
+    # pinning programs: 6 distinct buckets through a cap-4 cache
+    small = ExportedModel.load(path, device=XLADevice(), max_batch=8)
+    for n in (1, 3, 5, 9, 20, 33):
+        assert small(data[:n]).shape == (n, 3)
+    assert len(small._programs) <= int(math.log2(8)) + 1
+    assert 1 not in small._programs  # the cold first bucket fell out
+
+
+def test_export_bucketing_off_restores_exact_size_cache(tmp_path):
+    """``bucketing=False`` is the seed behavior (A/B arm of
+    serve_bench): one program per exact batch size, no rounding."""
+    wf = train_wine(XLADevice())
+    path = str(tmp_path / "wine.npz")
+    export_forward(wf, path)
+    model = ExportedModel.load(path, device=XLADevice(),
+                               bucketing=False)
+    data, _ = make_data()
+    for n in (8, 3, 5):
+        model(data[:n])
+    assert set(model._programs) == {8, 3, 5}
+    assert model.compile_count == 3
+
+
+def test_export_respects_bf16_manifest_dtype(tmp_path):
+    """A net trained under the bf16 precision mode serves in bf16 —
+    the manifest records the trained dtype and ``__call__`` no longer
+    silently upcasts every request to float32."""
+    from znicz_tpu.utils.config import root
+
+    root.common.precision_type = "bfloat16"
+    wf = train_wine(XLADevice())
+    path = str(tmp_path / "wine_bf16.npz")
+    export_forward(wf, path)
+    assert wf.device.compute_dtype == np.dtype("bfloat16")
+
+    # reload into a DEFAULT (f32) config — the bundle must carry its
+    # own precision mode
+    from znicz_tpu.utils.config import reset_root
+    reset_root()
+    model = ExportedModel.load(path, device=XLADevice())
+    assert model.manifest["dtype"] == "bfloat16"
+    assert model.serve_dtype == np.dtype("bfloat16")
+    assert model.device.compute_dtype == np.dtype("bfloat16")
+    data, _ = make_data()
+    probs = np.asarray(model(data[:8]), dtype=np.float32)
+    assert probs.shape == (8, 3)
+    # the f64 input was cast to bf16, not f32: the chain ran the
+    # trained mode end to end
+    assert model._input_vec.dtype == np.dtype("bfloat16")
+    np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=2e-2)
+    want = np.asarray(ExportedModel.load(
+        path, device=NumpyDevice())(data[:8]), dtype=np.float32)
+    np.testing.assert_allclose(probs, want, atol=6e-2)
+
+
+def test_export_f32_manifest_keeps_f32_serving(tmp_path):
+    """The dtype manifest entry round-trips float32 unchanged (and
+    pre-round-8 bundles without the key default to f32)."""
+    wf = train_wine(XLADevice())
+    path = str(tmp_path / "wine.npz")
+    export_forward(wf, path)
+    model = ExportedModel.load(path, device=XLADevice())
+    assert model.manifest["dtype"] == "float32"
+    assert model.serve_dtype == np.dtype(np.float32)
+    manifest = dict(model.manifest)
+    manifest.pop("dtype")  # a seed-era bundle
+    legacy = ExportedModel(manifest, model._params, device=XLADevice())
+    assert legacy.serve_dtype == np.dtype(np.float32)

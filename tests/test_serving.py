@@ -1,0 +1,370 @@
+"""Serving engine tests: bucket math, continuous batching under
+ragged concurrent traffic, backpressure, admission-window timing,
+data-parallel replication, telemetry (round 8).
+
+All CPU / tier-1 safe: the engine compiles small FC programs on the
+virtual 8-device platform the conftest forces."""
+
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import XLADevice
+from znicz_tpu.export import ExportedModel
+from znicz_tpu.serving import (ContinuousBatcher, QueueFull,
+                               ServingEngine, bucket_for, ladder,
+                               next_pow2)
+from znicz_tpu.utils import prng
+
+
+# ----------------------------------------------------------------------
+# bucket-ladder math
+# ----------------------------------------------------------------------
+def test_next_pow2():
+    assert [next_pow2(n) for n in (1, 2, 3, 4, 5, 63, 64, 65)] == \
+        [1, 2, 4, 4, 8, 64, 64, 128]
+    with pytest.raises(ValueError):
+        next_pow2(0)
+
+
+def test_bucket_for_plain_ladder():
+    assert [bucket_for(n) for n in (1, 2, 3, 5, 8, 9, 64)] == \
+        [1, 2, 4, 8, 8, 16, 64]
+
+
+def test_bucket_for_aligned_ladder():
+    # data-parallel alignment: every bucket divides over the mesh
+    assert [bucket_for(n, align=8) for n in (1, 8, 9, 16, 17, 64)] == \
+        [8, 8, 16, 16, 32, 64]
+    assert bucket_for(5, align=6) == 6
+    assert bucket_for(13, align=6) == 24
+
+
+def test_ladder_covers_max_batch():
+    assert ladder(64) == [1, 2, 4, 8, 16, 32, 64]
+    assert ladder(64, align=8) == [8, 16, 32, 64]
+    assert ladder(48, align=8) == [8, 16, 32, 64]  # covers 48
+    assert ladder(1) == [1]
+    for mb in (1, 7, 64, 100, 1024):
+        assert len(ladder(mb)) <= int(math.log2(next_pow2(mb))) + 1
+        assert ladder(mb)[-1] >= mb
+
+
+# ----------------------------------------------------------------------
+# the batcher alone (no jax): coalescing policy, failure isolation
+# ----------------------------------------------------------------------
+def test_batcher_coalesces_fifo_and_preserves_rows():
+    batches = []
+    done = threading.Event()
+
+    def run_batch(reqs):
+        batches.append([r.n for r in reqs])
+        for r in reqs:
+            r.future.set_result(r.x * 2)
+        if sum(len(b) for b in batches) >= 3:
+            done.set()
+
+    b = ContinuousBatcher(run_batch, max_batch=8, max_delay_ms=150,
+                          max_queue=64)
+    f1 = b.submit(np.ones((3, 2)))
+    f2 = b.submit(np.full((2, 2), 5.0))
+    f3 = b.submit(np.ones((4, 2)))  # 3+2+4 > 8: lands in batch 2
+    assert done.wait(5)
+    b.shutdown()
+    np.testing.assert_array_equal(f2.result(1), np.full((2, 2), 10.0))
+    assert f1.result(1).shape == (3, 2) and f3.result(1).shape == (4, 2)
+    # FIFO prefix: first flush takes 3+2 (4 would overflow the bucket)
+    assert batches[0] == [3, 2]
+    assert [3, 2, 4] == [n for bat in batches for n in bat]
+
+
+def test_batcher_run_batch_failure_fails_only_that_batch():
+    calls = []
+
+    def run_batch(reqs):
+        calls.append(len(reqs))
+        if len(calls) == 1:
+            raise RuntimeError("boom")
+        for r in reqs:
+            r.future.set_result(r.x)
+
+    b = ContinuousBatcher(run_batch, max_batch=4, max_delay_ms=0,
+                          max_queue=16)
+    f1 = b.submit(np.ones((1, 1)))
+    with pytest.raises(RuntimeError, match="boom"):
+        f1.result(5)
+    f2 = b.submit(np.ones((1, 1)))  # scheduler survived
+    assert f2.result(5).shape == (1, 1)
+    b.shutdown()
+
+
+def test_batcher_rejects_oversized_and_shutdown_submits():
+    b = ContinuousBatcher(lambda reqs: None, max_batch=4,
+                          max_delay_ms=0, max_queue=8)
+    with pytest.raises(ValueError, match="max_batch"):
+        b.submit(np.ones((5, 1)))
+    b.shutdown()
+    with pytest.raises(RuntimeError, match="shut down"):
+        b.submit(np.ones((1, 1)))
+    with pytest.raises(ValueError, match="max_queue"):
+        ContinuousBatcher(lambda reqs: None, max_batch=16, max_queue=8)
+
+
+# ----------------------------------------------------------------------
+# engine over a real exported model
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def bundle(tmp_path_factory):
+    """One small trained+exported FC net shared by the engine tests
+    (training it per-test would triple the file's runtime)."""
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+
+    rng = np.random.default_rng(3)
+    dim, n_classes = 12, 4
+    centers = rng.normal(0, 1, size=(n_classes, dim))
+    data = np.concatenate([
+        c + 0.3 * rng.normal(size=(48, dim)) for c in centers
+    ]).astype(np.float32)
+    labels = np.repeat(np.arange(n_classes), 48).astype(np.int32)
+    order = rng.permutation(len(data))
+    data, labels = data[order], labels[order]
+    prng.seed_all(5)
+    wf = StandardWorkflow(
+        name="serve_test",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:160], train_labels=labels[:160],
+            valid_data=data[160:], valid_labels=labels[160:],
+            minibatch_size=32),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 24},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": n_classes},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        ],
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    path = str(tmp_path_factory.mktemp("serving") / "serve_test.npz")
+    wf.export_forward(path)
+    return path, data
+
+
+def test_engine_ragged_concurrent_equals_sequential_oracle(bundle):
+    """N threads submitting random-size requests receive the rows a
+    sequential per-request serve produces: coalescing, bucket padding
+    and reply splitting never leak a padded row or mix up request
+    boundaries.  Concurrent replies match the oracle to float32 ulp
+    (coalescing can land a request in a LARGER bucket, and XLA
+    vectorizes the softmax reduction differently per batch size);
+    when a request rides the SAME bucket as the oracle the reply is
+    bit-exact — asserted in the sequential pass below."""
+    path, data = bundle
+    device = XLADevice()  # single device: replication tested separately
+    model = ExportedModel.load(path, device=device, max_batch=16)
+    rng = np.random.default_rng(11)
+    requests = [
+        np.ascontiguousarray(
+            data[rng.integers(0, len(data) - 16):][:n]).astype(np.float32)
+        for n in rng.integers(1, 17, size=32)
+    ]
+    # sequential oracle BEFORE the engine starts (shares the program
+    # cache; the scheduler thread must be the only concurrent caller)
+    oracle = [model(x) for x in requests]
+
+    engine = ServingEngine(model, max_batch=16, max_delay_ms=3.0,
+                           device=device)
+    engine.start()
+    compiles_after_warmup = model.compile_count
+    results: dict[int, np.ndarray] = {}
+    errors: list = []
+
+    def client(worker: int) -> None:
+        try:
+            for i in range(worker, len(requests), 4):
+                results[i] = engine.submit(requests[i]).result(timeout=60)
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=client, args=(w,))
+               for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert len(results) == len(requests)
+    for i, want in enumerate(oracle):
+        assert results[i].shape == want.shape, f"request {i}"
+        np.testing.assert_allclose(
+            results[i], want, rtol=1e-5, atol=2e-6,
+            err_msg=f"request {i} (rows={len(requests[i])})")
+    # sequential pass: one request per dispatch rides the oracle's own
+    # bucket — replies must be BIT-exact (any padded-row leak or row
+    # mixup shows up here with zero tolerance)
+    for i in range(0, len(requests), 3):
+        np.testing.assert_array_equal(
+            engine.submit(requests[i]).result(timeout=60), oracle[i],
+            err_msg=f"sequential request {i}")
+    # zero compiles at serve time: warmup covered the whole ladder
+    assert model.compile_count == compiles_after_warmup
+    st = engine.stats()
+    assert st["served"] >= len(requests)
+    assert st["programs_compiled"] <= math.log2(16) + 1
+    engine.shutdown()
+
+
+def test_engine_replicates_over_data_mesh(bundle):
+    """Auto-replication shards coalesced batches over the 8-device
+    virtual mesh: one program per bucket, every bucket divisible by
+    the data-axis size, outputs matching the single-device serve."""
+    path, data = bundle
+    single = ExportedModel.load(path, device=XLADevice())
+    want8, want3 = single(data[:8]), single(data[40:43])
+
+    engine = ServingEngine(path, max_batch=32, max_delay_ms=2.0)
+    engine.start()
+    assert engine.n_replicas == 8
+    assert all(b % 8 == 0 for b in engine.stats()["buckets_warmed"])
+    got8 = engine(data[:8], timeout=60)
+    got3 = engine(data[40:43], timeout=60)
+    np.testing.assert_allclose(got8, want8, atol=1e-5)
+    np.testing.assert_allclose(got3, want3, atol=1e-5)
+    batch = engine.model._input_vec.devmem
+    assert len(batch.sharding.device_set) == 8, \
+        "coalesced batch not sharded over the data axis"
+    status = engine.serving_status()
+    assert status["mesh"] == {"data": 8, "model": 1}
+    assert status["replicas"] == 8
+    engine.shutdown()
+
+
+def test_engine_replicate_gate_off(bundle):
+    """``root.common.serving.replicate = False`` keeps serving on one
+    device even with 8 visible."""
+    from znicz_tpu.utils.config import root
+
+    path, _data = bundle
+    root.common.serving.replicate = False
+    engine = ServingEngine(path, max_batch=8, max_delay_ms=1.0)
+    engine.start()
+    assert engine.n_replicas == 1
+    assert engine.stats()["buckets_warmed"] == [1, 2, 4, 8]
+    engine.shutdown()
+
+
+def test_engine_backpressure_queue_full(bundle):
+    """A full bounded queue rejects with QueueFull instead of growing
+    without limit; a later flush drains what was admitted."""
+    path, data = bundle
+    engine = ServingEngine(path, max_batch=8, max_delay_ms=10_000.0,
+                           max_queue=8,
+                           device=XLADevice())
+    engine.start()
+    f1 = engine.submit(data[:3])
+    f2 = engine.submit(data[3:6])  # 6 rows pending < 8: no flush yet
+    with pytest.raises(QueueFull):
+        engine.submit(data[6:9])   # 9 > max_queue
+    assert engine.requests_rejected == 1
+    engine.flush()
+    assert f1.result(30).shape == (3, 4)
+    assert f2.result(30).shape == (3, 4)
+    engine.shutdown()
+
+
+def test_engine_max_delay_admission_window(bundle):
+    """A lone request waits out ``max_delay_ms`` for company (lower
+    bound is exact — nothing may flush earlier), while a full bucket
+    flushes immediately without waiting the window."""
+    path, data = bundle
+    engine = ServingEngine(path, max_batch=8, max_delay_ms=300.0,
+                           device=XLADevice())
+    engine.start()
+    t0 = time.monotonic()
+    engine.submit(data[:1]).result(30)
+    lone = time.monotonic() - t0
+    assert lone >= 0.28, f"flushed {lone * 1e3:.0f}ms into a 300ms window"
+
+    t0 = time.monotonic()
+    engine.submit(data[:8]).result(30)  # full bucket: no waiting
+    full = time.monotonic() - t0
+    assert full < 0.28, f"full bucket waited {full * 1e3:.0f}ms"
+    engine.shutdown()
+
+
+def test_engine_shutdown_drains_pending(bundle):
+    path, data = bundle
+    engine = ServingEngine(path, max_batch=8, max_delay_ms=10_000.0,
+                           device=XLADevice())
+    engine.start()
+    futures = [engine.submit(data[i:i + 2]) for i in (0, 2, 4)]
+    engine.shutdown()  # must serve everything admitted, then stop
+    for f in futures:
+        assert f.result(1).shape == (2, 4)
+    with pytest.raises(RuntimeError):
+        engine.submit(data[:1])
+
+
+def test_engine_rejects_bad_shapes_and_sizes(bundle):
+    path, data = bundle
+    engine = ServingEngine(path, max_batch=4, max_delay_ms=1.0,
+                           device=XLADevice())
+    engine.start()
+    with pytest.raises(ValueError, match="sample shape"):
+        engine.submit(np.zeros((2, 5), np.float32))
+    with pytest.raises(ValueError, match="max_batch"):
+        engine.submit(data[:5])  # 5 rows > max_batch 4: split upstream
+    engine.shutdown()
+
+
+def test_web_status_renders_engine(bundle):
+    """A registered engine reports through the same /status.json feed
+    as training workflows."""
+    import json
+    import urllib.request
+
+    from znicz_tpu.web_status import WebStatusServer, gather_status
+
+    path, data = bundle
+    engine = ServingEngine(path, max_batch=8, max_delay_ms=1.0,
+                           device=XLADevice())
+    engine.start()
+    engine(data[:4], timeout=60)
+    snap = gather_status(engine)
+    assert snap["engine"] == "bucketed-aot"
+    assert snap["served"] == 1 and snap["replicas"] == 1
+    server = WebStatusServer(port=0)
+    try:
+        server.register(engine)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/status.json",
+                timeout=10) as resp:
+            feed = json.load(resp)
+        entry = feed["workflows"][0]
+        assert entry["name"].startswith("serving:")
+        assert entry["latency_ms"]["window"] == 1
+        assert entry["buckets"]["4"]["occupancy_pt"] == 100.0
+    finally:
+        server.stop()
+        engine.shutdown()
+
+
+@pytest.mark.slow
+def test_serve_bench_soak():
+    """The serve_bench replay end-to-end (small trace): the bucketed
+    arm must compile ≤ log2(max_batch)+1 programs vs one-per-distinct-
+    size for the seed arm, agree with it on outputs, and win on
+    throughput."""
+    import benchmarks.serve_bench as sb
+
+    report = sb.run(n_requests=60, rate=400.0, max_batch=16,
+                    delay_ms=3.0, n_devices=0, seed_arm=True)
+    cap = int(math.log2(16)) + 1
+    assert report["bucketed"]["programs_compiled"] <= cap
+    assert report["seed"]["programs_compiled"] > cap
+    assert report["ab"]["req_per_s_ratio"] > 1.0
+    assert report["bucketed"]["requests"] == 60

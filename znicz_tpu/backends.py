@@ -68,6 +68,13 @@ class Device(Logger):
     def sync(self) -> None:
         """Block until queued device work completes."""
 
+    @property
+    def supports_donation(self) -> bool:
+        """True when XLA implements input-buffer donation on this
+        platform (TPU/GPU).  The serving engine's AOT programs donate
+        the request buffer when they can — CPU only warns."""
+        return False
+
 
 class NumpyDevice(Device):
     """Host-only oracle backend (reference: ``NumpyDevice``)."""
@@ -123,6 +130,10 @@ class XLADevice(Device):
                    "mesh=%s)", device, device.platform, self.compute_dtype,
                    self.matmul_precision,
                    None if mesh is None else dict(mesh.shape))
+
+    @property
+    def supports_donation(self) -> bool:
+        return self.jax_device.platform in ("tpu", "gpu", "cuda", "rocm")
 
     @property
     def n_data_shards(self) -> int:

@@ -6,12 +6,24 @@ a format a standalone C++ inference engine could execute without the
 training framework).
 
 TPU-native format: one ``.npz`` bundle holding a JSON manifest (layer
-types + constructor configs + input geometry) beside the parameter
-arrays.  :class:`ExportedModel` reloads the bundle **without any
-workflow, loader or training machinery** and rebuilds the forward
-chain from the layer-type registry — the same unit code that trained
-is the inference spec — then compiles it into a single jitted
-inference function (or runs the numpy oracle path).
+types + constructor configs + input geometry + trained compute dtype)
+beside the parameter arrays.  :class:`ExportedModel` reloads the
+bundle **without any workflow, loader or training machinery** and
+rebuilds the forward chain from the layer-type registry — the same
+unit code that trained is the inference spec — then compiles it ahead
+of time (or runs the numpy oracle path).
+
+Program cache (round 8): batch sizes round up to a power-of-two
+**bucket ladder** (``serving.buckets``) so a ragged request stream
+(64, 64, 37, 1, …) shares ``log2(max_batch)+1`` compiled programs
+instead of paying one trace+compile per distinct size, and residents
+are LRU-bounded so a one-off odd size can no longer pin a program
+forever.  Each program is ``jit(...).lower(...).compile()``d — real
+AOT, so :meth:`ExportedModel.warmup` at engine start means zero
+compiles at serve time — with the input buffer donated on platforms
+that support donation (TPU/GPU; XLA then reuses the request's HBM for
+intermediates instead of allocating fresh).  The throughput path on
+top of this cache is :class:`znicz_tpu.serving.ServingEngine`.
 """
 
 from __future__ import annotations
@@ -19,15 +31,22 @@ from __future__ import annotations
 import io
 import json
 import os
+from collections import Counter, OrderedDict
 
 import numpy as np
 
 from znicz_tpu.backends import Device, NumpyDevice
 from znicz_tpu.dummy import DummyUnit, DummyWorkflow
 from znicz_tpu.memory import Vector
+from znicz_tpu.utils.logger import Logger
+from znicz_tpu.serving.buckets import bucket_for, ladder
 
 FORMAT_NAME = "znicz-tpu-forward"
 FORMAT_VERSION = 1
+
+#: default ladder cap for direct ``ExportedModel`` use (the engine
+#: passes its own, typically much smaller, ``max_batch``)
+DEFAULT_MAX_BATCH = 1024
 
 
 def _manifest_for(workflow) -> dict:
@@ -49,12 +68,21 @@ def _manifest_for(workflow) -> dict:
             entry["tied_to"] = int(spec["tied_to"])
             entry["tied_weights"] = bool(spec.get("tied_weights"))
         layers.append(entry)
+    device = getattr(workflow, "device", None)
+    if device is not None:
+        dtype = np.dtype(device.compute_dtype)
+    else:
+        from znicz_tpu.utils.config import root
+        dtype = np.dtype(root.common.get("precision_type", "float32"))
     return {
         "format": FORMAT_NAME,
         "version": FORMAT_VERSION,
         "workflow": workflow.name,
         "loss": workflow.loss,
         "input_shape": list(workflow.loader.minibatch_data.shape[1:]),
+        # the precision mode the net TRAINED under — serving must run
+        # the same mode, not silently upcast bf16 nets to f32
+        "dtype": str(dtype),
         "layers": layers,
     }
 
@@ -81,18 +109,27 @@ def export_forward(workflow, path: str) -> str:
     return path
 
 
-class ExportedModel:
+class ExportedModel(Logger):
     """A servable forward chain loaded from an exported bundle.
 
-    ``model(x)`` maps a float32 batch (NHWC or flat, matching the
-    training loader's sample shape) to the final layer's output
-    (softmax head → class probabilities).  Stochastic layers (dropout)
-    run in eval mode.  The XLA path compiles the whole chain into one
-    program; the numpy path is the oracle."""
+    ``model(x)`` maps a batch (NHWC or flat, matching the training
+    loader's sample shape) to the final layer's output (softmax head →
+    class probabilities).  Inputs are cast to the MANIFEST dtype — the
+    precision mode the net trained under — not unconditionally to
+    float32.  Stochastic layers (dropout) run in eval mode.
+
+    XLA path: requests round up to the power-of-two bucket ladder and
+    run AOT-compiled programs from a bounded LRU cache (``max_batch``
+    caps the ladder; ``bucketing=False`` restores the historical
+    per-exact-size unbounded cache for A/B benchmarks).  The numpy
+    path is the oracle and always computes in float32."""
 
     def __init__(self, manifest: dict,
                  params: dict[str, np.ndarray],
-                 device: Device | None = None) -> None:
+                 device: Device | None = None,
+                 max_batch: int = DEFAULT_MAX_BATCH,
+                 bucketing: bool = True) -> None:
+        super().__init__()
         if manifest.get("format") != FORMAT_NAME:
             raise ValueError("not a znicz-tpu forward bundle")
         if manifest.get("version", 0) > FORMAT_VERSION:
@@ -102,20 +139,53 @@ class ExportedModel:
         self.manifest = manifest
         self.input_shape = tuple(manifest["input_shape"])
         self.device = device or Device.create()
+        self.dtype = np.dtype(manifest.get("dtype", "float32"))
+        if not self.device.is_host_only \
+                and self.device.compute_dtype != self.dtype:
+            # the chain must rebuild under the TRAINED precision mode
+            # (MXU input dtype, activation storage) — a bf16 net served
+            # through an f32-configured device would silently change
+            # the program that validated
+            self.device.compute_dtype = self.dtype
+        self.max_batch = int(max_batch)
+        self.bucketing = bucketing
         self._params = params
         self._params_loaded = False
-        self._by_batch: dict[int, "callable"] = {}  # jit fn per size
+        #: AOT programs keyed by PADDED batch size, LRU-ordered
+        self._programs: OrderedDict[int, "callable"] = OrderedDict()
+        self.program_hits: Counter = Counter()  # size → cache hits
+        self.compile_count = 0
         self._cur_batch: int | None = None
         self._build_chain()
 
     @classmethod
-    def load(cls, path: str,
-             device: Device | None = None) -> "ExportedModel":
+    def load(cls, path: str, device: Device | None = None,
+             **kwargs) -> "ExportedModel":
         with np.load(path) as bundle:
             manifest = json.loads(bytes(bundle["manifest"]).decode())
             params = {k: bundle[k] for k in bundle.files
                       if k != "manifest"}
-        return cls(manifest, params, device=device)
+        return cls(manifest, params, device=device, **kwargs)
+
+    # ------------------------------------------------------------------
+    @property
+    def serve_dtype(self) -> np.dtype:
+        """Input/compute dtype requests are cast to: the manifest
+        (training) dtype on accelerator devices; the numpy oracle
+        always runs float32."""
+        if self.device.is_host_only:
+            return np.dtype(np.float32)
+        return self.dtype
+
+    @property
+    def _align(self) -> int:
+        """Bucket alignment: on a data-parallel mesh every bucket must
+        divide evenly over the data axis."""
+        return max(1, getattr(self.device, "n_data_shards", 1))
+
+    @property
+    def _program_capacity(self) -> int:
+        return len(ladder(self.max_batch, self._align))
 
     # ------------------------------------------------------------------
     def _build_chain(self) -> None:
@@ -165,7 +235,7 @@ class ExportedModel:
         weights/bias, so only the input and intermediate activations
         reallocate per batch size."""
         self._input_vec.reset(np.zeros(
-            (batch,) + self.input_shape, dtype=np.float32))
+            (batch,) + self.input_shape, dtype=self.serve_dtype))
         self._input_vec.initialize(self.device)
         for i, unit in enumerate(self.forwards):
             if not self._params_loaded:
@@ -205,7 +275,24 @@ class ExportedModel:
         self._cur_batch = batch
 
     # ------------------------------------------------------------------
-    def _compile(self):
+    def _donate_choice(self) -> bool:
+        """Donate the request buffer into the program?  Auto: yes on
+        platforms where XLA implements input donation (TPU/GPU — the
+        input's HBM is then recycled for intermediates, so steady-state
+        serving allocates nothing per request); no on CPU, where
+        donation is unimplemented and only emits warnings.
+        ``root.common.serving.donate`` overrides."""
+        from znicz_tpu.utils.config import root
+        cfg = root.common.serving.get("donate", None)
+        if cfg is not None:
+            return bool(cfg)
+        return bool(getattr(self.device, "supports_donation", False))
+
+    def _aot_compile(self):
+        """AOT-compile the chain at the CURRENT batch size (the caller
+        just ran :meth:`_initialize`): ``jit(...).lower(...).compile()``
+        — the compile happens HERE, not on first call, so warmup really
+        front-loads every trace."""
         import jax
 
         vectors: list[Vector] = []
@@ -235,24 +322,67 @@ class ExportedModel:
                 for vec in vectors:
                     vec._tracing = False
 
-        jitted = jax.jit(fn)
+        donate = self._donate_choice()
+        jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
         leaves = [vec._devmem for vec in vectors]
         input_leaf = input_vec._devmem
 
+        def struct(arr):
+            return jax.ShapeDtypeStruct(
+                np.shape(arr), np.dtype(arr.dtype),
+                sharding=getattr(arr, "sharding", None))
+
+        compiled = jitted.lower(
+            struct(input_leaf), *[struct(leaf) for leaf in leaves]
+        ).compile()
+        # lowering traced fn, which wrote tracers into vec._devmem;
+        # restore the real arrays so later _initialize rounds (other
+        # bucket sizes) never snapshot a dead tracer
+        for vec, leaf in zip(vectors, leaves):
+            vec._devmem = leaf
+        input_vec._devmem = input_leaf
+        self.compile_count += 1
+
         def call(x):
-            out = jitted(x, *leaves)
-            # tracing wrote tracers into vec._devmem; restore the real
-            # arrays so later _initialize/_compile rounds (other batch
-            # sizes) never snapshot a dead tracer
-            for vec, leaf in zip(vectors, leaves):
-                vec._devmem = leaf
-            input_vec._devmem = input_leaf
-            return out
+            # x: host array or committed jax.Array of the padded
+            # bucket shape; donated to the program when enabled
+            return compiled(x, *leaves)
 
         return call
 
+    def program_for(self, size: int):
+        """The AOT program serving a PADDED batch of exactly ``size``
+        rows, compiled on first use and LRU-cached.  The engine warms
+        the whole ladder through this; ``__call__`` routes through it
+        after rounding up."""
+        fn = self._programs.get(size)
+        if fn is not None:
+            self._programs.move_to_end(size)
+            self.program_hits[size] += 1
+            return fn
+        self._initialize(size)
+        fn = self._aot_compile()
+        self._programs[size] = fn
+        if self.bucketing:
+            while len(self._programs) > self._program_capacity:
+                evicted, _ = self._programs.popitem(last=False)
+                self.debug("evicted program for batch %d (LRU, cap %d)",
+                           evicted, self._program_capacity)
+        return fn
+
+    def warmup(self, max_batch: int | None = None) -> int:
+        """Eagerly compile every ladder bucket up to ``max_batch``
+        (default: this model's cap) so serve time pays ZERO compiles.
+        Returns the number of programs compiled."""
+        if max_batch is not None:
+            self.max_batch = max(self.max_batch, int(max_batch))
+        before = self.compile_count
+        for size in ladder(max_batch or self.max_batch, self._align):
+            self.program_for(size)
+        return self.compile_count - before
+
     def __call__(self, x: np.ndarray) -> np.ndarray:
-        x = np.ascontiguousarray(x, dtype=np.float32)
+        x = np.ascontiguousarray(x, dtype=self.serve_dtype)
         if x.shape[1:] != self.input_shape:
             raise ValueError(f"input sample shape {x.shape[1:]} != "
                              f"exported {self.input_shape}")
@@ -267,13 +397,16 @@ class ExportedModel:
             out = self.forwards[-1].output
             out.map_read()
             return np.array(out.mem, copy=True)
-        # XLA: one compiled program per batch size, cached — ragged
-        # serving streams (64,64,37,64,…) pay each size's trace once
-        fn = self._by_batch.get(batch)
-        if fn is None:
-            self._initialize(batch)
-            fn = self._by_batch[batch] = self._compile()
-        return np.asarray(fn(x))
+        # XLA: round up to the bucket ladder; the padded rows compute
+        # garbage that is sliced off before anyone sees it
+        size = bucket_for(batch, self._align) if self.bucketing else batch
+        fn = self.program_for(size)
+        if size != batch:
+            padded = np.zeros((size,) + self.input_shape, dtype=x.dtype)
+            padded[:batch] = x
+            x = padded
+        out = np.asarray(fn(x))
+        return np.array(out[:batch]) if size != batch else out
 
     def predict_classes(self, x: np.ndarray) -> np.ndarray:
-        return np.argmax(self(x), axis=1)
+        return np.argmax(np.asarray(self(x), dtype=np.float32), axis=1)

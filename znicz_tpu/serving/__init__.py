@@ -1,0 +1,30 @@
+"""Serving: a throughput-oriented engine over the export format.
+
+The reference shipped trained nets to a standalone C++ engine
+(libZnicz) that served one synchronous request at a time.  This
+package is the TPU-native replacement for that serving story:
+continuous batching of asynchronously arriving requests (Orca-style)
+into a power-of-two bucket ladder of AOT-compiled programs, optionally
+replicated across a data-axis mesh (GSPMD) — one compiled program,
+N-chip throughput, zero compiles at serve time.
+
+Entry point::
+
+    from znicz_tpu.serving import ServingEngine
+    with ServingEngine("model.npz", max_batch=64) as engine:
+        probs = engine(x)               # sync
+        future = engine.submit(x)       # async → future
+
+See :mod:`znicz_tpu.serving.engine` for the design notes.
+"""
+
+from znicz_tpu.serving.batcher import (  # noqa: F401
+    ContinuousBatcher,
+    QueueFull,
+)
+from znicz_tpu.serving.buckets import (  # noqa: F401
+    bucket_for,
+    ladder,
+    next_pow2,
+)
+from znicz_tpu.serving.engine import ServingEngine  # noqa: F401

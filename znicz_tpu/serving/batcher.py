@@ -1,0 +1,161 @@
+"""Continuous batcher: an async request queue drained into buckets.
+
+The Orca insight (Yu et al., OSDI 2022) applied to this framework's
+forward path: requests arrive asynchronously and individually, but the
+accelerator wants large batches — so a scheduler thread coalesces
+whatever is pending into one batch per dispatch, instead of locking
+the serving loop to fixed request boundaries.
+
+Policy (all knobs on the constructor):
+
+- a flush happens when pending rows reach ``max_batch`` (full bucket)
+  OR the **oldest** pending request has waited ``max_delay_ms`` (the
+  admission window: a lone size-1 request is never parked behind an
+  empty queue for long);
+- coalescing is FIFO-prefix: requests keep arrival order and are never
+  reordered past each other, so per-caller ordering holds;
+- the queue is bounded in ROWS (``max_queue``): when it is full,
+  :meth:`submit` raises :class:`QueueFull` immediately — callers see
+  backpressure, the server never queues itself into OOM;
+- shutdown drains: everything admitted before :meth:`shutdown` is
+  served before the scheduler exits.
+
+The batcher knows nothing about models or devices — it hands each
+coalesced batch (a list of :class:`Request`) to the ``run_batch``
+callable and that callable resolves the futures.  Exceptions from
+``run_batch`` fail that batch's futures and the scheduler keeps going.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from znicz_tpu.utils.logger import Logger
+
+
+class QueueFull(RuntimeError):
+    """Raised by :meth:`ContinuousBatcher.submit` when the bounded
+    request queue has no room — the caller's backpressure signal."""
+
+
+class Request:
+    """One submitted batch of rows riding the queue."""
+
+    __slots__ = ("x", "n", "future", "t_submit")
+
+    def __init__(self, x: np.ndarray) -> None:
+        self.x = x
+        self.n = int(x.shape[0])
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+
+
+class ContinuousBatcher(Logger):
+    """FIFO request queue + scheduler thread coalescing into batches."""
+
+    def __init__(self, run_batch, *, max_batch: int,
+                 max_delay_ms: float = 5.0, max_queue: int = 1024,
+                 name: str = "serving") -> None:
+        super().__init__()
+        if max_queue < max_batch:
+            raise ValueError(
+                f"max_queue ({max_queue}) must be >= max_batch "
+                f"({max_batch}) or full buckets could never form")
+        self._run_batch = run_batch
+        self.max_batch = int(max_batch)
+        self.max_delay = float(max_delay_ms) / 1000.0
+        self.max_queue = int(max_queue)
+        self._pending: deque[Request] = deque()
+        self._rows = 0
+        self._cond = threading.Condition()
+        self._stop = False
+        self._flush_now = False
+        self._thread = threading.Thread(
+            target=self._loop, name=f"{name}-batcher", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    @property
+    def queue_rows(self) -> int:
+        """Rows currently pending (telemetry; racy by nature)."""
+        return self._rows
+
+    def submit(self, x: np.ndarray) -> Future:
+        """Enqueue a request; returns the future of its output rows.
+
+        Raises :class:`QueueFull` when the bounded queue has no room
+        for ``x``'s rows, and ``RuntimeError`` after shutdown."""
+        req = Request(x)
+        if req.n < 1 or req.n > self.max_batch:
+            raise ValueError(
+                f"request of {req.n} rows outside 1..{self.max_batch} "
+                f"(max_batch) — split it client-side")
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("batcher is shut down")
+            if self._rows + req.n > self.max_queue:
+                raise QueueFull(
+                    f"serving queue full ({self._rows} rows pending, "
+                    f"limit {self.max_queue})")
+            self._pending.append(req)
+            self._rows += req.n
+            self._cond.notify_all()
+        return req.future
+
+    def flush(self) -> None:
+        """Dispatch whatever is pending without waiting out the
+        admission window (tests, graceful drain points)."""
+        with self._cond:
+            self._flush_now = True
+            self._cond.notify_all()
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        """Stop the scheduler after draining everything pending."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)
+
+    # ------------------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._stop:
+                    self._cond.wait()
+                if not self._pending and self._stop:
+                    return
+                # admission window: sleep until the batch fills, the
+                # oldest request's delay budget runs out, or someone
+                # forces a flush
+                while (self._rows < self.max_batch and not self._stop
+                       and not self._flush_now):
+                    remain = (self._pending[0].t_submit + self.max_delay
+                              - time.monotonic())
+                    if remain <= 0:
+                        break
+                    self._cond.wait(timeout=remain)
+                batch: list[Request] = []
+                rows = 0
+                while (self._pending
+                       and rows + self._pending[0].n <= self.max_batch):
+                    req = self._pending.popleft()
+                    rows += req.n
+                    batch.append(req)
+                self._rows -= rows
+                self._flush_now = False
+                self._cond.notify_all()
+            if not batch:  # pragma: no cover - spurious wakeup guard
+                continue
+            try:
+                self._run_batch(batch)
+            except Exception as exc:  # noqa: BLE001 - fail THIS batch only
+                self.warning("batch of %d requests failed: %s",
+                             len(batch), exc)
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(exc)

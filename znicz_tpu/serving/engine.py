@@ -1,0 +1,321 @@
+"""ServingEngine: throughput-oriented serving over the export format.
+
+Glues the three round-8 pieces together on top of
+:class:`znicz_tpu.export.ExportedModel`:
+
+1. **Bucketed AOT program cache** — :meth:`start` warms every bucket
+   of the power-of-two ladder (``serving.buckets``) through real
+   ``jit(...).lower(...).compile()`` calls, so steady-state serving
+   performs ZERO compiles and the number of live programs is
+   ``log2(max_batch)+1`` regardless of how ragged the traffic is.
+2. **Continuous batching** — :meth:`submit` enqueues onto a bounded
+   queue drained by a scheduler thread
+   (:class:`znicz_tpu.serving.batcher.ContinuousBatcher`) that
+   coalesces pending requests into the smallest covering bucket, pads
+   the tail, and masks the padded rows out of every reply.  Callers
+   see :class:`QueueFull` backpressure, never a server OOM.
+3. **Data-parallel replication** — on a multi-device backend the
+   engine builds a data-axis mesh (``parallel.make_mesh``) and lets
+   the existing ``XLADevice.sharding_for`` placement shard each
+   coalesced batch across it: one compiled program, N-chip
+   throughput, GSPMD inserting the collectives (gate:
+   ``root.common.serving.replicate = False`` → single device).
+
+Host-side allocation discipline: each bucket owns TWO pinned staging
+buffers used alternately (donation double-buffering) — with input
+donation the device consumes the uploaded buffer, and alternating the
+host side keeps refills off any buffer a still-in-flight upload may
+read, without allocating per request.
+
+Telemetry: per-request enqueue→reply latency (p50/p95/p99 over a
+sliding window) and per-bucket batch/row/occupancy counters, exposed
+through :meth:`stats` / :meth:`serving_status` (the latter is what
+``web_status.gather_status`` renders when an engine is registered on
+the dashboard).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from znicz_tpu.serving.batcher import ContinuousBatcher, QueueFull
+from znicz_tpu.serving.buckets import bucket_for, ladder
+from znicz_tpu.utils.logger import Logger
+
+__all__ = ["ServingEngine", "QueueFull"]
+
+
+def _percentile(sorted_vals: list[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+class ServingEngine(Logger):
+    """Continuous-batching server over an exported forward chain.
+
+    ``model`` is an :class:`~znicz_tpu.export.ExportedModel` or a
+    bundle path.  When a path is given (or the model's device should
+    be replaced), the engine resolves its own device: a data-axis mesh
+    over all visible devices when replication is on and more than one
+    device exists, else the default single device.
+
+    Lifecycle::
+
+        with ServingEngine("model.npz", max_batch=64) as eng:
+            future = eng.submit(x)          # async
+            probs = future.result()
+            probs = eng(x)                  # sync convenience
+
+    ``start()`` compiles the whole ladder up front; ``shutdown()``
+    drains the queue and stops the scheduler.
+    """
+
+    def __init__(self, model, *, max_batch: int = 64,
+                 max_delay_ms: float = 5.0, max_queue: int | None = None,
+                 replicate: bool | None = None,
+                 device=None) -> None:
+        super().__init__()
+        from znicz_tpu.export import ExportedModel  # deferred: cycle
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.max_batch = int(max_batch)
+        self.max_delay_ms = float(max_delay_ms)
+        self.max_queue = int(max_queue if max_queue is not None
+                             else max(4 * max_batch, 1024))
+        if isinstance(model, (str, bytes)) or hasattr(model, "__fspath__"):
+            if device is None:
+                device = self.resolve_device(replicate)
+            model = ExportedModel.load(model, device=device,
+                                       max_batch=self.max_batch)
+        self.model = model
+        if device is None:
+            device = model.device
+        self.device = device
+        self.n_replicas = max(1, getattr(self.device, "n_data_shards", 1))
+        if replicate is False and self.n_replicas > 1:
+            raise ValueError(
+                "replicate=False but the model's device already "
+                "carries a data-axis mesh — build the model on a "
+                "single device instead")
+        self._batcher: ContinuousBatcher | None = None
+        self._staging: dict[int, list[np.ndarray]] = {}
+        self._flip: dict[int, int] = {}
+        self._lock = threading.Lock()
+        # telemetry ----------------------------------------------------
+        self._lat = deque(maxlen=4096)  # enqueue→reply seconds
+        self._bucket_rows: dict[int, int] = {}
+        self._bucket_batches: dict[int, int] = {}
+        self.requests_submitted = 0
+        self.requests_served = 0
+        self.requests_rejected = 0
+        self.warmup_compiles = 0
+        self.warmup_seconds = 0.0
+        self._started = False
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+    @staticmethod
+    def resolve_device(replicate: bool | None = None):
+        """The serving device under the replication gate: a data-axis
+        mesh over every visible device when allowed and useful, else
+        the plain default device."""
+        from znicz_tpu.backends import Device, XLADevice
+        from znicz_tpu.utils.config import root
+        if replicate is None:
+            replicate = bool(root.common.serving.get("replicate", True))
+        if not replicate:
+            return Device.create()
+        import jax
+        devices = jax.devices()
+        if len(devices) < 2:
+            return Device.create()
+        from znicz_tpu.parallel import make_mesh
+        mesh = make_mesh(n_data=len(devices), n_model=1,
+                         devices=devices)
+        return XLADevice(mesh=mesh)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "ServingEngine":
+        """Warm the whole bucket ladder (every compile happens HERE)
+        and start the scheduler thread."""
+        if self._started:
+            return self
+        align = self.model._align
+        t0 = time.monotonic()
+        self.warmup_compiles = self.model.warmup(self.max_batch)
+        self.warmup_seconds = time.monotonic() - t0
+        shape, dtype = self.model.input_shape, self.model.serve_dtype
+        for size in ladder(self.max_batch, align):
+            # donation double-buffering: two host staging buffers per
+            # bucket, used alternately by the scheduler thread
+            self._staging[size] = [
+                np.zeros((size,) + shape, dtype=dtype) for _ in range(2)]
+            self._flip[size] = 0
+        self._batcher = ContinuousBatcher(
+            self._run_batch, max_batch=self.max_batch,
+            max_delay_ms=self.max_delay_ms, max_queue=self.max_queue,
+            name=self.model.manifest.get("workflow", "model"))
+        self._started = True
+        self.info(
+            "serving '%s': %d AOT programs warmed in %.2fs "
+            "(buckets %s, replicas=%d, donate=%s)",
+            self.model.manifest.get("workflow", "?"),
+            self.warmup_compiles, self.warmup_seconds,
+            ladder(self.max_batch, align), self.n_replicas,
+            self.model._donate_choice())
+        return self
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        """Drain the queue, stop the scheduler."""
+        if self._batcher is not None:
+            self._batcher.shutdown(timeout=timeout)
+            self._batcher = None
+        self._started = False
+
+    def __enter__(self) -> "ServingEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # ------------------------------------------------------------------
+    # request path
+    # ------------------------------------------------------------------
+    def submit(self, x: np.ndarray) -> Future:
+        """Enqueue a request (``x``: batch of samples, 1..max_batch
+        rows); returns a future of the output rows.  Raises
+        :class:`QueueFull` under backpressure."""
+        if self._batcher is None:
+            raise RuntimeError("engine not started — call start()")
+        x = np.ascontiguousarray(x, dtype=self.model.serve_dtype)
+        if x.shape[1:] != self.model.input_shape:
+            raise ValueError(
+                f"input sample shape {x.shape[1:]} != exported "
+                f"{self.model.input_shape}")
+        try:
+            future = self._batcher.submit(x)
+        except QueueFull:
+            with self._lock:
+                self.requests_rejected += 1
+            raise
+        with self._lock:
+            self.requests_submitted += 1
+        return future
+
+    def __call__(self, x: np.ndarray, timeout: float | None = None
+                 ) -> np.ndarray:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(x).result(timeout=timeout)
+
+    def flush(self) -> None:
+        """Dispatch pending requests without waiting out the admission
+        window."""
+        if self._batcher is not None:
+            self._batcher.flush()
+
+    # ------------------------------------------------------------------
+    def _run_batch(self, batch) -> None:
+        """Scheduler-thread dispatch: coalesce → pad → one AOT program
+        → split replies.  Sole caller of the compiled programs, so the
+        model's cache bookkeeping needs no locking."""
+        total = sum(req.n for req in batch)
+        size = bucket_for(total, self.model._align)
+        staging = self._staging.get(size)
+        if staging is None:  # bucket above the warmed ladder
+            staging = self._staging[size] = [
+                np.zeros((size,) + self.model.input_shape,
+                         dtype=self.model.serve_dtype) for _ in range(2)]
+            self._flip[size] = 0
+        self._flip[size] ^= 1
+        buf = staging[self._flip[size]]
+        row = 0
+        for req in batch:
+            buf[row:row + req.n] = req.x
+            row += req.n
+        if row < size:
+            buf[row:] = 0  # padded tail: never leaks, but keep it clean
+        out = np.asarray(self.model.program_for(size)(buf))
+        now = time.monotonic()
+        row = 0
+        for req in batch:
+            req.future.set_result(np.array(out[row:row + req.n],
+                                           copy=True))
+            row += req.n
+        with self._lock:
+            self.requests_served += len(batch)
+            self._bucket_rows[size] = self._bucket_rows.get(size, 0) + total
+            self._bucket_batches[size] = \
+                self._bucket_batches.get(size, 0) + 1
+            for req in batch:
+                self._lat.append(now - req.t_submit)
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        """Latency percentiles + per-bucket occupancy counters."""
+        with self._lock:
+            lat = sorted(self._lat)
+            buckets = {
+                size: {
+                    "batches": self._bucket_batches[size],
+                    "rows": self._bucket_rows[size],
+                    "occupancy_pt": round(
+                        100.0 * self._bucket_rows[size]
+                        / (self._bucket_batches[size] * size), 1),
+                }
+                for size in sorted(self._bucket_batches)
+            }
+            out = {
+                "engine": "bucketed-aot",
+                "replicas": self.n_replicas,
+                "max_batch": self.max_batch,
+                "max_delay_ms": self.max_delay_ms,
+                "buckets_warmed": sorted(self._staging),
+                "programs_compiled": self.model.compile_count,
+                "programs_live": len(self.model._programs),
+                "warmup_seconds": round(self.warmup_seconds, 3),
+                "submitted": self.requests_submitted,
+                "served": self.requests_served,
+                "rejected": self.requests_rejected,
+                "queue_rows": (self._batcher.queue_rows
+                               if self._batcher else 0),
+                "buckets": buckets,
+            }
+        if lat:
+            out["latency_ms"] = {
+                "p50": round(1e3 * _percentile(lat, 50), 3),
+                "p95": round(1e3 * _percentile(lat, 95), 3),
+                "p99": round(1e3 * _percentile(lat, 99), 3),
+                "mean": round(1e3 * sum(lat) / len(lat), 3),
+                "window": len(lat),
+            }
+        return out
+
+    def serving_status(self) -> dict:
+        """``web_status.gather_status`` hook: the dashboard entry for
+        this engine."""
+        out = {"name": f"serving:{self.model.manifest.get('workflow', '?')}",
+               "initialized": self._started,
+               "stopped": not self._started}
+        out.update(self.stats())
+        dev = self.device
+        if dev is not None:
+            out["backend"] = dev.backend
+            mesh = getattr(dev, "mesh", None)
+            if mesh is not None:
+                out["mesh"] = {ax: int(n) for ax, n
+                               in zip(mesh.axis_names, mesh.devices.shape)}
+        return out

@@ -24,7 +24,12 @@ from znicz_tpu.utils.logger import Logger
 
 def gather_status(workflow) -> dict:
     """One workflow's live status snapshot (scalars only — safe to
-    read from the serving thread while training runs)."""
+    read from the serving thread while training runs).  A registered
+    :class:`znicz_tpu.serving.ServingEngine` reports its own snapshot
+    (bucket occupancy, latency percentiles, queue depth) through the
+    same feed."""
+    if hasattr(workflow, "serving_status"):
+        return workflow.serving_status()
     from znicz_tpu.utils.introspect import (slowest_units,
                                             validation_metrics)
     out: dict = {"name": workflow.name,
