@@ -93,15 +93,29 @@ TIMEOUT_S = float(os.environ.get("BENCH_TIMEOUT_S", "900"))
 #: regenerable binaries, not version-controlled evidence — the
 #: decisions each trace drove live in PERF.md).  BENCH_PROFILE=""
 #: disables; set a path to move (user paths are never cleaned).
-PROFILE_DIR = os.environ.get(
-    "BENCH_PROFILE",
-    # stream mode is HOST-bound (single-core decode pool) and the
-    # profiler competes for that core — measured 816 → 294 img/s with
-    # default tracing on; only the device-resident mode profiles by
-    # default
-    "" if INPUT_MODE == "stream" else
-    os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                 "profiles", "bench_default"))
+#: ``--profile <dir>``: wrap the timed loop in
+#: ``observe.profile_window`` — the dir receives the jax.profiler
+#: device trace AND the window's host spans
+#: (``host_spans.trace.json``), so every committed BENCH row can carry
+#: a trace readable by ``benchmarks/trace_top.py <dir> <steps>
+#: --spans <dir>``.  Unlike BENCH_PROFILE (env), the flag also
+#: profiles on CPU and never cleans the target dir.
+_PROFILE_FLAG = None
+if "--profile" in sys.argv:
+    _i = sys.argv.index("--profile")
+    if _i + 1 >= len(sys.argv):
+        raise SystemExit("--profile requires a directory argument")
+    _PROFILE_FLAG = sys.argv[_i + 1]
+PROFILE_DIR = _PROFILE_FLAG if _PROFILE_FLAG is not None else \
+    os.environ.get(
+        "BENCH_PROFILE",
+        # stream mode is HOST-bound (single-core decode pool) and the
+        # profiler competes for that core — measured 816 → 294 img/s
+        # with default tracing on; only the device-resident mode
+        # profiles by default
+        "" if INPUT_MODE == "stream" else
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "profiles", "bench_default"))
 WARMUP_STEPS = 6
 TIMED_STEPS = 30
 BASELINE_IMG_PER_SEC_PER_CHIP = 250.0  # 8000 img/s ÷ 32 chips (v4-32)
@@ -285,29 +299,31 @@ def main() -> None:
         step()
     wf.forwards[-1].weights.devmem.block_until_ready()
 
-    profiling = bool(PROFILE_DIR) and tpu_like
+    profiling = bool(PROFILE_DIR) and (tpu_like
+                                       or _PROFILE_FLAG is not None)
+    from contextlib import nullcontext
+    window = nullcontext()
     if profiling:
-        import jax
-
-        if "BENCH_PROFILE" not in os.environ:
+        if "BENCH_PROFILE" not in os.environ and _PROFILE_FLAG is None:
             # one trace per directory, DEFAULT path only: jax writes a
             # new timestamped subdir per run, which would grow without
             # bound under the default-on policy.  A user-supplied
-            # BENCH_PROFILE dir is never cleaned — it may hold prior
-            # results.
+            # --profile / BENCH_PROFILE dir is never cleaned — it may
+            # hold prior results.
             import shutil
 
             shutil.rmtree(PROFILE_DIR, ignore_errors=True)
-        jax.profiler.start_trace(PROFILE_DIR)
-    start = time.perf_counter()
-    for _ in range(timed_dispatches):
-        step()
-    wf.forwards[-1].weights.devmem.block_until_ready()
-    elapsed = time.perf_counter() - start
-    if profiling:
-        import jax
+        from znicz_tpu import observe
 
-        jax.profiler.stop_trace()
+        # device trace + the window's host spans in one capture dir
+        window = observe.profile_window(
+            PROFILE_DIR, n_steps=timed_dispatches * CHUNK)
+    with window:
+        start = time.perf_counter()
+        for _ in range(timed_dispatches):
+            step()
+        wf.forwards[-1].weights.devmem.block_until_ready()
+        elapsed = time.perf_counter() - start
 
     step_time = elapsed / (timed_dispatches * CHUNK)
     img_per_sec = BATCH / step_time

@@ -50,6 +50,16 @@ DELAY_MS = float(os.environ.get("SERVE_DELAY_MS", "5"))
 N_DEVICES = int(os.environ.get("SERVE_DEVICES", "0"))  # 0 = single
 SEED_ARM = os.environ.get("SERVE_SEED_ARM", "1") == "1"
 EPOCHS = int(os.environ.get("SERVE_EPOCHS", "2"))
+#: ``--profile <dir>``: capture the bucketed replay under
+#: ``observe.profile_window`` (jax device trace + host spans of the
+#: batcher/serve dispatches) so a committed SERVE_BENCH row can carry
+#: its trace; read it with ``trace_top.py <dir> --spans <dir>``
+PROFILE_DIR = None
+if "--profile" in sys.argv:
+    _i = sys.argv.index("--profile")
+    if _i + 1 >= len(sys.argv):
+        raise SystemExit("--profile requires a directory argument")
+    PROFILE_DIR = sys.argv[_i + 1]
 
 
 def _ensure_platform() -> None:
@@ -212,7 +222,8 @@ def replay_engine(engine, trace) -> tuple:
 def run(n_requests: int = N_REQUESTS, rate: float = RATE,
         max_batch: int = MAX_BATCH, delay_ms: float = DELAY_MS,
         n_devices: int = N_DEVICES, seed_arm: bool = SEED_ARM,
-        bundle: str | None = None) -> dict:
+        bundle: str | None = None,
+        profile_dir: "str | None" = PROFILE_DIR) -> dict:
     import jax
 
     from znicz_tpu.backends import XLADevice
@@ -252,7 +263,13 @@ def run(n_requests: int = N_REQUESTS, rate: float = RATE,
     engine = ServingEngine(bundle, max_batch=max_batch,
                            max_delay_ms=delay_ms, device=device)
     engine.start()
-    report["bucketed"], eng_out = replay_engine(engine, trace)
+    if profile_dir:
+        from znicz_tpu import observe
+        with observe.profile_window(profile_dir, n_steps=n_requests):
+            report["bucketed"], eng_out = replay_engine(engine, trace)
+        report["bucketed"]["profile"] = profile_dir
+    else:
+        report["bucketed"], eng_out = replay_engine(engine, trace)
     engine.shutdown()
 
     cap = int(math.log2(max_batch)) + 1

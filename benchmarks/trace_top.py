@@ -1,10 +1,11 @@
 """Summarize a jax.profiler trace: top device-time sinks by fusion.
 
 Usage: ``python benchmarks/trace_top.py <profile_dir_or_trace.json.gz>
-[n_steps]`` — finds the newest ``*.trace.json.gz`` under the
-directory, sums durations of device-lane events by name, and prints
-the top entries (total ms, ms/step when ``n_steps`` given, % of
-device total).  This is how PERF.md's "named sinks" tables are made.
+[n_steps] [--spans <host_spans.trace.json | dir>]`` — finds the
+newest ``*.trace.json.gz`` under the directory, sums durations of
+device-lane events by name, and prints the top entries (total ms,
+ms/step when ``n_steps`` given, % of device total).  This is how
+PERF.md's "named sinks" tables are made.
 
 Collective ops (all-reduce / reduce-scatter / all-gather /
 collective-permute/ppermute and their async start/done halves) are
@@ -13,6 +14,18 @@ comm-vs-compute split line — the attribution needed to read the
 ZeRO-1 (round 7) update-path traces: the reduce-scatter + all-gather
 pair must show up as comm time halved against the replicated
 all-reduce, not smeared into the fusion names.
+
+``--spans`` (round 9) merges a HOST-span file — the
+``host_spans.trace.json`` that :func:`znicz_tpu.observe.profile_window`
+drops beside the device trace, or any Chrome-trace JSON from
+``SpanTracer.export`` — into the summary: per-span totals (which
+units/epochs/serve batches the host spent its time in) and a combined
+comms-vs-compute-vs-host attribution line.  The merge is *aggregate*
+(sums over the window): host perf_counter timestamps and device trace
+timestamps share no epoch, so timestamp-level alignment is the job of
+the profiler UI (TraceAnnotation puts the same spans on the profiler's
+host lanes); this summary answers "where did the window's time go"
+across both sources in one place.
 """
 
 from __future__ import annotations
@@ -52,9 +65,93 @@ def classify(name: str) -> str:
     return "compute"
 
 
+def parse_argv(argv: list) -> tuple:
+    """``(positional_args, spans_path)`` — ``--spans`` may appear
+    anywhere; its value may be the span file or the profile dir
+    ``profile_window`` wrote (``host_spans.trace.json`` inside)."""
+    spans = None
+    rest: list = []
+    i = 0
+    while i < len(argv):
+        if argv[i] == "--spans":
+            if i + 1 >= len(argv):
+                raise SystemExit("--spans requires a path")
+            spans = argv[i + 1]
+            i += 2
+        else:
+            rest.append(argv[i])
+            i += 1
+    return rest, spans
+
+
+def load_host_spans(path: str) -> tuple:
+    if os.path.isdir(path):
+        cand = os.path.join(path, "host_spans.trace.json")
+        if not os.path.exists(cand):
+            raise SystemExit(f"no host_spans.trace.json under {path}")
+        path = cand
+    with open(path) as fh:
+        data = json.load(fh)
+    return path, [ev for ev in data.get("traceEvents", [])
+                  if ev.get("ph") == "X"]
+
+
+def print_span_merge(spans_path: str, device_total: float,
+                     device_buckets: "collections.Counter",
+                     n_steps: "int | None") -> None:
+    """Host-span table + the combined attribution line."""
+    spans_path, spans = load_host_spans(spans_path)
+    print()
+    print(f"host spans: {spans_path}")
+    if not spans:
+        print("  (no spans recorded — was engine.telemetry off?)")
+        return
+    by_name: collections.Counter = collections.Counter()
+    n_by_name: collections.Counter = collections.Counter()
+    for ev in spans:
+        ms = ev.get("dur", 0) / 1e3
+        by_name[ev["name"]] += ms
+        n_by_name[ev["name"]] += 1
+    # top-level spans only for the wall accounting: nested spans
+    # (units inside a workflow span) would double-count; the
+    # profile_window envelope span covers everything and is excluded
+    # for the same reason
+    top_ms = sum(ev.get("dur", 0) / 1e3 for ev in spans
+                 if (ev.get("args") or {}).get("depth", 0) == 0
+                 and ev.get("cat") != "profile")
+    t0 = min(ev["ts"] for ev in spans) / 1e3
+    t1 = max(ev["ts"] + ev.get("dur", 0) for ev in spans) / 1e3
+    line = (f"host wall: {t1 - t0:.1f} ms, top-level spans "
+            f"{top_ms:.1f} ms over {len(spans)} spans")
+    if n_steps:
+        line += f" ({(t1 - t0) / n_steps:.3f} ms/step)"
+    print(line)
+    for name, ms in by_name.most_common(15):
+        row = f"{ms:9.1f} ms  {n_by_name[name]:6d}x"
+        if n_steps:
+            row += f"  {ms / n_steps:7.3f} ms/step"
+        print(f"{row}  {name[:60]}")
+    comms = device_buckets["comms"]
+    compute = device_buckets["compute"]
+    # aggregate merge: device busy time attributed by the device
+    # trace; whatever host-span time the device cannot account for is
+    # the host-side share (dispatch, batching, map/unmap, Python)
+    host_gap = max(0.0, top_ms - device_total)
+    covered = compute + comms + host_gap
+    if covered:
+        print(f"combined attribution: device compute {compute:.1f} ms "
+              f"({100 * compute / covered:.1f}%) · device comms "
+              f"{comms:.1f} ms ({100 * comms / covered:.1f}%) · "
+              f"host-side {host_gap:.1f} ms "
+              f"({100 * host_gap / covered:.1f}%)")
+
+
 def main() -> None:
-    trace = find_trace(sys.argv[1])
-    n_steps = int(sys.argv[2]) if len(sys.argv) > 2 else None
+    args, spans_path = parse_argv(sys.argv[1:])
+    if not args:
+        raise SystemExit(__doc__.split("\n\n")[1])
+    trace = find_trace(args[0])
+    n_steps = int(args[1]) if len(args) > 1 else None
     with gzip.open(trace, "rt") as fh:
         data = json.load(fh)
     events = data["traceEvents"]
@@ -132,6 +229,8 @@ def main() -> None:
         if nbytes:
             perf += f"  {nbytes / sec / 1e9:6.0f} GB/s"
         print(f"{line}{perf}  {name[:40]:40s} {src:34s} {tf_op[:60]}")
+    if spans_path:
+        print_span_merge(spans_path, total, buckets, n_steps)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,377 @@
+"""Unified telemetry (round 9): metrics registry, host-span tracer,
+exposition formats and the web endpoints.
+
+Covers the observe/ contract points:
+
+- registry concurrency: a 4-thread hammer lands exactly the serial
+  totals (counters, gauges, histogram sum/count);
+- histogram percentile math against the numpy oracle (error bounded
+  by the containing bucket's width);
+- span nesting/ordering and the Chrome-trace event shape;
+- Prometheus text exposition golden test;
+- ``/metrics`` + ``/trace.json`` round-trip through WebStatusServer;
+- the ``engine.telemetry`` gate actually gates;
+- transfer-byte counters through the Vector map/unmap protocol;
+- instrumented workflow training registers the core series.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from znicz_tpu.observe import metrics as obs_metrics
+from znicz_tpu.observe import tracing as obs_tracing
+from znicz_tpu.observe.metrics import MetricsRegistry
+from znicz_tpu.observe.tracing import SpanTracer
+
+
+# ----------------------------------------------------------------------
+# registry
+# ----------------------------------------------------------------------
+def test_counter_gauge_basics():
+    reg = MetricsRegistry()
+    c = reg.counter("c_total", "a counter")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    g = reg.gauge("g", "a gauge")
+    g.set(7)
+    g.inc(3)
+    g.dec(5)
+    assert g.value == 5.0
+    g.set_function(lambda: 42)
+    assert g.value == 42.0
+
+
+def test_family_redeclaration_idempotent_and_checked():
+    reg = MetricsRegistry()
+    a = reg.counter("x_total", labels=("k",))
+    b = reg.counter("x_total", labels=("k",))
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("x_total")  # kind mismatch
+    with pytest.raises(ValueError):
+        reg.counter("x_total", labels=("other",))  # label mismatch
+    with pytest.raises(ValueError):
+        a.labels(wrong="v")  # undeclared label name
+    with pytest.raises(ValueError):
+        a.inc()  # labeled family has no solo child
+
+
+def test_registry_concurrency_matches_serial_totals():
+    """4-thread hammer ≡ serial totals (the registry's one lock)."""
+    reg = MetricsRegistry()
+    cnt = reg.counter("hammer_total", labels=("t",))
+    hist = reg.histogram("hammer_seconds", buckets=(0.25, 0.5, 0.75))
+    gauge = reg.gauge("hammer_gauge")
+    n_per_thread = 2000
+
+    def work(tid: int):
+        child = cnt.labels(t=str(tid))
+        for i in range(n_per_thread):
+            child.inc()
+            cnt.labels(t="shared").inc(2)
+            hist.observe((i % 100) / 100.0)
+            gauge.inc()
+
+    threads = [threading.Thread(target=work, args=(t,))
+               for t in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for t in range(4):
+        assert cnt.labels(t=str(t)).value == n_per_thread
+    assert cnt.labels(t="shared").value == 2 * 4 * n_per_thread
+    h = hist.labels()
+    assert h.count == 4 * n_per_thread
+    # serial oracle for the bucket counts and the sum
+    vals = [(i % 100) / 100.0 for i in range(n_per_thread)] * 4
+    assert h.sum == pytest.approx(sum(vals))
+    assert h.counts[0] == sum(1 for v in vals if v <= 0.25)
+    assert gauge.value == 4 * n_per_thread
+
+
+def test_histogram_percentiles_vs_numpy_oracle():
+    reg = MetricsRegistry()
+    bounds = tuple(np.linspace(0.01, 1.0, 34))
+    hist = reg.histogram("lat_seconds", buckets=bounds).labels()
+    rng = np.random.default_rng(11)
+    vals = rng.gamma(2.0, 0.08, size=5000)  # latency-shaped
+    for v in vals:
+        hist.observe(float(v))
+    for q in (50, 90, 95, 99):
+        est = hist.percentile(q)
+        true = float(np.percentile(vals, q))
+        # bucket-interpolated estimate: error bounded by the width of
+        # the bucket the true quantile falls in
+        import bisect
+        i = bisect.bisect_left(bounds, true)
+        lo = bounds[i - 1] if i > 0 else 0.0
+        hi = bounds[i] if i < len(bounds) else float(vals.max())
+        width = hi - lo
+        assert abs(est - true) <= width + 1e-9, (q, est, true, width)
+    assert hist.percentile(0) >= 0.0
+    empty = reg.histogram("empty_seconds").labels()
+    assert empty.percentile(50) == 0.0
+
+
+def test_prometheus_exposition_golden():
+    reg = MetricsRegistry()
+    c = reg.counter("req_total", "Requests.", labels=("event",))
+    c.labels(event="ok").inc(3)
+    c.labels(event="err").inc()
+    reg.gauge("depth", "Queue depth.").set(2.5)
+    h = reg.histogram("lat_seconds", "Latency.", buckets=(0.1, 1.0))
+    h.observe(0.0625)   # binary-exact values: the _sum line must
+    h.observe(0.5)      # render without float fuzz
+    h.observe(5.0)
+    expected = "\n".join([
+        "# HELP req_total Requests.",
+        "# TYPE req_total counter",
+        'req_total{event="ok"} 3',
+        'req_total{event="err"} 1',
+        "# HELP depth Queue depth.",
+        "# TYPE depth gauge",
+        "depth 2.5",
+        "# HELP lat_seconds Latency.",
+        "# TYPE lat_seconds histogram",
+        'lat_seconds_bucket{le="0.1"} 1',
+        'lat_seconds_bucket{le="1"} 2',
+        'lat_seconds_bucket{le="+Inf"} 3',
+        "lat_seconds_sum 5.5625",
+        "lat_seconds_count 3",
+    ]) + "\n"
+    assert reg.to_prometheus() == expected
+
+
+def test_json_exposition_shape():
+    reg = MetricsRegistry()
+    reg.counter("a_total", "A.", labels=("k",)).labels(k="x").inc(2)
+    h = reg.histogram("b_seconds", buckets=(1.0,))
+    h.observe(0.5)
+    out = reg.to_json()
+    assert out["a_total"]["type"] == "counter"
+    assert out["a_total"]["values"] == [
+        {"labels": {"k": "x"}, "value": 2.0}]
+    hrow = out["b_seconds"]["values"][0]
+    assert hrow["count"] == 1 and hrow["sum"] == 0.5
+    assert hrow["buckets"]["1"] == 1 and hrow["buckets"]["+Inf"] == 0
+    json.dumps(out)  # must be JSON-serializable as-is
+
+
+def test_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("esc_total", labels=("p",)).labels(
+        p='a"b\\c\nd').inc()
+    text = reg.to_prometheus()
+    assert r'p="a\"b\\c\nd"' in text
+
+
+# ----------------------------------------------------------------------
+# tracer
+# ----------------------------------------------------------------------
+def test_span_nesting_and_ordering():
+    tracer = SpanTracer()
+    with tracer.span("outer", cat="t"):
+        with tracer.span("mid", cat="t"):
+            with tracer.span("inner", cat="t"):
+                pass
+        with tracer.span("mid2", cat="t"):
+            pass
+    events = tracer.to_chrome_trace()["traceEvents"]
+    spans = {ev["name"]: ev for ev in events if ev.get("ph") == "X"}
+    assert list(ev["name"] for ev in events if ev.get("ph") == "X") \
+        == ["inner", "mid", "mid2", "outer"]  # completion order
+    assert spans["outer"]["args"]["depth"] == 0
+    assert spans["mid"]["args"]["depth"] == 1
+    assert spans["inner"]["args"]["depth"] == 2
+    # interval containment: children inside parents
+    for child, parent in (("inner", "mid"), ("mid", "outer"),
+                          ("mid2", "outer")):
+        c, p = spans[child], spans[parent]
+        assert c["ts"] >= p["ts"] - 1e-6
+        assert c["ts"] + c["dur"] <= p["ts"] + p["dur"] + 1e-6
+    # mid2 starts after mid ends (ordering within a level)
+    assert spans["mid2"]["ts"] >= spans["mid"]["ts"] + spans["mid"]["dur"]
+
+
+def test_span_ring_buffer_bounded_and_mark():
+    tracer = SpanTracer(max_events=8)
+    for i in range(20):
+        with tracer.span(f"s{i}"):
+            pass
+    assert len(tracer) == 8
+    mark = tracer.mark()
+    with tracer.span("after_mark"):
+        pass
+    windowed = tracer.to_chrome_trace(since=mark)["traceEvents"]
+    names = [ev["name"] for ev in windowed if ev.get("ph") == "X"]
+    assert names == ["after_mark"]
+
+
+def test_tracer_exception_still_records_and_unwinds():
+    tracer = SpanTracer()
+    with pytest.raises(RuntimeError):
+        with tracer.span("outer"):
+            with tracer.span("boom"):
+                raise RuntimeError("x")
+    spans = [ev for ev in tracer.to_chrome_trace()["traceEvents"]
+             if ev.get("ph") == "X"]
+    assert [s["name"] for s in spans] == ["boom", "outer"]
+    with tracer.span("fresh"):  # stack unwound: depth back to 0
+        pass
+    fresh = [ev for ev in tracer.to_chrome_trace()["traceEvents"]
+             if ev.get("ph") == "X"][-1]
+    assert fresh["args"]["depth"] == 0
+
+
+def test_profile_window_writes_host_spans(tmp_path):
+    from znicz_tpu.observe import profile_window
+    tracer = SpanTracer()
+    outdir = str(tmp_path / "win")
+    with profile_window(outdir, n_steps=4, device=False,
+                        tracer=tracer):
+        with tracer.span("step"):
+            pass
+    path = tmp_path / "win" / "host_spans.trace.json"
+    assert path.exists()
+    data = json.loads(path.read_text())
+    names = [ev["name"] for ev in data["traceEvents"]
+             if ev.get("ph") == "X"]
+    assert names == ["step", "profile_window"]
+    window = [ev for ev in data["traceEvents"]
+              if ev.get("name") == "profile_window"][0]
+    assert window["args"]["n_steps"] == 4
+
+
+# ----------------------------------------------------------------------
+# the telemetry gate
+# ----------------------------------------------------------------------
+def test_telemetry_gate_disables_instrumentation():
+    from znicz_tpu.units import Unit
+    from znicz_tpu.utils.config import root
+
+    root.common.engine.telemetry = False
+    tracer_mark = obs_tracing.TRACER.mark()
+    fam = obs_metrics.REGISTRY.get("znicz_unit_run_seconds")
+    before = fam.labels(unit="gated_unit").count if fam else 0
+
+    u = Unit(None, name="gated_unit")
+    u._fire()
+    assert u.run_count == 1  # the unit itself still runs + times
+    assert obs_tracing.TRACER.mark() == tracer_mark  # no span
+    fam = obs_metrics.REGISTRY.get("znicz_unit_run_seconds")
+    after = fam.labels(unit="gated_unit").count if fam else 0
+    assert after == before  # no histogram sample
+
+    root.common.engine.telemetry = True
+    u._fire()
+    assert obs_metrics.REGISTRY.get("znicz_unit_run_seconds") \
+        .labels(unit="gated_unit").count == before + 1
+    assert obs_tracing.TRACER.mark() == tracer_mark + 1
+
+
+def test_vector_transfer_byte_counters():
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.memory import Vector
+
+    h2d = obs_metrics.transfer_bytes("h2d")
+    d2h = obs_metrics.transfer_bytes("d2h")
+    base_up, base_down = h2d.value, d2h.value
+    arr = np.arange(64, dtype=np.float32).reshape(8, 8)
+    vec = Vector(arr, name="obs_probe")
+    vec.initialize(XLADevice())          # upload: +256 bytes h2d
+    assert h2d.value == base_up + arr.nbytes
+    vec.devmem = vec.devmem + 1.0        # device-authoritative now
+    vec.map_read()                       # fetch: +256 bytes d2h
+    assert d2h.value == base_down + arr.nbytes
+    vec.map_write()
+    vec.unmap()                          # re-upload after host write
+    assert h2d.value == base_up + 2 * arr.nbytes
+
+
+# ----------------------------------------------------------------------
+# web endpoints + end-to-end series registration
+# ----------------------------------------------------------------------
+def _get(url: str) -> bytes:
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        return resp.read()
+
+
+def test_metrics_and_trace_endpoints_roundtrip():
+    from znicz_tpu.web_status import WebStatusServer
+
+    obs_metrics.REGISTRY.counter(
+        "endpoint_probe_total", "Probe.").inc(5)
+    with obs_tracing.TRACER.span("endpoint_probe_span"):
+        pass
+    server = WebStatusServer(port=0)
+    try:
+        text = _get(
+            f"http://127.0.0.1:{server.port}/metrics").decode()
+        assert "# TYPE endpoint_probe_total counter" in text
+        assert "endpoint_probe_total 5" in text
+        trace = json.loads(_get(
+            f"http://127.0.0.1:{server.port}/trace.json"))
+        names = [ev["name"] for ev in trace["traceEvents"]
+                 if ev.get("ph") == "X"]
+        assert "endpoint_probe_span" in names
+    finally:
+        server.stop()
+
+
+def test_training_registers_core_series():
+    """One tiny trained workflow populates compile counter, unit run
+    histogram, region steps, epoch counter and transfer bytes — the
+    series the dryrun attestation and the verify scrape assert on."""
+    from conftest import make_blobs
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+
+    data, labels = make_blobs(24, 3, 10)
+    wf = StandardWorkflow(
+        name="obs_train",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:48], train_labels=labels[:48],
+            valid_data=data[48:], valid_labels=labels[48:],
+            minibatch_size=12),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 16},
+                 "<-": {"learning_rate": 0.05}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": 0.05}}],
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 100_000
+    wf.initialize(device=XLADevice())
+    wf.run()
+
+    compiles = obs_metrics.xla_compiles(
+        f"region:{wf._region_unit.name}")
+    assert compiles.value >= 2  # train + eval variants at least
+    unit_hist = obs_metrics.REGISTRY.get("znicz_unit_run_seconds")
+    fired = {key[0] for key, child in unit_hist.items()
+             if child.count > 0}
+    assert wf.loader.name in fired and wf._region_unit.name in fired
+    assert obs_metrics.region_steps(wf._region_unit.name).value > 0
+    assert obs_metrics.epochs_total("obs_train").value >= 2
+    assert obs_metrics.transfer_bytes("h2d").value > 0
+    # epochs left retroactive spans on the tracer
+    epoch_spans = [ev for ev in
+                   obs_tracing.TRACER.to_chrome_trace()["traceEvents"]
+                   if ev.get("ph") == "X"
+                   and ev.get("cat") == "epoch"
+                   and ev.get("args", {}).get("workflow") == "obs_train"]
+    assert len(epoch_spans) >= 2
+    # and the prometheus exposition renders it all without error
+    text = obs_metrics.REGISTRY.to_prometheus()
+    assert "znicz_xla_compiles_total" in text
+    assert "znicz_unit_run_seconds_bucket" in text

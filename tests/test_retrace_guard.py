@@ -1,0 +1,121 @@
+"""Steady-state retrace guard (round 9 satellite): once warmed up,
+neither the training step nor the serving path may trigger a new XLA
+compile.
+
+This is the regression net for the AOT ladder, the donation paths and
+the region-key design: an accidental retrace (a shape that varies per
+step, a gate that leaks into the traced program, a bucket the warmup
+missed) shows up here as a compile-counter delta, not as a mystery
+slowdown on a chip three rounds later.  The counter is
+``znicz_xla_compiles_total`` from :mod:`znicz_tpu.observe` — the same
+series the multichip dryrun attests and ``/metrics`` exposes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from conftest import make_blobs
+from znicz_tpu.backends import XLADevice
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.observe import metrics as obs_metrics
+from znicz_tpu.utils import prng
+
+
+def _build_wf(name: str, max_epochs: int = 2,
+              chunked: bool = False) -> StandardWorkflow:
+    data, labels = make_blobs(24, 3, 10)
+    prng.seed_all(17)
+    wf = StandardWorkflow(
+        name=name,
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:48], train_labels=labels[:48],
+            valid_data=data[48:], valid_labels=labels[48:],
+            minibatch_size=12),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 16},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}}],
+        decision_config={"max_epochs": max_epochs})
+    wf._max_fires = 100_000
+    wf.initialize(device=XLADevice())
+    return wf
+
+
+def test_warmed_train_step_zero_new_compiles():
+    """After one full epoch schedule (train + eval variants both
+    compiled), further steps must hit the program cache."""
+    wf = _build_wf("retrace_train")
+    compiles = obs_metrics.xla_compiles(f"region:{wf._region_unit.name}")
+    wf.run()  # 2 epochs: every region variant the schedule uses
+    warmed = compiles.value
+    assert warmed >= 2, "expected at least train+eval region variants"
+    for _ in range(6):  # cycle through train AND valid minibatches
+        wf.loader.run()
+        wf._region_unit.run()
+    assert compiles.value == warmed, (
+        f"warmed-up train steps recompiled: {compiles.value - warmed} "
+        f"new XLA programs after the warmup epochs")
+
+
+def test_warmed_chunked_dispatch_zero_new_compiles():
+    """The lax.scan chunk path is its own cache entry: the first
+    run_chunk compiles once, repeats must not."""
+    wf = _build_wf("retrace_chunk")
+    region = wf._region_unit.region
+    compiles = obs_metrics.xla_compiles(f"region:{wf._region_unit.name}")
+
+    def one_epoch_of_chunks():
+        # 6-step schedule (4 train + 2 valid minibatches): chunks of 2
+        # hit both the train and the eval variant of the scan body
+        for _ in range(3):
+            for _ in range(2):
+                wf.loader.run()
+            region.run_chunk(2)
+
+    one_epoch_of_chunks()  # warmup: every chunk variant compiles here
+    warmed = compiles.value
+    one_epoch_of_chunks()
+    one_epoch_of_chunks()
+    assert compiles.value == warmed, \
+        "warmed-up scan chunks recompiled"
+
+
+@pytest.fixture()
+def served_bundle(tmp_path):
+    wf = _build_wf("retrace_serve", max_epochs=1)
+    wf.run()
+    path = str(tmp_path / "retrace_serve.npz")
+    wf.export_forward(path)
+    return path
+
+
+def test_warmed_serving_bucket_zero_new_compiles(served_bundle):
+    """The engine's warmup covers the whole ladder; ragged traffic
+    afterwards — partial, odd, full, repeated — must not compile."""
+    from znicz_tpu.serving import ServingEngine
+
+    serving_compiles = obs_metrics.xla_compiles("serving-aot")
+    engine = ServingEngine(served_bundle, max_batch=16,
+                           max_delay_ms=1.0)
+    engine.start()
+    warmed = serving_compiles.value
+    assert engine.warmup_compiles >= 1
+    rng = np.random.default_rng(4)
+    try:
+        for rows in (1, 3, 16, 7, 16, 2, 5, 11):
+            x = rng.normal(size=(rows, 10)).astype(np.float32)
+            out = engine(x, timeout=60)
+            assert out.shape == (rows, 3)
+        assert serving_compiles.value == warmed, (
+            f"warmed serving buckets recompiled: "
+            f"{serving_compiles.value - warmed} new AOT programs")
+        assert engine.stats()["programs_compiled"] == \
+            engine.warmup_compiles
+    finally:
+        engine.shutdown()

@@ -38,6 +38,8 @@ import jax
 
 from znicz_tpu.backends import Device, NumpyDevice
 from znicz_tpu.memory import Vector
+from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.observe import tracing as _tracing
 from znicz_tpu.units import Unit
 from znicz_tpu.utils import prng
 from znicz_tpu.utils.logger import Logger
@@ -241,18 +243,32 @@ class JitRegion(Logger):
         key = tuple(unit.region_key() for unit in self.units) \
             + (skips, checks)
         fn = self._cache.get(key)
+        leaves = [vec._devmem for vec in vectors]
         if fn is None:
             self.debug("region '%s': compiling for key %s "
                        "(%d units, %d leaves)", self.name, key,
                        len(self.units), len(vectors))
-            fn = self._cache[key] = self._build(skips, checks)
-        leaves = [vec._devmem for vec in vectors]
-        if checks:
+            # compile/retrace counter: the steady-state retrace guard
+            # asserts this stays flat once every variant is warmed.
+            # jit compiles lazily, so the first dispatch rides inside
+            # the compile span — that is where the trace+compile
+            # cost actually lands.
+            _metrics.xla_compiles(f"region:{self.name}").inc()
+            with _tracing.TRACER.span(f"compile:{self.name}",
+                                      cat="compile"):
+                fn = self._cache[key] = self._build(skips, checks)
+                if checks:
+                    err, out = fn(*leaves)
+                    err.throw()
+                else:
+                    out = fn(*leaves)
+        elif checks:
             err, out = fn(*leaves)
             err.throw()  # located NaN/inf/OOB report, e.g. "nan
             #              generated by primitive: log" + traceback
         else:
             out = fn(*leaves)
+        _metrics.region_steps(self.name).inc()
         for vec, leaf in zip(vectors, out):
             vec.devmem = leaf
 
@@ -267,6 +283,11 @@ class JitRegion(Logger):
         vectors = self._vectors
         units = self.units
         precision = getattr(self.device, "matmul_precision", "default")
+        # telemetry: trace each member under jax.named_scope so the
+        # compiled program's op names (and thus trace_top's fusion
+        # rows) carry unit attribution; resolved at trace time so a
+        # cached program keeps whatever naming it compiled with
+        named = _metrics.enabled()
 
         def fn(*leaves):
             for vec, leaf in zip(vectors, leaves):
@@ -275,7 +296,12 @@ class JitRegion(Logger):
             try:
                 with jax.default_matmul_precision(precision):
                     for unit, skip in zip(units, skips):
-                        if not skip:
+                        if skip:
+                            continue
+                        if named:
+                            with jax.named_scope(unit.name):
+                                unit.xla_run()
+                        else:
                             unit.xla_run()
                 return tuple(vec._devmem for vec in vectors)
             finally:
@@ -326,6 +352,7 @@ class JitRegion(Logger):
         if fn is None:
             self.debug("region '%s': compiling %d-step scan chunk",
                        self.name, n_steps)
+            _metrics.xla_compiles(f"region:{self.name}").inc()
             body = self.build_callable(skips)
             # Loop-invariant analysis: leaves the body never writes
             # (datasets, schedule tables) must NOT ride the scan carry
@@ -367,7 +394,17 @@ class JitRegion(Logger):
 
             fn = self._cache[key] = jax.jit(
                 chunk_fn, donate_argnums=tuple(range(len(vectors))))
-        out = fn(*leaves)
+            with _tracing.TRACER.span(f"compile:{self.name}",
+                                      cat="compile", chunk=n_steps):
+                out = fn(*leaves)  # first dispatch = trace+compile
+        else:
+            # chunked dispatches bypass RegionUnit._fire (bench /
+            # run_chunked drive this directly), so the dispatch gets
+            # its own span — one per chunk, not per step
+            with _tracing.TRACER.span(f"chunk:{self.name}",
+                                      cat="region", steps=n_steps):
+                out = fn(*leaves)
+        _metrics.region_steps(self.name).inc(n_steps)
         for vec, leaf in zip(vectors, out):
             vec.devmem = leaf
 

@@ -26,6 +26,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from znicz_tpu.observe import metrics as _metrics
 from znicz_tpu.utils.config import root
 from znicz_tpu.utils.logger import Logger
 
@@ -82,6 +83,11 @@ class NumpyDevice(Device):
     backend = "numpy"
     is_host_only = True
 
+    def __init__(self, **kwargs) -> None:
+        super().__init__(**kwargs)
+        if _metrics.enabled():
+            _metrics.backend_info(self.backend, "host").set(1)
+
     def put(self, arr: np.ndarray, vector=None) -> np.ndarray:
         return arr
 
@@ -130,6 +136,8 @@ class XLADevice(Device):
                    "mesh=%s)", device, device.platform, self.compute_dtype,
                    self.matmul_precision,
                    None if mesh is None else dict(mesh.shape))
+        if _metrics.enabled():
+            _metrics.backend_info(self.backend, device.platform).set(1)
 
     @property
     def supports_donation(self) -> bool:

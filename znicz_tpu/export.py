@@ -38,6 +38,8 @@ import numpy as np
 from znicz_tpu.backends import Device, NumpyDevice
 from znicz_tpu.dummy import DummyUnit, DummyWorkflow
 from znicz_tpu.memory import Vector
+from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.observe import tracing as _tracing
 from znicz_tpu.utils.logger import Logger
 from znicz_tpu.serving.buckets import bucket_for, ladder
 
@@ -332,9 +334,14 @@ class ExportedModel(Logger):
                 np.shape(arr), np.dtype(arr.dtype),
                 sharding=getattr(arr, "sharding", None))
 
-        compiled = jitted.lower(
-            struct(input_leaf), *[struct(leaf) for leaf in leaves]
-        ).compile()
+        with _tracing.TRACER.span(
+                f"aot_compile:b{self._cur_batch}", cat="compile"):
+            compiled = jitted.lower(
+                struct(input_leaf), *[struct(leaf) for leaf in leaves]
+            ).compile()
+        # the same series the jit regions count on — the serving side
+        # of the steady-state retrace guard watches this site
+        _metrics.xla_compiles("serving-aot").inc()
         # lowering traced fn, which wrote tracers into vec._devmem;
         # restore the real arrays so later _initialize rounds (other
         # bucket sizes) never snapshot a dead tracer

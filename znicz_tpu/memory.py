@@ -25,8 +25,19 @@ from typing import TYPE_CHECKING
 
 import numpy as np
 
+from znicz_tpu.observe import metrics as _metrics
+
 if TYPE_CHECKING:  # pragma: no cover
     from znicz_tpu.backends import Device
+
+
+def _count_transfer(direction: str, nbytes: int) -> None:
+    """Telemetry: host<->device traffic through the map/unmap
+    protocol (the explicit-transfer invariant makes this THE place
+    transfer volume is knowable).  Gated — disabled telemetry costs
+    one dict lookup on an already-transferring path."""
+    if _metrics.enabled():
+        _metrics.transfer_bytes(direction).inc(nbytes)
 
 
 def _is_float_dtype(dt: np.dtype) -> bool:
@@ -119,6 +130,7 @@ class Vector:
             return
         if self._state == _State.HOST:
             self._devmem = device.put(self._mem, vector=self)
+            _count_transfer("h2d", self._mem.nbytes)
             self._state = _State.SYNCED
 
     @property
@@ -144,6 +156,7 @@ class Vector:
         if self._state == _State.DEVICE:
             assert self._device is not None
             self._mem = self._device.get(self._devmem)
+            _count_transfer("d2h", self._mem.nbytes)
             self._state = _State.SYNCED
 
     def map_write(self) -> None:
@@ -177,6 +190,7 @@ class Vector:
             return
         if self._state == _State.HOST:
             self._devmem = self._device.put(self._mem, vector=self)
+            _count_transfer("h2d", self._mem.nbytes)
         self._state = _State.DEVICE
 
     # ------------------------------------------------------------------

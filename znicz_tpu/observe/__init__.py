@@ -1,0 +1,32 @@
+"""Unified telemetry: metrics registry + host-span tracing.
+
+One observability layer shared by the training engine and the serving
+engine (the modern equivalent of the reference's live workflow
+introspection — plotters and ``veles/web_status.py``):
+
+- :mod:`znicz_tpu.observe.metrics` — a thread-safe process-local
+  registry of counters/gauges/histograms with JSON and Prometheus
+  text exposition.  ``WebStatusServer`` serves it at ``/metrics``.
+- :mod:`znicz_tpu.observe.tracing` — a host-side span tracer (unit
+  fires, epochs, compiles, serving dispatches) exporting
+  Chrome-trace/Perfetto JSON, served live at ``/trace.json`` and
+  merged with device traces by ``trace_top.py --spans``.
+- :func:`profile_window` — capture a ``jax.profiler`` device trace +
+  the window's host spans around any region.
+
+Master gate: ``root.common.engine.telemetry`` (default on;
+near-zero overhead — hot sites check :func:`enabled` first).
+"""
+
+from znicz_tpu.observe.metrics import (  # noqa: F401
+    DEFAULT_BUCKETS,
+    REGISTRY,
+    MetricsRegistry,
+    enabled,
+)
+from znicz_tpu.observe.tracing import (  # noqa: F401
+    TRACER,
+    SpanTracer,
+    now_us,
+    profile_window,
+)

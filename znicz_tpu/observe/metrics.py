@@ -1,0 +1,476 @@
+"""Process-local metrics registry: counters, gauges, histograms.
+
+The reference framework's observability was live *introspection* —
+plotters and the web status page read whatever attributes a workflow
+happened to expose (``veles/web_status.py``).  This module is the
+modern equivalent's measurement half: a thread-safe, process-local
+registry of named metric families in the Prometheus data model
+
+- **counter** — monotone accumulator (``znicz_xla_compiles_total``),
+- **gauge** — set-to-current value (``znicz_serving_queue_rows``),
+- **histogram** — fixed-bucket distribution with cumulative
+  ``le``-bucket counts (``znicz_unit_run_seconds``),
+
+each optionally split by a small, fixed set of labels.  Two
+expositions: :meth:`MetricsRegistry.to_prometheus` (text format 0.0.4,
+what ``WebStatusServer`` serves at ``/metrics``) and
+:meth:`MetricsRegistry.to_json` (the machine-readable feed).
+
+Design constraints, in order:
+
+1. **Near-zero overhead when telemetry is off** — every hot-path
+   instrumentation site checks :func:`enabled`
+   (``root.common.engine.telemetry``, default on) before doing any
+   work; a disabled gate costs one dict lookup.
+2. **Thread safety** — the serving scheduler thread, the web-status
+   handler threads and the training loop all touch the registry; one
+   registry-level lock guards family creation and every child update
+   (contention is negligible: host-side events are O(kHz)).
+3. **Bounded cardinality** — labels are unit/bucket/direction-shaped
+   (dozens of children), never per-request.
+
+Canonical series used across the framework live here as helper
+constructors (:func:`xla_compiles`, :func:`unit_run_seconds`, …) so
+instrumentation sites and tests agree on names by construction.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from collections import OrderedDict
+from typing import Callable, Iterable
+
+from znicz_tpu.utils.config import root
+
+
+def enabled() -> bool:
+    """The telemetry master gate: ``root.common.engine.telemetry``
+    (default on).  Hot-path instrumentation (per-unit spans/timing,
+    transfer byte counts) short-circuits on this; rare-event counters
+    (compiles, snapshots) and the serving engine's own stats are
+    always recorded — they are functional state, not overhead."""
+    return bool(root.common.engine.get("telemetry", True))
+
+
+#: default histogram bounds (seconds): log-ish ladder from 0.1 ms to
+#: 30 s — covers unit fires, serve latencies and snapshot writes
+DEFAULT_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+
+
+def _fmt(value: float) -> str:
+    """Prometheus sample-value formatting: integral floats print as
+    integers, +Inf spelled the Prometheus way."""
+    if value == math.inf:
+        return "+Inf"
+    if value == -math.inf:
+        return "-Inf"
+    f = float(value)
+    if f.is_integer() and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+class Counter:
+    """Monotone accumulator child."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock: threading.RLock) -> None:
+        self._lock = lock
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counter increment must be >= 0, "
+                             f"got {amount}")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Gauge:
+    """Set-to-current child.  ``set_function`` turns it into a
+    callback gauge read at collect time (live queue depths)."""
+
+    __slots__ = ("_lock", "_value", "_fn")
+
+    def __init__(self, lock: threading.RLock) -> None:
+        self._lock = lock
+        self._value = 0.0
+        self._fn: Callable[[], float] | None = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+            self._fn = None
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._fn = fn
+
+    @property
+    def value(self) -> float:
+        fn = self._fn
+        if fn is not None:
+            try:
+                return float(fn())
+            except Exception:  # noqa: BLE001 — a dead callback reads 0
+                return 0.0
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket distribution child with Prometheus ``le``
+    semantics (cumulative counts of observations <= bound)."""
+
+    __slots__ = ("_lock", "bounds", "counts", "sum", "count", "_max")
+
+    def __init__(self, lock: threading.RLock,
+                 bounds: tuple[float, ...]) -> None:
+        self._lock = lock
+        self.bounds = bounds
+        self.counts = [0] * (len(bounds) + 1)  # +1: the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+        self._max = -math.inf
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        idx = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self.counts[idx] += 1
+            self.sum += value
+            self.count += 1
+            if value > self._max:
+                self._max = value
+
+    def percentile(self, q: float) -> float:
+        """Bucket-interpolated percentile estimate (error bounded by
+        the width of the bucket the true quantile lands in — the
+        classic Prometheus ``histogram_quantile`` math)."""
+        with self._lock:
+            total = self.count
+            if not total:
+                return 0.0
+            rank = q / 100.0 * total
+            cum = 0
+            for i, n in enumerate(self.counts):
+                if not n:
+                    continue
+                lo_cum = cum
+                cum += n
+                if cum >= rank:
+                    lo = self.bounds[i - 1] if i > 0 else 0.0
+                    hi = (self.bounds[i] if i < len(self.bounds)
+                          else max(self._max, lo))
+                    frac = (rank - lo_cum) / n
+                    return lo + (hi - lo) * frac
+            return max(self._max, 0.0)
+
+
+class MetricFamily:
+    """One named metric + its labeled children."""
+
+    KINDS = ("counter", "gauge", "histogram")
+    _CHILD = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+    def __init__(self, name: str, kind: str, help_: str,
+                 labelnames: tuple[str, ...],
+                 lock: threading.RLock,
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS) -> None:
+        if kind not in self.KINDS:
+            raise ValueError(f"unknown metric kind '{kind}'")
+        self.name = name
+        self.kind = kind
+        self.help = help_
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(sorted(buckets))
+        self._lock = lock
+        self._children: "OrderedDict[tuple, object]" = OrderedDict()
+
+    def labels(self, **labelvalues):
+        """The child for this label combination, created on first
+        use.  Label names must match the family declaration exactly."""
+        if tuple(sorted(labelvalues)) != tuple(sorted(self.labelnames)):
+            raise ValueError(
+                f"metric '{self.name}' declares labels "
+                f"{self.labelnames}, got {tuple(sorted(labelvalues))}")
+        key = tuple(str(labelvalues[n]) for n in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                if self.kind == "histogram":
+                    child = Histogram(self._lock, self.buckets)
+                else:
+                    child = self._CHILD[self.kind](self._lock)
+                self._children[key] = child
+            return child
+
+    # label-less convenience: the family IS its single child ---------
+    def _solo(self):
+        if self.labelnames:
+            raise ValueError(
+                f"metric '{self.name}' has labels {self.labelnames} — "
+                f"address a child via .labels(...)")
+        return self.labels()
+
+    def inc(self, amount: float = 1.0) -> None:
+        self._solo().inc(amount)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._solo().dec(amount)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        self._solo().set_function(fn)
+
+    def set(self, value: float) -> None:
+        self._solo().set(value)
+
+    def observe(self, value: float) -> None:
+        self._solo().observe(value)
+
+    @property
+    def value(self) -> float:
+        return self._solo().value
+
+    def items(self) -> list[tuple[tuple, object]]:
+        with self._lock:
+            return list(self._children.items())
+
+
+class MetricsRegistry:
+    """Thread-safe, process-local registry of metric families."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._families: "OrderedDict[str, MetricFamily]" = OrderedDict()
+
+    # ------------------------------------------------------------------
+    # declaration (idempotent: re-declaring the same family returns it)
+    # ------------------------------------------------------------------
+    def _declare(self, name: str, kind: str, help_: str,
+                 labels: Iterable[str],
+                 buckets: tuple[float, ...] = DEFAULT_BUCKETS
+                 ) -> MetricFamily:
+        labels = tuple(labels)
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                if fam.kind != kind or fam.labelnames != labels:
+                    raise ValueError(
+                        f"metric '{name}' already registered as "
+                        f"{fam.kind}{fam.labelnames}, cannot re-declare "
+                        f"as {kind}{labels}")
+                return fam
+            fam = MetricFamily(name, kind, help_, labels, self._lock,
+                               buckets=buckets)
+            self._families[name] = fam
+            return fam
+
+    def counter(self, name: str, help_: str = "",
+                labels: Iterable[str] = ()) -> MetricFamily:
+        return self._declare(name, "counter", help_, labels)
+
+    def gauge(self, name: str, help_: str = "",
+              labels: Iterable[str] = ()) -> MetricFamily:
+        return self._declare(name, "gauge", help_, labels)
+
+    def histogram(self, name: str, help_: str = "",
+                  labels: Iterable[str] = (),
+                  buckets: tuple[float, ...] = DEFAULT_BUCKETS
+                  ) -> MetricFamily:
+        return self._declare(name, "histogram", help_, labels,
+                             buckets=buckets)
+
+    def get(self, name: str) -> MetricFamily | None:
+        with self._lock:
+            return self._families.get(name)
+
+    def clear(self) -> None:
+        """Drop every family (tests)."""
+        with self._lock:
+            self._families.clear()
+
+    # ------------------------------------------------------------------
+    # exposition
+    # ------------------------------------------------------------------
+    def to_json(self) -> dict:
+        out: dict = {}
+        with self._lock:
+            families = list(self._families.values())
+        for fam in families:
+            rows = []
+            for key, child in fam.items():
+                labels = dict(zip(fam.labelnames, key))
+                if fam.kind == "histogram":
+                    rows.append({
+                        "labels": labels,
+                        "buckets": {_fmt(b): c for b, c in zip(
+                            fam.buckets + (math.inf,), child.counts)},
+                        "sum": child.sum, "count": child.count})
+                else:
+                    rows.append({"labels": labels,
+                                 "value": child.value})
+            out[fam.name] = {"type": fam.kind, "help": fam.help,
+                             "values": rows}
+        return out
+
+    def to_prometheus(self) -> str:
+        """Text exposition format 0.0.4."""
+        lines: list[str] = []
+        with self._lock:
+            families = list(self._families.values())
+        for fam in families:
+            if fam.help:
+                lines.append(f"# HELP {fam.name} {fam.help}")
+            lines.append(f"# TYPE {fam.name} {fam.kind}")
+            for key, child in fam.items():
+                pairs = [f'{n}="{_escape_label(v)}"'
+                         for n, v in zip(fam.labelnames, key)]
+                base = ",".join(pairs)
+                if fam.kind == "histogram":
+                    cum = 0
+                    for bound, n in zip(fam.buckets + (math.inf,),
+                                        child.counts):
+                        cum += n
+                        le = ([f'le="{_fmt(bound)}"'] if not base
+                              else pairs + [f'le="{_fmt(bound)}"'])
+                        lines.append(
+                            f"{fam.name}_bucket{{{','.join(le)}}} {cum}")
+                    suffix = f"{{{base}}}" if base else ""
+                    lines.append(
+                        f"{fam.name}_sum{suffix} {_fmt(child.sum)}")
+                    lines.append(
+                        f"{fam.name}_count{suffix} {child.count}")
+                else:
+                    suffix = f"{{{base}}}" if base else ""
+                    lines.append(
+                        f"{fam.name}{suffix} {_fmt(child.value)}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+#: the process-global registry every framework series registers on
+REGISTRY = MetricsRegistry()
+
+
+# ----------------------------------------------------------------------
+# canonical framework series — single home for the names so
+# instrumentation sites, the dryrun attestation and the tests agree
+# ----------------------------------------------------------------------
+def xla_compiles(site: str) -> Counter:
+    """XLA trace+compile events: jit-region variants, scan chunks and
+    serving AOT programs, labeled by site.  The steady-state retrace
+    guard asserts this stays flat on warmed paths."""
+    return REGISTRY.counter(
+        "znicz_xla_compiles_total",
+        "XLA program compiles (jit-region variants, scan chunks, "
+        "serving AOT buckets)", labels=("site",)).labels(site=site)
+
+
+def unit_run_seconds(unit: str) -> Histogram:
+    """Per-unit ``run()`` wall time (host control plane)."""
+    return REGISTRY.histogram(
+        "znicz_unit_run_seconds",
+        "Unit.run wall time by unit name",
+        labels=("unit",)).labels(unit=unit)
+
+
+def transfer_bytes(direction: str) -> Counter:
+    """Host<->device transfer volume through the Vector map/unmap
+    protocol (``h2d`` uploads, ``d2h`` fetches)."""
+    return REGISTRY.counter(
+        "znicz_device_transfer_bytes_total",
+        "Vector host<->device transfer bytes by direction",
+        labels=("direction",)).labels(direction=direction)
+
+
+def snapshot_seconds(op: str) -> Histogram:
+    return REGISTRY.histogram(
+        "znicz_snapshot_seconds",
+        "Snapshot state-tree save/load duration",
+        labels=("op",)).labels(op=op)
+
+
+def epochs_total(workflow: str) -> Counter:
+    return REGISTRY.counter(
+        "znicz_epochs_total", "Training epochs completed",
+        labels=("workflow",)).labels(workflow=workflow)
+
+
+def region_steps(region: str) -> Counter:
+    return REGISTRY.counter(
+        "znicz_region_steps_total",
+        "Jit-region device steps dispatched (scan chunks count each "
+        "inner step)", labels=("region",)).labels(region=region)
+
+
+def backend_info(backend: str, platform: str) -> Gauge:
+    return REGISTRY.gauge(
+        "znicz_backend_info",
+        "Active device backend (value is always 1; read the labels)",
+        labels=("backend", "platform")).labels(
+            backend=backend, platform=platform)
+
+
+def serving_requests(engine: str, event: str) -> Counter:
+    return REGISTRY.counter(
+        "znicz_serving_requests_total",
+        "Serving requests by lifecycle event "
+        "(submitted/served/rejected)",
+        labels=("engine", "event")).labels(engine=engine, event=event)
+
+
+def serving_latency_seconds(engine: str) -> Histogram:
+    return REGISTRY.histogram(
+        "znicz_serving_latency_seconds",
+        "Serving enqueue->reply latency",
+        labels=("engine",)).labels(engine=engine)
+
+
+def serving_queue_rows(engine: str) -> Gauge:
+    return REGISTRY.gauge(
+        "znicz_serving_queue_rows",
+        "Rows pending in the continuous batcher's bounded queue",
+        labels=("engine",)).labels(engine=engine)
+
+
+def serving_bucket_batches(engine: str, bucket: int) -> Counter:
+    return REGISTRY.counter(
+        "znicz_serving_bucket_batches_total",
+        "Coalesced batches dispatched per bucket size",
+        labels=("engine", "bucket")).labels(engine=engine,
+                                            bucket=bucket)
+
+
+def serving_bucket_rows(engine: str, bucket: int) -> Counter:
+    return REGISTRY.counter(
+        "znicz_serving_bucket_rows_total",
+        "Real (non-padded) rows served per bucket size",
+        labels=("engine", "bucket")).labels(engine=engine,
+                                            bucket=bucket)
+
+
+def serving_warmup_seconds(engine: str) -> Gauge:
+    return REGISTRY.gauge(
+        "znicz_serving_warmup_seconds",
+        "Wall time spent AOT-compiling the bucket ladder at start()",
+        labels=("engine",)).labels(engine=engine)

@@ -1,0 +1,223 @@
+"""Host-side span tracer: Dapper-style spans over the control plane.
+
+The training engine's device time is already observable through
+``jax.profiler`` traces (summarized by ``benchmarks/trace_top.py``);
+what was missing is the HOST half — which unit, epoch, or serving
+request the device lanes were working for.  This module records
+host-side spans (unit fires, workflow runs, epochs, serving batch
+dispatches, compiles) into a bounded ring buffer and exports them as
+Chrome-trace/Perfetto JSON (``ph: "X"`` complete events), so
+``chrome://tracing`` / Perfetto can show them, ``WebStatusServer``
+serves them live at ``/trace.json``, and ``trace_top.py --spans``
+merges them with a device-trace summary.
+
+Correlation with XLA device lanes: inside every span the tracer also
+enters ``jax.profiler.TraceAnnotation`` (a TraceMe), so when a
+``jax.profiler`` trace window is open the SAME span appears on the
+profiler's host thread lane, lined up against the device lanes — one
+timeline, two sources.  (``jax.named_scope`` is the tracing-time
+cousin: the jit-region builder enters it per member unit so device-op
+names carry unit attribution — see
+``JitRegion.build_callable``.)
+
+:func:`profile_window` is the capture helper: a context manager that
+opens a ``jax.profiler`` trace around any region (N training steps, a
+bench's timed loop) and drops the window's host spans beside it as
+``host_spans.trace.json`` — every committed BENCH row can carry both.
+
+All recording is gated on :func:`znicz_tpu.observe.metrics.enabled`
+(``root.common.engine.telemetry``); a disabled tracer costs one dict
+lookup per span.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+from znicz_tpu.observe import metrics as _metrics
+
+#: trace time zero (module import); spans report microseconds since
+_EPOCH = time.perf_counter()
+
+
+def now_us() -> float:
+    """Microseconds since the tracer epoch (the Chrome-trace ``ts``
+    time base)."""
+    return (time.perf_counter() - _EPOCH) * 1e6
+
+
+def _trace_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` for ``name`` when jax is
+    importable (it always is in this framework; the guard keeps the
+    tracer usable standalone)."""
+    try:
+        import jax
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 — tracer must never break the host loop
+        return None
+
+
+class SpanTracer:
+    """Bounded ring buffer of completed host spans."""
+
+    def __init__(self, max_events: int = 65536) -> None:
+        self._events: deque = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._seq = 0
+        self._pid = os.getpid()
+
+    # ------------------------------------------------------------------
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def _append(self, event: dict) -> None:
+        with self._lock:
+            self._seq += 1
+            event["_seq"] = self._seq
+            self._events.append(event)
+
+    def mark(self) -> int:
+        """A position marker; pass to :meth:`to_chrome_trace` /
+        :meth:`export` as ``since`` to keep only later events."""
+        with self._lock:
+            return self._seq
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+
+    # ------------------------------------------------------------------
+    @contextmanager
+    def span(self, name: str, cat: str = "host", **args):
+        """Record a span around the with-body.  Nesting is tracked per
+        thread (the ``depth`` arg on the event); inside the span a
+        ``jax.profiler.TraceAnnotation`` is open so a concurrently
+        captured device trace carries the same span on its host lane."""
+        if not _metrics.enabled():
+            yield
+            return
+        stack = self._stack()
+        depth = len(stack)
+        stack.append(name)
+        ann = _trace_annotation(name)
+        if ann is not None:
+            ann.__enter__()
+        t0 = now_us()
+        try:
+            yield
+        finally:
+            t1 = now_us()
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            stack.pop()
+            self._append({
+                "ph": "X", "name": name, "cat": cat,
+                "pid": self._pid, "tid": threading.get_native_id(),
+                "ts": t0, "dur": t1 - t0,
+                "args": {**args, "depth": depth}})
+
+    def complete(self, name: str, t0_us: float, t1_us: float,
+                 cat: str = "host", **args) -> None:
+        """Record a retroactive span from explicit timestamps (epoch
+        boundaries are only known at the END of the epoch)."""
+        if not _metrics.enabled():
+            return
+        self._append({
+            "ph": "X", "name": name, "cat": cat,
+            "pid": self._pid, "tid": threading.get_native_id(),
+            "ts": t0_us, "dur": max(0.0, t1_us - t0_us),
+            "args": {**args, "depth": 0}})
+
+    def instant(self, name: str, cat: str = "host", **args) -> None:
+        if not _metrics.enabled():
+            return
+        self._append({
+            "ph": "i", "s": "t", "name": name, "cat": cat,
+            "pid": self._pid, "tid": threading.get_native_id(),
+            "ts": now_us(), "args": dict(args)})
+
+    # ------------------------------------------------------------------
+    def to_chrome_trace(self, since: int = 0) -> dict:
+        """The Chrome-trace/Perfetto JSON object (``traceEvents``)."""
+        with self._lock:
+            events = [ev for ev in self._events if ev["_seq"] > since]
+        out_events = [{"ph": "M", "name": "process_name",
+                       "pid": self._pid, "tid": 0,
+                       "args": {"name": "znicz_tpu host spans"}}]
+        for ev in events:
+            ev = dict(ev)
+            ev.pop("_seq", None)
+            out_events.append(ev)
+        return {"traceEvents": out_events, "displayTimeUnit": "ms"}
+
+    def export(self, path: str, since: int = 0) -> str:
+        with open(path, "w") as fh:
+            json.dump(self.to_chrome_trace(since=since), fh)
+        return path
+
+
+#: the process-global tracer every instrumentation site records on
+TRACER = SpanTracer()
+
+
+@contextmanager
+def profile_window(outdir: str, n_steps: int | None = None,
+                   device: bool = True, tracer: SpanTracer | None = None):
+    """Capture a ``jax.profiler`` device trace plus the window's host
+    spans around the with-body.
+
+    ``outdir`` receives the profiler's trace directory (the usual
+    ``*.trace.json.gz`` tree ``trace_top.py`` reads) and
+    ``host_spans.trace.json`` (Chrome-trace JSON of the host spans
+    recorded during the window — feed it to ``trace_top.py --spans``).
+    ``n_steps`` is recorded on the window span so per-step math in the
+    post-processors has its divisor.  ``device=False`` skips the jax
+    profiler (host spans only — cheap enough for always-on use).
+
+    Usage mid-training::
+
+        with observe.profile_window("profiles/r09", n_steps=32):
+            for _ in range(32):
+                step()
+    """
+    if tracer is None:  # NOT `or`: an empty SpanTracer is falsy
+        tracer = TRACER
+    os.makedirs(outdir, exist_ok=True)
+    started = False
+    if device:
+        try:
+            import jax
+            jax.profiler.start_trace(outdir)
+            started = True
+        except Exception as exc:  # noqa: BLE001 — an open trace must not kill the run
+            import logging
+            logging.getLogger("znicz_tpu.observe").warning(
+                "profile_window: device trace unavailable (%s) — "
+                "recording host spans only", exc)
+    mark = tracer.mark()
+    try:
+        with tracer.span("profile_window", cat="profile",
+                         n_steps=n_steps or 0):
+            yield outdir
+    finally:
+        if started:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001 — already stopped elsewhere
+                pass
+        tracer.export(os.path.join(outdir, "host_spans.trace.json"),
+                      since=mark)

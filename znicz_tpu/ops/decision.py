@@ -22,6 +22,8 @@ import numpy as np
 from znicz_tpu.loader.base import CLASS_NAME, TRAIN, VALID
 from znicz_tpu.memory import Vector
 from znicz_tpu.mutable import Bool
+from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.observe import tracing as _tracing
 from znicz_tpu.units import Unit
 
 
@@ -39,18 +41,34 @@ class DecisionBase(Unit):
         # linked from loader by the workflow builder:
         self.loader = None
         self._epochs_without_improvement = 0
+        self._epoch_t0_us: float | None = None  # telemetry span base
 
     def on_epoch_ended(self) -> None:
         """Subclass hook: finalize epoch stats, update improved flag."""
 
     def run(self) -> None:
         loader = self.loader
+        if self._epoch_t0_us is None:
+            self._epoch_t0_us = _tracing.now_us()
         self.improved.value = False
         self.epoch_ended.value = False
         self.accumulate_minibatch()
         if loader.epoch_ended:
             self.on_epoch_ended()
             self.epoch_ended.value = True
+            if _metrics.enabled():
+                # epoch boundaries are only known here, so the epoch
+                # span is recorded retroactively: one "X" event per
+                # epoch over the device lanes in a merged timeline
+                now = _tracing.now_us()
+                wf = self.workflow
+                wf_name = wf.name if wf is not None else "?"
+                _tracing.TRACER.complete(
+                    f"epoch:{int(loader.epoch_number)}",
+                    self._epoch_t0_us, now, cat="epoch",
+                    workflow=wf_name)
+                self._epoch_t0_us = now
+                _metrics.epochs_total(wf_name).inc()
             if self.improved:
                 self._epochs_without_improvement = 0
             else:

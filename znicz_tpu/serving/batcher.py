@@ -35,6 +35,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from znicz_tpu.observe import tracing as _tracing
 from znicz_tpu.utils.logger import Logger
 
 
@@ -60,7 +61,7 @@ class ContinuousBatcher(Logger):
 
     def __init__(self, run_batch, *, max_batch: int,
                  max_delay_ms: float = 5.0, max_queue: int = 1024,
-                 name: str = "serving") -> None:
+                 name: str = "serving", queue_gauge=None) -> None:
         super().__init__()
         if max_queue < max_batch:
             raise ValueError(
@@ -70,6 +71,9 @@ class ContinuousBatcher(Logger):
         self.max_batch = int(max_batch)
         self.max_delay = float(max_delay_ms) / 1000.0
         self.max_queue = int(max_queue)
+        #: optional observe.metrics Gauge tracking pending rows live
+        #: (the engine passes its per-engine-labeled child)
+        self._queue_gauge = queue_gauge
         self._pending: deque[Request] = deque()
         self._rows = 0
         self._cond = threading.Condition()
@@ -104,6 +108,8 @@ class ContinuousBatcher(Logger):
                     f"limit {self.max_queue})")
             self._pending.append(req)
             self._rows += req.n
+            if self._queue_gauge is not None:
+                self._queue_gauge.set(self._rows)
             self._cond.notify_all()
         return req.future
 
@@ -147,12 +153,17 @@ class ContinuousBatcher(Logger):
                     rows += req.n
                     batch.append(req)
                 self._rows -= rows
+                if self._queue_gauge is not None:
+                    self._queue_gauge.set(self._rows)
                 self._flush_now = False
                 self._cond.notify_all()
             if not batch:  # pragma: no cover - spurious wakeup guard
                 continue
             try:
-                self._run_batch(batch)
+                with _tracing.TRACER.span("serve_batch", cat="serving",
+                                          requests=len(batch),
+                                          rows=rows):
+                    self._run_batch(batch)
             except Exception as exc:  # noqa: BLE001 - fail THIS batch only
                 self.warning("batch of %d requests failed: %s",
                              len(batch), exc)

@@ -27,15 +27,20 @@ donation the device consumes the uploaded buffer, and alternating the
 host side keeps refills off any buffer a still-in-flight upload may
 read, without allocating per request.
 
-Telemetry: per-request enqueue→reply latency (p50/p95/p99 over a
-sliding window) and per-bucket batch/row/occupancy counters, exposed
-through :meth:`stats` / :meth:`serving_status` (the latter is what
-``web_status.gather_status`` renders when an engine is registered on
-the dashboard).
+Telemetry: every counter lives in the process-global
+:mod:`znicz_tpu.observe` registry under per-engine labels
+(``znicz_serving_requests_total``, ``znicz_serving_latency_seconds``,
+``znicz_serving_queue_rows``, per-bucket batch/row counters) so a
+Prometheus scrape of ``/metrics`` sees serving beside the training
+series; :meth:`stats` / :meth:`serving_status` are VIEWS over those
+registry children (plus a sliding exact-value window for the
+p50/p95/p99 the dashboard shows — the scrapeable histogram carries the
+same distribution in buckets).
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import deque
@@ -43,11 +48,15 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from znicz_tpu.observe import metrics as _metrics
 from znicz_tpu.serving.batcher import ContinuousBatcher, QueueFull
 from znicz_tpu.serving.buckets import bucket_for, ladder
 from znicz_tpu.utils.logger import Logger
 
 __all__ = ["ServingEngine", "QueueFull"]
+
+#: distinguishes same-named engines in the registry's labels
+_ENGINE_SEQ = itertools.count()
 
 
 def _percentile(sorted_vals: list[float], q: float) -> float:
@@ -110,13 +119,23 @@ class ServingEngine(Logger):
         self._staging: dict[int, list[np.ndarray]] = {}
         self._flip: dict[int, int] = {}
         self._lock = threading.Lock()
-        # telemetry ----------------------------------------------------
+        # telemetry: counters live in the observe registry under a
+        # per-engine label (unique even when two engines serve the
+        # same workflow name); stats() reads these children back ----
+        wf_name = self.model.manifest.get("workflow", "model")
+        self._obs_id = f"{wf_name}#{next(_ENGINE_SEQ)}"
+        self._m_submitted = _metrics.serving_requests(
+            self._obs_id, "submitted")
+        self._m_served = _metrics.serving_requests(self._obs_id, "served")
+        self._m_rejected = _metrics.serving_requests(
+            self._obs_id, "rejected")
+        self._m_latency = _metrics.serving_latency_seconds(self._obs_id)
+        self._m_queue = _metrics.serving_queue_rows(self._obs_id)
+        self._m_warmup = _metrics.serving_warmup_seconds(self._obs_id)
+        #: bucket size → (batches counter, rows counter)
+        self._m_bucket: dict[int, tuple] = {}
+        #: exact-value sliding window for the dashboard percentiles
         self._lat = deque(maxlen=4096)  # enqueue→reply seconds
-        self._bucket_rows: dict[int, int] = {}
-        self._bucket_batches: dict[int, int] = {}
-        self.requests_submitted = 0
-        self.requests_served = 0
-        self.requests_rejected = 0
         self.warmup_compiles = 0
         self.warmup_seconds = 0.0
         self._started = False
@@ -163,10 +182,12 @@ class ServingEngine(Logger):
             self._staging[size] = [
                 np.zeros((size,) + shape, dtype=dtype) for _ in range(2)]
             self._flip[size] = 0
+        self._m_warmup.set(self.warmup_seconds)
         self._batcher = ContinuousBatcher(
             self._run_batch, max_batch=self.max_batch,
             max_delay_ms=self.max_delay_ms, max_queue=self.max_queue,
-            name=self.model.manifest.get("workflow", "model"))
+            name=self.model.manifest.get("workflow", "model"),
+            queue_gauge=self._m_queue)
         self._started = True
         self.info(
             "serving '%s': %d AOT programs warmed in %.2fs "
@@ -207,11 +228,9 @@ class ServingEngine(Logger):
         try:
             future = self._batcher.submit(x)
         except QueueFull:
-            with self._lock:
-                self.requests_rejected += 1
+            self._m_rejected.inc()
             raise
-        with self._lock:
-            self.requests_submitted += 1
+        self._m_submitted.inc()
         return future
 
     def __call__(self, x: np.ndarray, timeout: float | None = None
@@ -253,31 +272,52 @@ class ServingEngine(Logger):
             req.future.set_result(np.array(out[row:row + req.n],
                                            copy=True))
             row += req.n
+        self._m_served.inc(len(batch))
         with self._lock:
-            self.requests_served += len(batch)
-            self._bucket_rows[size] = self._bucket_rows.get(size, 0) + total
-            self._bucket_batches[size] = \
-                self._bucket_batches.get(size, 0) + 1
+            pair = self._m_bucket.get(size)
+            if pair is None:
+                pair = self._m_bucket[size] = (
+                    _metrics.serving_bucket_batches(self._obs_id, size),
+                    _metrics.serving_bucket_rows(self._obs_id, size))
+            pair[0].inc()
+            pair[1].inc(total)
             for req in batch:
-                self._lat.append(now - req.t_submit)
+                lat = now - req.t_submit
+                self._lat.append(lat)
+                self._m_latency.observe(lat)
 
     # ------------------------------------------------------------------
     # telemetry
     # ------------------------------------------------------------------
+    @property
+    def requests_submitted(self) -> int:
+        return int(self._m_submitted.value)
+
+    @property
+    def requests_served(self) -> int:
+        return int(self._m_served.value)
+
+    @property
+    def requests_rejected(self) -> int:
+        return int(self._m_rejected.value)
+
     def stats(self) -> dict:
-        """Latency percentiles + per-bucket occupancy counters."""
+        """The engine's live snapshot — a VIEW over this engine's
+        children in the observe registry (the same numbers a
+        Prometheus ``/metrics`` scrape sees), plus exact windowed
+        latency percentiles for the dashboard."""
         with self._lock:
             lat = sorted(self._lat)
-            buckets = {
-                size: {
-                    "batches": self._bucket_batches[size],
-                    "rows": self._bucket_rows[size],
+            buckets = {}
+            for size in sorted(self._m_bucket):
+                batches_c, rows_c = self._m_bucket[size]
+                batches, rows = int(batches_c.value), int(rows_c.value)
+                buckets[size] = {
+                    "batches": batches,
+                    "rows": rows,
                     "occupancy_pt": round(
-                        100.0 * self._bucket_rows[size]
-                        / (self._bucket_batches[size] * size), 1),
+                        100.0 * rows / (batches * size), 1),
                 }
-                for size in sorted(self._bucket_batches)
-            }
             out = {
                 "engine": "bucketed-aot",
                 "replicas": self.n_replicas,

@@ -26,6 +26,8 @@ import time
 from typing import TYPE_CHECKING, Iterable
 
 from znicz_tpu.mutable import Bool, LinkableAttribute
+from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.observe import tracing as _tracing
 from znicz_tpu.utils.logger import Logger
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -229,8 +231,19 @@ class Unit(Logger):
     # engine hook — called by the workflow scheduler
     def _fire(self) -> None:
         start = time.perf_counter()
-        self.run()
-        self.run_time_total += time.perf_counter() - start
+        if _metrics.enabled():
+            # telemetry on: the fire becomes a host span (lined up
+            # with XLA device lanes when a profiler window is open)
+            # and a sample in the per-unit run-time histogram
+            with _tracing.TRACER.span(self.name, cat="unit",
+                                      kind=type(self).__name__):
+                self.run()
+            elapsed = time.perf_counter() - start
+            _metrics.unit_run_seconds(self.name).observe(elapsed)
+        else:
+            self.run()
+            elapsed = time.perf_counter() - start
+        self.run_time_total += elapsed
         self.run_count += 1
 
     def __repr__(self) -> str:

@@ -31,7 +31,10 @@ from __future__ import annotations
 import gzip
 import os
 import pickle
+import time
 
+from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.observe import tracing as _tracing
 from znicz_tpu.units import Unit
 from znicz_tpu.utils.config import root
 
@@ -137,12 +140,21 @@ class Snapshotter(Unit):
         # in depth — run() already single-writes) must not truncate
         # each other's in-progress stream before the atomic replace
         tmp = f"{path}.{os.getpid()}.tmp"
-        with gzip.open(tmp, "wb") as f:
-            pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, path)
+        start = time.perf_counter()
+        with _tracing.TRACER.span("snapshot_save", cat="snapshot"):
+            with gzip.open(tmp, "wb") as f:
+                pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, path)
+        _metrics.snapshot_seconds("save").observe(
+            time.perf_counter() - start)
         return path
 
     @staticmethod
     def load(path: str) -> dict:
-        with gzip.open(path, "rb") as f:
-            return pickle.load(f)
+        start = time.perf_counter()
+        with _tracing.TRACER.span("snapshot_load", cat="snapshot"):
+            with gzip.open(path, "rb") as f:
+                state = pickle.load(f)
+        _metrics.snapshot_seconds("load").observe(
+            time.perf_counter() - start)
+        return state

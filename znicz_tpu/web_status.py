@@ -8,7 +8,12 @@ process's registered workflows: epoch/minibatch progress, best
 metrics, device, mesh shape, per-unit timing.  Implementation is
 stdlib ``http.server`` in a daemon thread (no tornado in this
 environment): ``/`` is a self-refreshing HTML page, ``/status.json``
-the machine-readable feed.
+the machine-readable feed, ``/metrics`` the Prometheus text
+exposition of the process-global :mod:`znicz_tpu.observe` registry
+(compile counts, per-unit run-time histograms, transfer bytes,
+serving latency — everything train + serve register), and
+``/trace.json`` a live Chrome-trace/Perfetto dump of the host-span
+ring buffer (open it in ``ui.perfetto.dev``).
 """
 
 from __future__ import annotations
@@ -77,6 +82,15 @@ class WebStatusServer(Logger):
             def do_GET(self):
                 if self.path.startswith("/status.json"):
                     body = json.dumps(status_server.status()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    from znicz_tpu.observe import metrics
+                    body = metrics.REGISTRY.to_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif self.path.startswith("/trace.json"):
+                    from znicz_tpu.observe import tracing
+                    body = json.dumps(
+                        tracing.TRACER.to_chrome_trace()).encode()
                     ctype = "application/json"
                 elif self.path == "/" or self.path.startswith("/index"):
                     body = status_server.render_html().encode()

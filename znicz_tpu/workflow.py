@@ -18,6 +18,8 @@ from __future__ import annotations
 from collections import deque
 
 from znicz_tpu.mutable import Bool
+from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.observe import tracing as _tracing
 from znicz_tpu.units import Container, EndPoint, StartPoint, Unit
 
 
@@ -88,28 +90,34 @@ class Workflow(Container):
         import time as _time
         self.run_started_at = _time.time()  # consumers (Publisher)
         #                       use it to tell this run's artifacts apart
+        if _metrics.enabled():
+            _metrics.REGISTRY.counter(
+                "znicz_workflow_runs_total", "Workflow.run invocations",
+                labels=("workflow",)).labels(workflow=self.name).inc()
         self._finished = False
         self.stopped.value = False
         queue: deque[Unit] = deque([self.start_point])
         self.start_point.reset_links()
         fires = 0
-        while queue and not self._finished and not self.stopped:
-            unit = queue.popleft()
-            if unit.gate_block:
-                continue
-            if not unit.gate_skip:
-                unit._fire()
-                if self._finished or self.stopped:
-                    break
-            for dst in list(unit.links_to):
-                if dst.open_gate(unit):
-                    dst.reset_links()
-                    queue.append(dst)
-            fires += 1
-            if self._max_fires is not None and fires > self._max_fires:
-                raise RuntimeError(
-                    f"workflow '{self.name}' exceeded max_fires="
-                    f"{self._max_fires} (runaway loop?)")
+        with _tracing.TRACER.span(f"workflow:{self.name}",
+                                  cat="workflow"):
+            while queue and not self._finished and not self.stopped:
+                unit = queue.popleft()
+                if unit.gate_block:
+                    continue
+                if not unit.gate_skip:
+                    unit._fire()
+                    if self._finished or self.stopped:
+                        break
+                for dst in list(unit.links_to):
+                    if dst.open_gate(unit):
+                        dst.reset_links()
+                        queue.append(dst)
+                fires += 1
+                if self._max_fires is not None and fires > self._max_fires:
+                    raise RuntimeError(
+                        f"workflow '{self.name}' exceeded max_fires="
+                        f"{self._max_fires} (runaway loop?)")
         self.on_workflow_finished()
 
     def on_end_point(self) -> None:
