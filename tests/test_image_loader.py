@@ -253,6 +253,31 @@ def test_streaming_prefetch_actually_overlaps(tmp_path):
         f"the compute window")
 
 
+def test_prefetch_crosses_epoch_boundary(image_tree):
+    """Round 10: the counter-based shuffle fixes the next epoch's
+    order before it starts, so the decode prefetch no longer stalls at
+    the boundary — only the very first step is a synchronous miss, and
+    every boundary entry is a recovered (counted) crossing."""
+    from znicz_tpu.utils import prng
+    prng.seed_all(1234)
+    wf = Workflow(name="w_cross")
+    loader = FileImageLoader(
+        wf, train_dir=image_tree, validation_fraction=0.25,
+        out_hw=(24, 24), resize_hw=(28, 28), minibatch_size=6,
+        use_native=True, prefetch=True, n_threads=2)
+    loader.initialize(device=NumpyDevice())
+    n_sched = len(loader._schedule)
+    n_epochs = 3
+    for _ in range(n_epochs * n_sched):
+        loader.run()
+    loader.stop()
+    assert loader.prefetch_misses == 1, (
+        f"expected only the first step synchronous, got "
+        f"{loader.prefetch_misses} misses / {loader.prefetch_hits} hits")
+    assert loader.prefetch_hits == n_epochs * n_sched - 1
+    assert loader.epoch_cross_prefetches == n_epochs - 1
+
+
 def test_fullbatch_image_loader(image_tree):
     wf = Workflow(name="w")
     loader = FullBatchImageLoader(

@@ -86,6 +86,51 @@ def test_warmed_chunked_dispatch_zero_new_compiles():
         "warmed-up scan chunks recompiled"
 
 
+def test_warmed_streaming_loop_zero_new_compiles(tmp_path):
+    """Round 10: the streaming data plane feeds the SAME region
+    signature every step (fixed shapes, raw dtype staged, on-device
+    normalize) — a warmed streamed train loop must never compile,
+    even across the epoch boundaries its prefetch runs through."""
+    from znicz_tpu.loader.streaming import StreamingLoader, write_shards
+
+    rng = np.random.default_rng(2)
+    sdata = rng.integers(0, 255, size=(120, 10), dtype=np.uint8)
+    slabels = (rng.random(120) * 3).astype(np.int32)
+    shards = str(tmp_path / "shards")
+    write_shards(shards, sdata[:96], slabels[:96],
+                 valid_data=sdata[96:], valid_labels=slabels[96:],
+                 rows_per_shard=40)
+    prng.seed_all(17)
+    wf = StandardWorkflow(
+        name="retrace_stream",
+        loader_factory=lambda w: StreamingLoader(
+            w, shards, minibatch_size=12, prefetch_depth=2,
+            normalization_scale=1 / 127.5, normalization_bias=-1.0),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 16},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}}],
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 100_000
+    wf.initialize(device=XLADevice())
+    compiles = obs_metrics.xla_compiles(f"region:{wf._region_unit.name}")
+    wf.run()  # 2 epochs: train + eval variants both warmed
+    warmed = compiles.value
+    assert warmed >= 2
+    for _ in range(10):  # cross class segments AND an epoch boundary
+        wf.loader.run()
+        wf._region_unit.run()
+    try:
+        assert compiles.value == warmed, (
+            f"warmed streaming steps recompiled: "
+            f"{compiles.value - warmed} new XLA programs")
+    finally:
+        wf.stop()
+
+
 @pytest.fixture()
 def served_bundle(tmp_path):
     wf = _build_wf("retrace_serve", max_epochs=1)

@@ -63,6 +63,14 @@ class Device(Logger):
     def put(self, arr: np.ndarray, vector=None):
         raise NotImplementedError
 
+    def put_local_batch(self, arr: np.ndarray, vector=None):
+        """Place a host-staged batch-major buffer.  Single-process
+        backends: identical to :meth:`put`.  Multi-process SPMD
+        overrides assemble the GLOBAL batch from this process's 1/N of
+        the rows — the placement half of the streaming data plane's
+        per-host sharded reads."""
+        return self.put(arr, vector=vector)
+
     def get(self, devarr) -> np.ndarray:
         raise NotImplementedError
 
@@ -199,6 +207,17 @@ class XLADevice(Device):
         if sharding is None:
             return jax.device_put(arr, self.jax_device)
         return jax.device_put(arr, sharding)
+
+    def put_local_batch(self, arr: np.ndarray, vector=None):
+        """Multi-process meshes: ``arr`` holds only THIS process's
+        rows of the (batch-major) buffer; assemble the global sharded
+        array without any cross-host gather.  Single-process falls
+        through to :meth:`put` (arr already is the whole batch)."""
+        if self.mesh is not None and jax.process_count() > 1:
+            sharding = self.sharding_for(vector)
+            assert sharding is not None
+            return jax.make_array_from_process_local_data(sharding, arr)
+        return self.put(arr, vector=vector)
 
     def get(self, devarr) -> np.ndarray:
         if isinstance(devarr, jax.Array) and not devarr.is_fully_addressable:

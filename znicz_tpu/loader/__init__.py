@@ -4,5 +4,8 @@ device-resident full-batch variants whose minibatch assembly is a
 gather that runs *inside* the jit region.
 """
 
-from znicz_tpu.loader.base import Loader, TEST, VALID, TRAIN, CLASS_NAME  # noqa: F401
+from znicz_tpu.loader.base import (Loader, TEST, VALID, TRAIN,  # noqa: F401
+                                   CLASS_NAME, epoch_permutation)
 from znicz_tpu.loader.fullbatch import FullBatchLoader, ArrayLoader  # noqa: F401
+from znicz_tpu.loader.streaming import (StreamingLoader,  # noqa: F401
+                                        ShardReader, write_shards)

@@ -6,7 +6,15 @@ Rebuilds the reference's ``veles/loader/base.py``:
   ``class_lengths``; one *epoch* walks every non-empty class in order
   (test, validation, train), the reference's schedule that lets the
   Decision unit account errors per class;
-- train indices reshuffled every epoch from the seeded PRNG;
+- train indices reshuffled every epoch — **counter-based**: the
+  permutation for epoch *e* is a pure function of ``(shuffle_seed,
+  e)`` through a Philox CBRNG (:func:`epoch_permutation`), not a
+  stateful stream.  Any component can therefore compute any epoch's
+  order without replaying history: prefetchers legally look across
+  epoch boundaries (:meth:`Loader.schedule_entry`), every process of a
+  multi-host run derives the same global order from the shared seed
+  and reads only its 1/N slice, and a resumed run reproduces the
+  exact remaining sequence from the snapshotted seed;
 - the last minibatch of a class is **padded** to the static minibatch
   size (static shapes for XLA) and ``minibatch_valid`` carries the
   true count as a device scalar so evaluators mask the tail —
@@ -22,6 +30,8 @@ the jit region.
 
 from __future__ import annotations
 
+import threading
+
 import numpy as np
 
 from znicz_tpu.accelerated_units import AcceleratedUnit
@@ -31,6 +41,21 @@ from znicz_tpu.utils import prng
 
 TEST, VALID, TRAIN = 0, 1, 2
 CLASS_NAME = {TEST: "test", VALID: "validation", TRAIN: "train"}
+
+_U64 = (1 << 64) - 1
+
+
+def epoch_permutation(seed: int, epoch: int, n: int) -> np.ndarray:
+    """The framework's one shuffle function: a permutation of ``n``
+    as a pure function of ``(seed, epoch)`` via the Philox
+    counter-based RNG.  Every loader family (full-batch, streaming,
+    image) derives its train order here, so a streamed epoch
+    reproduces the resident loader's shuffled order bit-for-bit for
+    the same seed — the determinism contract the streaming data
+    plane's cross-epoch prefetch and per-process sharding rest on."""
+    gen = np.random.Generator(np.random.Philox(
+        key=np.array([seed & _U64, epoch & _U64], dtype=np.uint64)))
+    return gen.permutation(n).astype(np.int32)
 
 
 class Loader(AcceleratedUnit):
@@ -42,8 +67,8 @@ class Loader(AcceleratedUnit):
     """
 
     SNAPSHOT_ATTRS = ("epoch_number", "_cursor", "_shuffled",
-                      "minibatch_class", "minibatch_size",
-                      "minibatch_offset")
+                      "_shuffle_seed", "minibatch_class",
+                      "minibatch_size", "minibatch_offset")
     # transient per-step buffers; resume regenerates them next step
     SNAPSHOT_EXCLUDE = ("minibatch_data", "minibatch_labels",
                         "minibatch_indices", "minibatch_valid")
@@ -77,6 +102,14 @@ class Loader(AcceleratedUnit):
         self._schedule: list[tuple[int, int, int]] = []  # (class, lo, hi)
         self._cursor = 0
         self._shuffled: np.ndarray | None = None
+        #: root of the counter-based shuffle: (seed, epoch) → order.
+        #: Drawn once from the loader PRNG at initialize (so the global
+        #: seed still decides the trajectory) and snapshotted.
+        self._shuffle_seed = 0
+        self._order_cache: dict[tuple[int, int], np.ndarray] = {}
+        #: producer threads (streaming prefetch, decode pools) call
+        #: train_order concurrently with the control plane
+        self._order_lock = threading.Lock()
         self._host_indices: np.ndarray | None = None
         #: device-resident schedule copies need (re)uploading
         self._sched_dirty = True
@@ -135,6 +168,10 @@ class Loader(AcceleratedUnit):
         self.create_minibatch_data()
         self.init_vectors(self.minibatch_data, self.minibatch_labels,
                           self.minibatch_indices, self.minibatch_valid)
+        # one draw from the shared stream roots ALL epoch permutations
+        # (snapshot resume restores the saved seed over this one)
+        self._shuffle_seed = int(self.rnd.randint(0, 2 ** 63))
+        self._order_cache.clear()
         self._build_schedule()
         if (self._shuffled is None
                 or len(self._shuffled) != self.total_samples):
@@ -152,13 +189,63 @@ class Loader(AcceleratedUnit):
                 self._schedule.append(
                     (cls, start, min(start + self.max_minibatch_size, hi)))
 
+    # ------------------------------------------------------------------
+    # deterministic counter-based epoch order
+    # ------------------------------------------------------------------
+    def train_order(self, epoch: int) -> np.ndarray:
+        """Global indices of the TRAIN segment in the order epoch
+        ``epoch`` visits them — a pure function of the snapshotted
+        ``_shuffle_seed`` (any epoch, past or future, no state
+        replay).  ``shuffle_limit`` freezes the order at the last
+        shuffled epoch, matching the stateful semantics it replaces."""
+        lo, hi = self.class_index_range(TRAIN)
+        n = hi - lo
+        if n <= 0 or self.shuffle_limit <= 0:
+            return np.arange(lo, hi, dtype=np.int32)
+        eff = min(int(epoch), int(self.shuffle_limit) - 1)
+        key = (self._shuffle_seed, eff)
+        with self._order_lock:
+            perm = self._order_cache.get(key)
+            if perm is None:
+                if len(self._order_cache) >= 4:  # current + lookahead
+                    self._order_cache.pop(next(iter(self._order_cache)))
+                perm = self._order_cache[key] = epoch_permutation(
+                    self._shuffle_seed, eff, n)
+        return (lo + perm).astype(np.int32)
+
+    def epoch_order(self, epoch: int) -> np.ndarray:
+        """The full global sample order of epoch ``epoch`` (test and
+        validation segments ride in natural order; train shuffled)."""
+        order = np.arange(self.total_samples, dtype=np.int32)
+        lo, hi = self.class_index_range(TRAIN)
+        if hi > lo:
+            order[lo:hi] = self.train_order(epoch)
+        return order
+
+    def schedule_entry(self, epoch: int, cursor: int
+                       ) -> tuple[np.ndarray, int, int]:
+        """Deterministic ``(padded indices, class, true count)`` for
+        ANY schedule position — including future epochs.  This is what
+        lets prefetchers (streaming producer threads, the image
+        loader's decode pool) run ahead across epoch boundaries: the
+        order there is already decided by the counter-based shuffle,
+        no stale-order hazard."""
+        cls, lo, hi = self._schedule[cursor]
+        count = hi - lo
+        order = self.epoch_order(epoch)
+        idx = np.empty(self.max_minibatch_size, dtype=np.int32)
+        idx[:count] = order[lo:hi]
+        if count < self.max_minibatch_size:  # pad: repeat the first
+            idx[count:] = idx[0]
+        return idx, cls, count
+
     def _shuffle_train(self) -> None:
         if self.epoch_number >= self.shuffle_limit:
             return
         lo, hi = self.class_index_range(TRAIN)
         if hi > lo:
-            seg = self._shuffled[lo:hi]
-            self.rnd.shuffle(seg)
+            assert self._shuffled is not None
+            self._shuffled[lo:hi] = self.train_order(self.epoch_number)
             self._sched_dirty = True  # device-resident copy is stale
 
     # ------------------------------------------------------------------

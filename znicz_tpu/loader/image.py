@@ -32,6 +32,8 @@ import numpy as np
 from znicz_tpu.loader.base import Loader, TEST, TRAIN, VALID
 from znicz_tpu.loader.fullbatch import FullBatchLoader
 from znicz_tpu.memory import Vector
+from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.observe import tracing as _tracing
 
 IMAGE_EXTENSIONS = (".jpg", ".jpeg", ".png")
 
@@ -181,13 +183,20 @@ class ImageLoader(Loader):
         self._pending: tuple[int, int] | None = None  # (epoch, cursor)
         self._pil_rng = np.random.default_rng(1)
         #: overlap telemetry: hits = steps served by a prefetched
-        #: decode, misses = synchronous decodes (first step + epoch
-        #: boundaries), wait_s = total time blocked on in-flight
-        #: decodes.  wait_s ≈ 0 with hits > 0 means the decode fully
-        #: overlapped the consumer's compute window.
+        #: decode, misses = synchronous decodes (now only the first
+        #: step and schedule jumps — the counter-based shuffle lets
+        #: the decode pool run ahead across epoch boundaries too),
+        #: wait_s = total time blocked on in-flight decodes.  wait_s
+        #: ≈ 0 with hits > 0 means the decode fully overlapped the
+        #: consumer's compute window.  Mirrored into the round-9
+        #: metrics registry (``znicz_loader_prefetch_total``,
+        #: ``znicz_input_wait_seconds``) so loader overlap shows on
+        #: ``/metrics`` and in ``trace_top.py --spans`` beside
+        #: everything else.
         self.prefetch_hits = 0
         self.prefetch_misses = 0
         self.prefetch_wait_s = 0.0
+        self.epoch_cross_prefetches = 0
 
     # subclasses fill file_paths/file_labels/class_lengths here
     def load_data(self) -> None:
@@ -241,6 +250,11 @@ class ImageLoader(Loader):
         self.prefetch_hits = 0
         self.prefetch_misses = 0
         self.prefetch_wait_s = 0.0
+        self.epoch_cross_prefetches = 0
+        if _metrics.enabled():
+            # decode double-buffering = one batch in flight
+            _metrics.prefetch_depth(self.name).set(
+                1 if (self.prefetch and self._pipe is not None) else 0)
         self._pil_rng = np.random.default_rng(
             self.rnd.randint(0, 2 ** 31))
 
@@ -279,19 +293,20 @@ class ImageLoader(Loader):
                 self.channels, crop, flip, 1.0, 0.0,
                 self._pil_rng)).astype(np.uint8)
 
-    def _peek_next(self) -> tuple[np.ndarray, int] | None:
-        """Indices + class of the NEXT schedule entry, or None at the
-        epoch boundary (the shuffle for the next epoch hasn't happened
-        yet — prefetching across it would use stale order)."""
-        if self._cursor >= len(self._schedule):
-            return None
-        cls, lo, hi = self._schedule[self._cursor]
-        count = hi - lo
-        idx = np.empty(self.max_minibatch_size, dtype=np.int32)
-        idx[:count] = self._shuffled[lo:hi]
-        if count < self.max_minibatch_size:
-            idx[count:] = idx[0]
-        return idx, cls
+    def _peek_next(self) -> tuple[np.ndarray, int, tuple[int, int]]:
+        """Indices, class and ``(epoch, cursor)`` of the NEXT schedule
+        entry — including across the epoch boundary: the counter-based
+        shuffle (``Loader.schedule_entry``) fixes the next epoch's
+        order before it starts, so the old stale-order bail-out (the
+        one guaranteed decode stall per epoch) is gone; each crossing
+        the prefetch serves is a recovered stall, counted in
+        ``epoch_cross_prefetches``."""
+        if self._cursor < len(self._schedule):
+            pos = (self.epoch_number, self._cursor)
+        else:
+            pos = (self.epoch_number + 1, 0)
+        idx, cls, _count = self.schedule_entry(*pos)
+        return idx, cls, pos
 
     def _decode_seed(self, epoch: int, cursor: int) -> int:
         return (int(self._seed_base) + epoch * 1_000_003 + cursor) \
@@ -305,10 +320,23 @@ class ImageLoader(Loader):
         cur = (self.epoch_number, self._cursor - 1)
         if self._pipe is not None:
             if self.prefetch and self._pending == cur:
-                t0 = time.perf_counter()
-                n_failed = self._pipe.wait()
-                self.prefetch_wait_s += time.perf_counter() - t0
+                with _tracing.TRACER.span(f"input_wait:{self.name}",
+                                          cat="loader"):
+                    t0 = time.perf_counter()
+                    n_failed = self._pipe.wait()
+                    waited = time.perf_counter() - t0
+                self.prefetch_wait_s += waited
                 self.prefetch_hits += 1
+                crossed = cur[1] == 0 and cur[0] > 0
+                if crossed:
+                    self.epoch_cross_prefetches += 1
+                if _metrics.enabled():
+                    _metrics.input_wait_seconds(self.name).observe(
+                        waited)
+                    _metrics.loader_prefetch(self.name, "hit").inc()
+                    if crossed:
+                        _metrics.loader_prefetch(
+                            self.name, "epoch_cross").inc()
                 if n_failed:
                     self.warning("%d failed decodes (zero-filled)",
                                  n_failed)
@@ -318,9 +346,15 @@ class ImageLoader(Loader):
                     # a stale prefetch is in flight (schedule jumped:
                     # resume/reshuffle) — drain it before resubmitting
                     self._pipe.wait()
+                t0 = time.perf_counter()
                 self._decode_sync(idx, self.minibatch_class,
                                   self._buffers[self._decode_buf],
                                   self._decode_seed(*cur))
+                if _metrics.enabled():
+                    # a synchronous decode is 100% un-hidden input time
+                    _metrics.input_wait_seconds(self.name).observe(
+                        time.perf_counter() - t0)
+                    _metrics.loader_prefetch(self.name, "miss").inc()
             # zero-copy handoff: rebind the Vector to the filled
             # buffer; the pool decodes the NEXT batch into the other
             filled = self._decode_buf
@@ -330,15 +364,12 @@ class ImageLoader(Loader):
             # C++ workers chew N+1 while device_put streams batch N
             # and the device computes it
             if self.prefetch:
-                nxt = self._peek_next()
-                if nxt is not None:
-                    nidx, ncls = nxt
-                    self._decode_buf = 1 - filled
-                    self._submit(nidx, ncls,
-                                 self._buffers[self._decode_buf],
-                                 self._decode_seed(self.epoch_number,
-                                                   self._cursor))
-                    self._pending = (self.epoch_number, self._cursor)
+                nidx, ncls, pos = self._peek_next()
+                self._decode_buf = 1 - filled
+                self._submit(nidx, ncls,
+                             self._buffers[self._decode_buf],
+                             self._decode_seed(*pos))
+                self._pending = pos
         else:
             self.minibatch_raw.map_invalidate()
             self._decode_sync(idx, self.minibatch_class,

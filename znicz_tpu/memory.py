@@ -253,6 +253,31 @@ class Vector:
         if not self._tracing:
             self._state = _State.DEVICE
 
+    def accept_device(self, devarr) -> None:
+        """Adopt an ALREADY-uploaded device array as the authoritative
+        copy — the streaming data plane's delivery handoff: an uploader
+        thread ``device_put`` the staged batch while the previous step
+        computed, and delivery is this pointer swap (zero host work on
+        the step's critical path).  Shape/dtype must match the declared
+        storage so consumers (jit regions) never see a new signature —
+        the zero-recompile contract.  (Multi-process arrays are
+        globally shaped while the host mirror holds only the local
+        shard; those skip the host-shape check.)"""
+        self._check_not_tracing("accept_device")
+        if self._state == _State.EMPTY:
+            raise ValueError(
+                f"Vector '{self.name}': accept_device on empty buffer")
+        addressable = getattr(devarr, "is_fully_addressable", True)
+        if self._mem is not None and addressable:
+            if (tuple(devarr.shape) != tuple(self._mem.shape)
+                    or np.dtype(devarr.dtype) != self._mem.dtype):
+                raise ValueError(
+                    f"Vector '{self.name}': accept_device "
+                    f"{devarr.shape}/{devarr.dtype} does not match the "
+                    f"declared {self._mem.shape}/{self._mem.dtype}")
+        self._devmem = devarr
+        self._state = _State.DEVICE
+
     @property
     def state_name(self) -> str:
         return self._state.name
@@ -349,3 +374,60 @@ class Vector:
                 f"Vector '{self.name}': {op}() inside a jit region — "
                 f"host sync is not allowed in traced code; move this "
                 f"unit out of the region or use device-side state")
+
+
+class StagingRing:
+    """Bounded ring of reusable host staging buffers — the streaming
+    data plane's slot pool.
+
+    Producers :meth:`acquire` a free slot, fill it (shard reads /
+    decode), and hand the index downstream; whoever finishes with the
+    contents (the uploader after ``device_put``, or the consumer on
+    host-only backends) :meth:`release`\\ s it.  The bound is the
+    backpressure mechanism: a stalled consumer blocks the producers at
+    ``acquire`` instead of growing host memory — total staging
+    footprint is pinned at ``n_slots × batch_bytes`` no matter how
+    large the dataset is.
+
+    Thread-safe; allocation happens once, up front (steady state does
+    zero allocations on the step path).
+    """
+
+    def __init__(self, n_slots: int, shape: tuple[int, ...],
+                 dtype) -> None:
+        import queue
+        if n_slots < 1:
+            raise ValueError(f"StagingRing needs >= 1 slot, got {n_slots}")
+        self._bufs = [np.zeros(shape, dtype=dtype) for _ in range(n_slots)]
+        self._free: "queue.Queue[int]" = queue.Queue()
+        for i in range(n_slots):
+            self._free.put(i)
+
+    @property
+    def n_slots(self) -> int:
+        return len(self._bufs)
+
+    @property
+    def n_free(self) -> int:
+        return self._free.qsize()
+
+    @property
+    def nbytes(self) -> int:
+        """Total host bytes pinned by the ring."""
+        return sum(b.nbytes for b in self._bufs)
+
+    def buffer(self, slot: int) -> np.ndarray:
+        return self._bufs[slot]
+
+    def acquire(self, timeout: float | None = None) -> int | None:
+        """Next free slot index; blocks (bounded by ``timeout``) when
+        the ring is full downstream.  ``None`` on timeout so pipeline
+        threads can re-check their stop flag instead of hanging."""
+        import queue
+        try:
+            return self._free.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def release(self, slot: int) -> None:
+        self._free.put(slot)

@@ -403,6 +403,48 @@ def transfer_bytes(direction: str) -> Counter:
         labels=("direction",)).labels(direction=direction)
 
 
+def input_wait_seconds(loader: str) -> Histogram:
+    """Host time a training step spent BLOCKED on the input pipeline
+    (prefetch miss, empty prefetch queue).  A fully hidden input plane
+    keeps this ≈ 0 while :func:`input_stage_seconds` keeps accruing —
+    the ratio of the two sums is the input-overlap attestation the
+    dryrun and ``stream_bench`` report as ``input_hidden``."""
+    return REGISTRY.histogram(
+        "znicz_input_wait_seconds",
+        "Step time blocked waiting for the input pipeline",
+        labels=("loader",)).labels(loader=loader)
+
+
+def input_stage_seconds(loader: str) -> Histogram:
+    """Producer-side cost of one minibatch (shard read/decode +
+    staging) — the work the prefetch must hide under the device
+    step."""
+    return REGISTRY.histogram(
+        "znicz_input_stage_seconds",
+        "Producer time to read+stage one minibatch",
+        labels=("loader",)).labels(loader=loader)
+
+
+def prefetch_depth(loader: str) -> Gauge:
+    """Configured prefetch depth (in-flight device batches) of a
+    streaming/double-buffered loader."""
+    return REGISTRY.gauge(
+        "znicz_prefetch_depth",
+        "Loader prefetch depth (0 = synchronous input)",
+        labels=("loader",)).labels(loader=loader)
+
+
+def loader_prefetch(loader: str, event: str) -> Counter:
+    """Loader prefetch lifecycle counters: ``hit`` (step served from
+    an in-flight prefetch), ``miss`` (synchronous fallback),
+    ``epoch_cross`` (prefetch legally spanned an epoch boundary via
+    the counter-based shuffle — each one is a recovered stall)."""
+    return REGISTRY.counter(
+        "znicz_loader_prefetch_total",
+        "Loader prefetch events (hit/miss/epoch_cross)",
+        labels=("loader", "event")).labels(loader=loader, event=event)
+
+
 def snapshot_seconds(op: str) -> Histogram:
     return REGISTRY.histogram(
         "znicz_snapshot_seconds",
