@@ -1,0 +1,410 @@
+"""Round-11 serving degradation: per-request deadlines (fail fast,
+evicted before dispatch), the retry budget, and the circuit breaker's
+closed → open → half-open → closed cycle.  CPU / tier-1 safe."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from conftest import make_blobs
+from znicz_tpu.backends import XLADevice
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.observe import metrics as obs_metrics
+from znicz_tpu.serving import (ContinuousBatcher, DeadlineExceeded,
+                               Overloaded, QueueFull, ServingEngine)
+from znicz_tpu.utils import prng
+from znicz_tpu.utils.config import root
+
+
+# ----------------------------------------------------------------------
+# batcher-level: deadlines
+# ----------------------------------------------------------------------
+def _echo_batcher(**kwargs):
+    dispatched = []
+
+    def run_batch(reqs):
+        dispatched.append([r.n for r in reqs])
+        for r in reqs:
+            r.future.set_result(r.x)
+
+    return ContinuousBatcher(run_batch, **kwargs), dispatched
+
+
+def test_deadline_expired_request_never_reaches_program():
+    """A request whose deadline passes inside the admission window
+    fails fast with DeadlineExceeded and its rows are evicted before
+    coalescing — the dispatched batches never contain them."""
+    b, dispatched = _echo_batcher(max_batch=8, max_delay_ms=400,
+                                  max_queue=64)
+    t0 = time.monotonic()
+    doomed = b.submit(np.ones((3, 2)), deadline_ms=40)
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=5)
+    waited = time.monotonic() - t0
+    assert waited < 0.39, f"fail-fast took {waited * 1e3:.0f}ms (the " \
+                          f"full admission window is 400ms)"
+    ok = b.submit(np.ones((2, 2)))
+    np.testing.assert_array_equal(ok.result(timeout=5), np.ones((2, 2)))
+    b.shutdown()
+    assert all(3 not in batch for batch in dispatched), dispatched
+    assert b.expired_total == 1
+
+
+def test_deadline_at_submit_and_negative():
+    b, _ = _echo_batcher(max_batch=4, max_delay_ms=1, max_queue=16)
+    with pytest.raises(DeadlineExceeded):
+        b.submit(np.ones((1, 1)), deadline_ms=0)
+    b.shutdown()
+
+
+def test_admission_window_holds_with_deadlines_mixed_in():
+    """Deadline housekeeping must not break the admission-window
+    timing contract: a lone undeadlined request still waits out the
+    window (exact lower bound), even while a deadlined sibling expires
+    out from under it."""
+    b, dispatched = _echo_batcher(max_batch=8, max_delay_ms=300,
+                                  max_queue=64)
+    t0 = time.monotonic()
+    doomed = b.submit(np.ones((2, 2)), deadline_ms=30)
+    lone = b.submit(np.ones((1, 2)))
+    lone.result(timeout=5)
+    waited = time.monotonic() - t0
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=5)
+    # the DOOMED request was oldest: once evicted, the window is the
+    # survivor's — it may flush no earlier than ITS 300ms budget
+    assert waited >= 0.28, f"window broke: flushed at {waited * 1e3:.0f}ms"
+    assert dispatched == [[1]]
+    b.shutdown()
+
+
+# ----------------------------------------------------------------------
+# batcher-level: retry budget
+# ----------------------------------------------------------------------
+def test_retry_budget_recovers_transient_failure():
+    calls = []
+
+    def run_batch(reqs):
+        calls.append(len(reqs))
+        if len(calls) == 1:
+            raise RuntimeError("transient boom")
+        for r in reqs:
+            r.future.set_result(r.x * 2)
+
+    recov = obs_metrics.recoveries("serving_retry")
+    base = recov.value
+    b = ContinuousBatcher(run_batch, max_batch=4, max_delay_ms=0,
+                          max_queue=16, retry_budget=1)
+    f = b.submit(np.ones((2, 2)))
+    np.testing.assert_array_equal(f.result(timeout=5),
+                                  np.full((2, 2), 2.0))
+    b.shutdown()
+    assert b.retries_total == 1
+    assert recov.value - base == 1
+
+
+def test_retry_budget_exhausted_fails_future():
+    def run_batch(reqs):
+        raise RuntimeError("permanent boom")
+
+    b = ContinuousBatcher(run_batch, max_batch=4, max_delay_ms=0,
+                          max_queue=16, retry_budget=2,
+                          breaker_min_samples=100)
+    f = b.submit(np.ones((1, 1)))
+    with pytest.raises(RuntimeError, match="permanent boom"):
+        f.result(timeout=5)
+    b.shutdown()
+    assert b.retries_total == 2  # budget spent before the future failed
+
+
+# ----------------------------------------------------------------------
+# batcher-level: circuit breaker
+# ----------------------------------------------------------------------
+def test_breaker_opens_sheds_then_half_open_recovers():
+    healthy = threading.Event()
+
+    def run_batch(reqs):
+        if not healthy.is_set():
+            raise RuntimeError("backend down")
+        for r in reqs:
+            r.future.set_result(r.x)
+
+    b = ContinuousBatcher(run_batch, max_batch=4, max_delay_ms=0,
+                          max_queue=64, retry_budget=0,
+                          breaker_window=4, breaker_min_samples=2,
+                          breaker_failure_rate=0.5,
+                          breaker_cooldown_ms=150.0, obs_id="brk#0")
+    futures = [b.submit(np.ones((1, 1))) for _ in range(2)]
+    for f in futures:
+        with pytest.raises(RuntimeError):
+            f.result(timeout=5)
+    deadline = time.monotonic() + 5
+    while b.breaker_state != "open" and time.monotonic() < deadline:
+        try:
+            with pytest.raises(RuntimeError):
+                b.submit(np.ones((1, 1))).result(timeout=5)
+        except Overloaded:
+            break
+        time.sleep(0.01)
+    assert b.breaker_state == "open"
+    # open: shedding is fast and counted
+    t0 = time.monotonic()
+    with pytest.raises(Overloaded):
+        b.submit(np.ones((1, 1)))
+    assert time.monotonic() - t0 < 0.05
+    assert b.shed_total >= 1
+    assert obs_metrics.serving_breaker_state("brk#0").value == 2
+    # Overloaded IS QueueFull: existing backpressure handling catches it
+    with pytest.raises(QueueFull):
+        b.submit(np.ones((1, 1)))
+    # cooldown → half-open: the probe dispatch closes it again
+    healthy.set()
+    time.sleep(0.2)
+    probe = b.submit(np.ones((1, 1)))  # admitted in half-open
+    np.testing.assert_array_equal(probe.result(timeout=5),
+                                  np.ones((1, 1)))
+    deadline = time.monotonic() + 5
+    while b.breaker_state != "closed" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert b.breaker_state == "closed"
+    assert obs_metrics.serving_breaker_state("brk#0").value == 0
+    trans = obs_metrics.serving_breaker_transitions("brk#0", "open")
+    assert trans.value >= 1
+    # healthy again end-to-end
+    f = b.submit(np.ones((2, 1)))
+    np.testing.assert_array_equal(f.result(timeout=5), np.ones((2, 1)))
+    b.shutdown()
+
+
+def test_breaker_half_open_failure_reopens():
+    def run_batch(reqs):
+        raise RuntimeError("still down")
+
+    b = ContinuousBatcher(run_batch, max_batch=4, max_delay_ms=0,
+                          max_queue=16, retry_budget=0,
+                          breaker_window=4, breaker_min_samples=2,
+                          breaker_failure_rate=0.5,
+                          breaker_cooldown_ms=50.0)
+    for _ in range(2):
+        with pytest.raises(RuntimeError):
+            b.submit(np.ones((1, 1))).result(timeout=5)
+    deadline = time.monotonic() + 5
+    while b.breaker_state != "open" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.1)  # past cooldown: next submit probes (half-open)
+    probe = b.submit(np.ones((1, 1)))
+    with pytest.raises(RuntimeError):
+        probe.result(timeout=5)
+    deadline = time.monotonic() + 5
+    while b.breaker_state != "open" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert b.breaker_state == "open"  # the failed probe re-opened it
+    b.shutdown()
+
+
+def test_breaker_queue_age_trip_forces_flush():
+    """A queue stalled past max_queue_age_ms trips the breaker (stall
+    detector) AND force-flushes the stale prefix so it stops aging."""
+    b, dispatched = _echo_batcher(max_batch=8, max_delay_ms=60_000.0,
+                                  max_queue=64, max_queue_age_ms=200.0)
+    f = b.submit(np.ones((1, 1)))  # parked behind a 60s window
+    f.result(timeout=10)           # age-trip flushed it long before
+    deadline = time.monotonic() + 5
+    while b.breaker_state != "open" and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert b.breaker_state == "open"
+    with pytest.raises(Overloaded):
+        b.submit(np.ones((1, 1)))
+    b.shutdown()
+    assert dispatched == [[1]]
+
+
+# ----------------------------------------------------------------------
+# engine-level: deadlines + oracle equality with expirations mixed in
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def bundle(tmp_path_factory):
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+
+    data, labels = make_blobs(48, 4, 12)
+    prng.seed_all(5)
+    wf = StandardWorkflow(
+        name="resil_serve",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:160], train_labels=labels[:160],
+            valid_data=data[160:], valid_labels=labels[160:],
+            minibatch_size=32),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 24},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "softmax", "->": {"output_sample_shape": 4},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        ],
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    path = str(tmp_path_factory.mktemp("resil") / "resil_serve.npz")
+    wf.export_forward(path)
+    return path, data
+
+
+def test_engine_coalesced_results_oracle_equal_with_expired_rows(bundle):
+    """Some requests expire in the queue; the survivors' coalesced
+    replies still match the per-request oracle bit-for-bit semantics
+    of round 8 (no padded-row leak, no row shift from the eviction)."""
+    path, data = bundle
+    device = XLADevice()
+    from znicz_tpu.export import ExportedModel
+    model = ExportedModel.load(path, device=device, max_batch=16)
+    # 6 × 2 rows = 12 < max_batch, so nothing full-bucket-flushes
+    # before the odd requests' deadlines expire inside the window
+    requests = [np.ascontiguousarray(data[i * 4:i * 4 + 2])
+                for i in range(6)]
+    oracle = [model(x) for x in requests]
+    engine = ServingEngine(model, max_batch=16, max_delay_ms=250.0,
+                           device=device)
+    engine.start()
+    futures = []
+    for i, x in enumerate(requests):
+        # every odd request gets an already-hopeless deadline
+        futures.append(engine.submit(
+            x, deadline_ms=20 if i % 2 else None))
+    outcomes = []
+    for i, f in enumerate(futures):
+        if i % 2:
+            with pytest.raises(DeadlineExceeded):
+                f.result(timeout=30)
+            outcomes.append(None)
+        else:
+            outcomes.append(f.result(timeout=30))
+    for i, got in enumerate(outcomes):
+        if got is not None:
+            np.testing.assert_allclose(got, oracle[i], rtol=1e-5,
+                                       atol=2e-6, err_msg=f"req {i}")
+    st = engine.stats()
+    assert st["resilience"]["expired"] == 3
+    assert st["resilience"]["breaker"] == "closed"
+    engine.shutdown()
+
+
+def test_engine_deadline_rows_never_dispatch_and_stats(bundle):
+    path, data = bundle
+    engine = ServingEngine(path, max_batch=8, max_delay_ms=500.0,
+                           device=XLADevice())
+    engine.start()
+    served = obs_metrics.serving_requests(engine._obs_id, "served")
+    with pytest.raises(DeadlineExceeded):
+        engine.submit(data[:2], deadline_ms=30).result(timeout=10)
+    assert served.value == 0  # nothing reached a program
+    exp = obs_metrics.serving_requests(engine._obs_id, "expired")
+    assert exp.value == 1
+    assert engine.ready()
+    engine.shutdown()
+
+
+def test_engine_injected_program_error_retried_to_success(bundle):
+    """The chaos site serving.program_error fails the first dispatch;
+    the retry budget re-runs it and the caller never notices."""
+    path, data = bundle
+    root.common.engine.faults = {"serving.program_error": {"at": [1]}}
+    engine = ServingEngine(path, max_batch=8, max_delay_ms=2.0,
+                           device=XLADevice(), retry_budget=1)
+    engine.start()
+    out = engine(data[:3], timeout=60)
+    assert out.shape == (3, 4)
+    st = engine.stats()
+    assert st["resilience"]["retried"] == 1
+    assert st["served"] == 1
+    engine.shutdown()
+
+
+def test_healthz_readyz_registry_fed(bundle):
+    """/healthz is liveness (always 200); /readyz is 200 while every
+    breaker is closed and flips 503 — with the reason named — when an
+    engine sheds load.  Both are fed from the observe registry, so
+    they see exactly what /metrics exports."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from znicz_tpu.web_status import WebStatusServer
+
+    path, data = bundle
+    engine = ServingEngine(path, max_batch=8, max_delay_ms=1.0,
+                           device=XLADevice())
+    engine.start()
+    engine(data[:2], timeout=60)
+    server = WebStatusServer(port=0)
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        server.register(engine)
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as r:
+            assert r.status == 200
+            assert json.load(r)["status"] == "ok"
+        with urllib.request.urlopen(f"{base}/readyz", timeout=10) as r:
+            assert r.status == 200
+            report = json.load(r)
+        assert report["ready"] is True
+        assert report["engines"][engine._obs_id]["breaker"] == "closed"
+        assert "queue_age_s" in report["engines"][engine._obs_id]
+        # force the breaker open and the probe must flip to 503
+        obs_metrics.serving_breaker_state(engine._obs_id).set(2)
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(f"{base}/readyz", timeout=10)
+        assert exc_info.value.code == 503
+        report = json.load(exc_info.value)
+        assert report["ready"] is False
+        assert any("breaker open" in r for r in report["reasons"])
+    finally:
+        # the registry is process-global: put the forced gauge back so
+        # later tests' /readyz probes see a healthy fleet
+        obs_metrics.serving_breaker_state(engine._obs_id).set(0)
+        server.stop()
+        engine.shutdown()
+
+
+def test_readyz_reports_training_staleness(bundle):
+    import json
+    import urllib.error
+    import urllib.request
+
+    from znicz_tpu.web_status import WebStatusServer
+
+    obs_metrics.last_step_timestamp("stale_wf").set(time.time() - 100)
+    server = WebStatusServer(port=0)
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/readyz", timeout=10) as r:
+            report = json.load(r)  # report-only without a threshold
+        assert report["workflows"]["stale_wf"]["last_step_age_s"] >= 99
+        assert report["ready"] is True
+        root.common.engine.ready_max_staleness_s = 30
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(f"{base}/readyz", timeout=10)
+        assert exc_info.value.code == 503
+    finally:
+        server.stop()
+
+
+def test_engine_latency_spike_expires_deadlined_request(bundle):
+    """An injected latency spike holds the scheduler; a deadlined
+    request queued behind it fails fast instead of riding a stale
+    bucket."""
+    path, data = bundle
+    root.common.engine.faults = {
+        "serving.latency_spike": {"at": [1], "ms": 300}}
+    engine = ServingEngine(path, max_batch=8, max_delay_ms=1.0,
+                           device=XLADevice())
+    engine.start()
+    slow = engine.submit(data[:2])         # rides the spiked dispatch
+    time.sleep(0.05)  # let the 1ms window dispatch `slow` alone
+    doomed = engine.submit(data[2:4], deadline_ms=60)
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=30)
+    assert slow.result(timeout=30).shape == (2, 4)
+    engine.shutdown()

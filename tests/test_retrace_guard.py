@@ -140,6 +140,63 @@ def served_bundle(tmp_path):
     return path
 
 
+def test_warmed_anomaly_guard_chaos_steps_zero_new_compiles():
+    """Round 11: the anomaly guard's finite checks AND an active
+    fault recipe ride the SAME region program — the injected NaN is a
+    leaf VALUE, so warmed steps never recompile even while faults
+    fire and updates are skipped."""
+    from znicz_tpu.utils.config import root
+
+    root.common.engine.faults = {
+        "train.nonfinite_loss": {"at": [6, 9]},
+        "train.nonfinite_grad": {"at": [12]},
+    }
+    wf = _build_wf("retrace_chaos")
+    assert wf.anomaly_guard is not None
+    assert wf.anomaly_guard.fault_inject is not None
+    compiles = obs_metrics.xla_compiles(f"region:{wf._region_unit.name}")
+    wf.run()  # warmup epochs; injections land mid-run
+    warmed = compiles.value
+    for _ in range(8):  # keep stepping across inject/clean boundaries
+        wf.loader.run()
+        wf.anomaly_guard._fire()
+        wf._region_unit.run()
+    assert compiles.value == warmed, (
+        f"anomaly-guard/chaos steps recompiled: "
+        f"{compiles.value - warmed} new XLA programs")
+    assert obs_metrics.step_anomalies("retrace_chaos",
+                                      "loss").value >= 1
+
+
+def test_warmed_serving_deadline_path_zero_new_compiles(served_bundle):
+    """Round 11: deadline eviction reshapes the COALESCED batch, but
+    buckets absorb it — mixed deadlined/expired traffic on a warmed
+    ladder never compiles."""
+    from znicz_tpu.serving import DeadlineExceeded, ServingEngine
+
+    serving_compiles = obs_metrics.xla_compiles("serving-aot")
+    engine = ServingEngine(served_bundle, max_batch=16,
+                           max_delay_ms=120.0)
+    engine.start()
+    warmed = serving_compiles.value
+    rng = np.random.default_rng(8)
+    try:
+        for rows in (1, 5, 3, 7):
+            x = rng.normal(size=(rows, 10)).astype(np.float32)
+            doomed = engine.submit(
+                rng.normal(size=(2, 10)).astype(np.float32),
+                deadline_ms=15)
+            out = engine(x, timeout=60)
+            assert out.shape == (rows, 3)
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(timeout=30)
+        assert serving_compiles.value == warmed, (
+            f"deadline-mixed serving recompiled: "
+            f"{serving_compiles.value - warmed} new AOT programs")
+    finally:
+        engine.shutdown()
+
+
 def test_warmed_serving_bucket_zero_new_compiles(served_bundle):
     """The engine's warmup covers the whole ladder; ragged traffic
     afterwards — partial, odd, full, repeated — must not compile."""

@@ -66,6 +66,7 @@ import os
 import queue
 import threading
 import time
+import zlib
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
@@ -75,8 +76,38 @@ from znicz_tpu.loader.base import Loader
 from znicz_tpu.memory import StagingRing, Vector
 from znicz_tpu.observe import metrics as _metrics
 from znicz_tpu.observe import tracing as _tracing
+from znicz_tpu.resilience import faults as _faults
+from znicz_tpu.utils.config import root
 
 MANIFEST_NAME = "manifest.json"
+
+
+class ShardReadError(RuntimeError):
+    """A shard read failed (CRC mismatch, IO error, injected fault).
+    Carries the shard index so the retry path can quarantine a
+    persistently bad shard and continue the epoch."""
+
+    def __init__(self, shard: int | None, msg: str) -> None:
+        super().__init__(msg)
+        self.shard = shard
+
+
+class PipelineDead(RuntimeError):
+    """The streaming pipeline's producer or uploader thread died.
+    Raised in the CONSUMER (propagated through the bounded device
+    queue by a poison-pill sentinel — the consumer never hangs on a
+    dead producer); the loader absorbs a bounded number of these by
+    rebuilding the pipeline (``engine.reader_restarts``, default 2)."""
+
+
+def _file_crc32(path: str, chunk: int = 1 << 20) -> int:
+    crc = 0
+    with open(path, "rb") as fh:
+        while True:
+            buf = fh.read(chunk)
+            if not buf:
+                return crc
+            crc = zlib.crc32(buf, crc)
 
 
 # ----------------------------------------------------------------------
@@ -122,12 +153,17 @@ def write_shards(out_dir: str,
         chunk = np.ascontiguousarray(data[lo:lo + rows_per_shard])
         fn = f"data-{i:05d}.npy"
         np.save(os.path.join(out_dir, fn), chunk)
-        entry: dict = {"data": fn, "rows": int(len(chunk))}
+        # per-shard integrity digest (round 11): readers verify on
+        # first open and quarantine-and-continue on mismatch
+        entry: dict = {"data": fn, "rows": int(len(chunk)),
+                       "crc32": _file_crc32(os.path.join(out_dir, fn))}
         if labs is not None:
             lfn = f"labels-{i:05d}.npy"
             np.save(os.path.join(out_dir, lfn),
                     labs[lo:lo + rows_per_shard])
             entry["labels"] = lfn
+            entry["labels_crc32"] = _file_crc32(
+                os.path.join(out_dir, lfn))
         shards.append(entry)
     manifest = {"version": 1,
                 "class_lengths": [int(n) for n in lengths],
@@ -168,12 +204,42 @@ class ShardReader:
                 f"class_lengths sum {sum(self.class_lengths)}")
         self._maps: list[np.ndarray | None] = [None] * len(self._shards)
         self._lock = threading.Lock()
+        #: shards that exhausted their read retries: their rows
+        #: deliver zeros for the rest of the run (quarantine-and-
+        #: continue beats crashing the epoch on one bad file)
+        self._quarantined: set[int] = set()
         self.has_labels = all("labels" in s for s in self._shards)
         self._labels: np.ndarray | None = None
         if self.has_labels:
-            self._labels = np.concatenate([
-                np.load(os.path.join(directory, s["labels"]))
-                for s in self._shards]).astype(np.int32)
+            parts = []
+            for i, s in enumerate(self._shards):
+                lpath = os.path.join(directory, s["labels"])
+                want = s.get("labels_crc32")
+                if want is not None and self.verify_crc \
+                        and _file_crc32(lpath) != int(want):
+                    raise ShardReadError(
+                        i, f"{lpath}: labels CRC mismatch — dataset "
+                           f"corrupt on disk")
+                parts.append(np.load(lpath))
+            self._labels = np.concatenate(parts).astype(np.int32)
+
+    @property
+    def verify_crc(self) -> bool:
+        """``root.common.engine.shard_crc`` (default on): verify each
+        shard file's manifest digest on first open.  One sequential
+        read per shard per process — page-cache warming the mmap would
+        do anyway."""
+        return bool(root.common.engine.get("shard_crc", True))
+
+    @property
+    def quarantined(self) -> frozenset:
+        return frozenset(self._quarantined)
+
+    def quarantine(self, shard: int) -> None:
+        """Mark a shard permanently bad: drop its mmap, serve zeros."""
+        with self._lock:
+            self._quarantined.add(int(shard))
+            self._maps[int(shard)] = None
 
     @property
     def nbytes(self) -> int:
@@ -187,20 +253,39 @@ class ShardReader:
             with self._lock:
                 arr = self._maps[shard]
                 if arr is None:
-                    arr = np.load(os.path.join(
-                        self.directory, self._shards[shard]["data"]),
-                        mmap_mode="r")
+                    path = os.path.join(
+                        self.directory, self._shards[shard]["data"])
+                    want = self._shards[shard].get("crc32")
+                    if want is not None and self.verify_crc \
+                            and _file_crc32(path) != int(want):
+                        raise ShardReadError(
+                            shard, f"{path}: CRC mismatch (manifest "
+                                   f"{int(want)}) — shard corrupt on "
+                                   f"disk")
+                    arr = np.load(path, mmap_mode="r")
                     self._maps[shard] = arr
         return arr
 
     def gather(self, idx: np.ndarray, out: np.ndarray) -> None:
-        """``out[k] = dataset[idx[k]]`` across shard boundaries."""
+        """``out[k] = dataset[idx[k]]`` across shard boundaries.
+        Quarantined shards contribute zero rows; fault sites
+        ``loader.corrupt_shard`` / ``loader.short_read`` raise here
+        exactly like a real CRC/IO failure would."""
         idx = np.asarray(idx, dtype=np.int64)
         shard_of = np.searchsorted(self._offsets, idx, side="right") - 1
         for s in np.unique(shard_of):
+            s = int(s)
             mask = shard_of == s
+            if s in self._quarantined:
+                out[mask] = 0
+                continue
+            if _faults.fire("loader.corrupt_shard", shard=s) is not None:
+                raise ShardReadError(s, f"injected corrupt shard {s}")
+            if _faults.fire("loader.short_read", shard=s) is not None:
+                raise ShardReadError(s, f"injected short read on "
+                                        f"shard {s}")
             rows = idx[mask] - self._offsets[s]
-            out[mask] = self._mmap(int(s))[rows]
+            out[mask] = self._mmap(s)[rows]
 
     def labels(self, idx: np.ndarray) -> np.ndarray:
         assert self._labels is not None
@@ -218,6 +303,10 @@ class _Item:
     slot: int | None = None              # ring slot (host-only delivery)
     devarr: object = None                # uploaded device array
     crossed_epoch: bool = field(default=False)
+    #: poison pill: a producer/uploader thread died — wakes the
+    #: consumer IMMEDIATELY instead of leaving it blocked on the
+    #: bounded queue (round-11 satellite: the dead-reader hang fix)
+    pill: bool = field(default=False)
 
 
 class _StreamPipeline:
@@ -246,13 +335,32 @@ class _StreamPipeline:
             thread_name_prefix=f"{loader.name}.reader")
             if loader.n_reader_threads > 1 else None)
         self._producer = threading.Thread(
-            target=self._produce, args=(epoch, cursor),
+            target=self._thread_body, args=(self._produce, epoch, cursor),
             name=f"{loader.name}.producer", daemon=True)
         self._uploader = threading.Thread(
-            target=self._upload, name=f"{loader.name}.uploader",
-            daemon=True)
+            target=self._thread_body, args=(self._upload,),
+            name=f"{loader.name}.uploader", daemon=True)
         self._producer.start()
         self._uploader.start()
+
+    # -- death propagation ---------------------------------------------
+    def _thread_body(self, fn, *args) -> None:
+        """Run a pipeline stage; on ANY death record the cause and
+        push a poison pill through the device queue so the consumer
+        raises :class:`PipelineDead` immediately instead of hanging on
+        (or slow-polling) the bounded queue."""
+        try:
+            fn(*args)
+        except BaseException as exc:  # noqa: BLE001 — must not die silent
+            if not self.stop_flag.is_set():
+                if self.error is None:
+                    self.error = exc
+                try:
+                    self.dev_q.put_nowait(
+                        _Item((-1, -1), None, pill=True))
+                except queue.Full:
+                    pass  # consumer has items to drain; the error
+                    #       check in take()'s poll loop catches it
 
     # -- stage 1: shard gather into a ring slot ------------------------
     def _produce(self, epoch: int, cursor: int) -> None:
@@ -264,10 +372,13 @@ class _StreamPipeline:
             if slot is None:
                 continue
             try:
+                if _faults.fire("loader.reader_death") is not None:
+                    raise _faults.FaultInjected(
+                        f"{loader.name}: injected reader-thread death")
                 t0 = time.perf_counter()
                 idx, _cls, _count = loader.schedule_entry(epoch, cursor)
                 local = loader._local_slice(idx)
-                self._gather(local, self.ring.buffer(slot))
+                self._gather_retry(local, self.ring.buffer(slot))
                 labels = (loader._reader.labels(local)
                           if loader.has_labels else None)
                 if _metrics.enabled():
@@ -288,6 +399,48 @@ class _StreamPipeline:
             cursor += 1
             if cursor >= n_sched:
                 cursor, epoch = 0, epoch + 1
+
+    def _gather_retry(self, local_idx: np.ndarray,
+                      buf: np.ndarray) -> None:
+        """Shard gather with exponential-backoff retry and quarantine:
+        a transient failure (IO hiccup, injected short read) retries
+        up to ``engine.read_retries`` times; a shard still failing
+        after that is quarantined (its rows deliver zeros) and the
+        gather proceeds — a persistently corrupt shard costs data, not
+        the run."""
+        loader = self.loader
+        reader = loader._reader
+        retries = int(root.common.engine.get("read_retries", 2))
+        backoff = float(root.common.engine.get("read_backoff_s", 0.05))
+        attempts = 0
+        while True:
+            try:
+                self._gather(local_idx, buf)
+                if attempts:
+                    _metrics.recoveries("shard_retry").inc()
+                return
+            except ShardReadError as exc:
+                if self.stop_flag.is_set():
+                    raise
+                attempts += 1
+                _metrics.loader_read_retries(loader.name).inc()
+                if attempts <= retries:
+                    loader.warning(
+                        "shard read failed (%s) — retry %d/%d",
+                        exc, attempts, retries)
+                    time.sleep(backoff * (2 ** (attempts - 1)))
+                    continue
+                shard = exc.shard
+                if shard is None or shard in reader.quarantined:
+                    raise  # not shard-attributable: real death
+                reader.quarantine(shard)
+                _metrics.loader_shards_quarantined(loader.name).inc()
+                _metrics.recoveries("shard_quarantine").inc()
+                loader.warning(
+                    "shard %d quarantined after %d failed reads (%s) "
+                    "— its rows deliver zeros for the rest of the run",
+                    shard, attempts, exc)
+                attempts = 0  # fresh budget for the remaining shards
 
     def _gather(self, local_idx: np.ndarray, buf: np.ndarray) -> None:
         reader = self.loader._reader
@@ -354,23 +507,34 @@ class _StreamPipeline:
         deadline = time.monotonic() + timeout
         while True:
             try:
-                return self.dev_q.get(timeout=0.1)
+                item = self.dev_q.get(timeout=0.1)
             except queue.Empty:
                 if self.error is not None:
-                    raise RuntimeError(
+                    raise PipelineDead(
                         f"{self.loader}: streaming producer died"
                     ) from self.error
                 if time.monotonic() > deadline:
-                    raise RuntimeError(
+                    raise PipelineDead(
                         f"{self.loader}: streaming pipeline produced "
                         f"nothing for {timeout:.0f}s — reader thread "
                         f"dead?") from None
+                continue
+            if item.pill:
+                raise PipelineDead(
+                    f"{self.loader}: streaming pipeline thread died"
+                ) from self.error
+            return item
 
     def take_nowait(self) -> _Item | None:
         try:
-            return self.dev_q.get_nowait()
+            item = self.dev_q.get_nowait()
         except queue.Empty:
             return None
+        if item.pill:
+            raise PipelineDead(
+                f"{self.loader}: streaming pipeline thread died"
+            ) from self.error
+        return item
 
     @property
     def ready(self) -> int:
@@ -457,6 +621,8 @@ class StreamingLoader(Loader):
         self.prefetch_misses = 0
         self.input_wait_s = 0.0
         self.epoch_cross_prefetches = 0
+        #: pipeline rebuilds after a producer/uploader death this run
+        self.pipeline_restarts = 0
 
     # -- dataset ---------------------------------------------------------
     def load_data(self) -> None:
@@ -528,6 +694,7 @@ class StreamingLoader(Loader):
         self.prefetch_misses = 0
         self.input_wait_s = 0.0
         self.epoch_cross_prefetches = 0
+        self.pipeline_restarts = 0
         if _metrics.enabled():
             _metrics.prefetch_depth(self.name).set(self.prefetch_depth)
 
@@ -563,6 +730,32 @@ class StreamingLoader(Loader):
 
     # -- the per-step handoff -------------------------------------------
     def _take(self, expected: tuple[int, int]) -> _Item:
+        """The staged batch for ``expected``, absorbing a bounded
+        number of pipeline deaths: a dead producer/uploader thread
+        raises :class:`PipelineDead` in the consumer (poison pill —
+        never a hang on the bounded queue), and the loader rebuilds
+        the pipeline at the expected position up to
+        ``engine.reader_restarts`` (default 2) times per run before
+        letting the error propagate.  A restart re-reads the same
+        deterministic indices, so a recovered run is bit-identical to
+        an undisturbed one."""
+        while True:
+            try:
+                return self._take_inner(expected)
+            except PipelineDead as exc:
+                self.pipeline_restarts += 1
+                limit = int(root.common.engine.get("reader_restarts", 2))
+                self._stop_pipeline()
+                if self.pipeline_restarts > limit:
+                    raise
+                self.warning(
+                    "streaming pipeline died (%s) — restart %d/%d at "
+                    "epoch %d cursor %d", exc, self.pipeline_restarts,
+                    limit, *expected)
+                _metrics.loader_pipeline_restarts(self.name).inc()
+                _metrics.recoveries("reader_restart").inc()
+
+    def _take_inner(self, expected: tuple[int, int]) -> _Item:
         """The staged batch for schedule position ``expected`` —
         served from the prefetch queue (hit) or after a pipeline
         (re)start at that position (miss)."""
@@ -679,4 +872,4 @@ class StreamingLoader(Loader):
 
 
 __all__ = ["StreamingLoader", "ShardReader", "write_shards",
-           "MANIFEST_NAME"]
+           "MANIFEST_NAME", "ShardReadError", "PipelineDead"]

@@ -126,6 +126,7 @@ class StandardWorkflow(AcceleratedWorkflow):
                  decision_config: dict[str, Any] | None = None,
                  snapshotter_config: dict[str, Any] | None = None,
                  lr_adjuster_config: dict[str, Any] | None = None,
+                 anomaly_guard: bool | None = None,
                  **kwargs) -> None:
         super().__init__(workflow, name=name, **kwargs)
         if loader_factory is None:
@@ -138,10 +139,17 @@ class StandardWorkflow(AcceleratedWorkflow):
         assert isinstance(self.loader, Loader)
         self.forwards: list[Forward] = []
         self.gds: list = []
+        self.anomaly_guard = None
         self.link_forwards()
         self.link_evaluator(**(evaluator_config or {}))
         self.link_decision(**(decision_config or {}))
         self.link_gds()
+        from znicz_tpu.utils.config import root as _root
+        guard_on = (anomaly_guard if anomaly_guard is not None
+                    else bool(_root.common.engine.get("anomaly_guard",
+                                                      True)))
+        if guard_on:
+            self.link_anomaly_guard()
         self.link_loop()
         self.snapshotter = None
         self.image_saver = None
@@ -248,6 +256,54 @@ class StandardWorkflow(AcceleratedWorkflow):
             next_gd = unit
         self.gds.reverse()
 
+    def link_anomaly_guard(self) -> None:
+        """Attach the resilience anomaly guard (round 11): the
+        evaluator seeds per-step finite flags, every weighted GD folds
+        its gradient check in and gates its update, and the guard unit
+        commits the streak/totals state the Decision unit reads (see
+        :mod:`znicz_tpu.resilience.guard`).  Gate:
+        ``root.common.engine.anomaly_guard`` (default on) or the
+        ``anomaly_guard`` constructor argument."""
+        from znicz_tpu.resilience.guard import AnomalyGuard
+        guard = AnomalyGuard(self, name="anomaly_guard")
+        self.anomaly_guard = guard
+        self.evaluator.link_attrs(guard, "step_flags", "fault_inject",
+                                  two_way=False)
+        for gd_unit in self.gds:
+            gd_unit.link_attrs(guard, ("anomaly_flag", "step_flags"),
+                               two_way=False)
+
+    def rollback_to_snapshot(self, streak: int) -> bool:
+        """Anomaly-streak recovery (called by the Decision unit after
+        K consecutive non-finite steps): reload the Snapshotter's last
+        good checkpoint through the digest-verified load path and
+        resume mid-epoch (the round-10 resume machinery restores the
+        loader cursor, PRNG streams and optimizer state).  Returns
+        True when a rollback happened.  Without a snapshot the guard
+        has still prevented weight poisoning (anomalous updates were
+        skipped), so the run continues with a warning."""
+        import os as _os
+
+        from znicz_tpu.observe import metrics as _metrics
+        from znicz_tpu.utils.snapshotter import Snapshotter
+        snap = self.snapshotter
+        path = snap.destination if snap is not None else None
+        if self.anomaly_guard is not None:
+            self.anomaly_guard.reset_streak()
+        if not path or not _os.path.exists(path):
+            self.warning(
+                "anomaly streak %d with no snapshot to roll back to — "
+                "anomalous updates were skipped, continuing as-is",
+                streak)
+            return False
+        state = Snapshotter.load(path)
+        self.load_state(state)
+        _metrics.anomaly_rollbacks(self.name).inc()
+        _metrics.recoveries("rollback").inc()
+        self.warning("anomaly streak %d: rolled back to %s and "
+                     "resumed", streak, path)
+        return True
+
     def link_loop(self) -> None:
         """Wire the training loop's control flow."""
         self.repeater.link_from(self.start_point)
@@ -273,6 +329,12 @@ class StandardWorkflow(AcceleratedWorkflow):
         for gd_unit in reversed(self.gds):
             gd_unit.link_from(prev)
             prev = gd_unit
+        if self.anomaly_guard is not None:
+            # the guard commits the step's anomaly verdict AFTER the
+            # last backward unit (same position it holds in the
+            # region's trace order)
+            self.anomaly_guard.link_from(prev)
+            prev = self.anomaly_guard
         return prev
 
     def _relink_end_point_last(self) -> None:
@@ -493,14 +555,27 @@ class StandardWorkflow(AcceleratedWorkflow):
         """Swap the eager hot chain for one jit region (xla backend)."""
         members = [self.loader, *self.forwards, self.evaluator,
                    *reversed(self.gds)]
+        guard = self.anomaly_guard
+        if guard is not None:
+            members.append(guard)  # traced last: commits the verdict
         region = RegionUnit(self, members, name="train_region")
         region.initialize(device=self.device)
         region._initialized = True
-        # rewire: loader → region → decision (drop the eager chain)
-        self.decision.unlink_from(self.gds[0] if self.gds
-                                  else self.evaluator)
+        # rewire: loader → [guard host hook] → region → decision (drop
+        # the eager chain).  Like the loader, the guard stays in the
+        # control graph for its per-step host_run (the fault-inject
+        # leaf) while its device compute runs inside the region.
+        tail = guard if guard is not None \
+            else (self.gds[0] if self.gds else self.evaluator)
+        self.decision.unlink_from(tail)
         first_fwd = self.forwards[0]
         first_fwd.unlink_from(self.loader)
-        region.link_from(self.loader)
+        if guard is not None:
+            guard.unlink_from(self.gds[0] if self.gds
+                              else self.evaluator)
+            guard.link_from(self.loader)
+            region.link_from(guard)
+        else:
+            region.link_from(self.loader)
         self.decision.link_from(region)
         self._region_unit = region

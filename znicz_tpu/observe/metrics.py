@@ -516,3 +516,109 @@ def serving_warmup_seconds(engine: str) -> Gauge:
         "znicz_serving_warmup_seconds",
         "Wall time spent AOT-compiling the bucket ladder at start()",
         labels=("engine",)).labels(engine=engine)
+
+
+# ----------------------------------------------------------------------
+# resilience series (round 11): every fault, skip, retry, quarantine,
+# rollback and breaker transition is a scrapeable counter so the chaos
+# dryrun attests recovery from the same /metrics feed Prometheus reads
+# ----------------------------------------------------------------------
+def faults_injected(site: str) -> Counter:
+    """Deterministic fault-injection events by named site (one event
+    per transient firing; a persistent fault counts once)."""
+    return REGISTRY.counter(
+        "znicz_faults_injected_total",
+        "Injected fault events by site (resilience.faults)",
+        labels=("site",)).labels(site=site)
+
+
+def recoveries(kind: str) -> Counter:
+    """Recovery events: the system absorbed a fault and kept going
+    (anomaly_step, rollback, shard_retry, shard_quarantine,
+    reader_restart, serving_retry, snapshot_write,
+    snapshot_fallback)."""
+    return REGISTRY.counter(
+        "znicz_recoveries_total",
+        "Faults absorbed without failing the run, by recovery kind",
+        labels=("kind",)).labels(kind=kind)
+
+
+def step_anomalies(workflow: str, kind: str) -> Counter:
+    """Training steps whose loss (kind=loss) or gradients (kind=grad)
+    went non-finite; the guard skipped their optimizer update."""
+    return REGISTRY.counter(
+        "znicz_step_anomalies_total",
+        "Non-finite training steps by kind (update skipped)",
+        labels=("workflow", "kind")).labels(workflow=workflow, kind=kind)
+
+
+def anomaly_rollbacks(workflow: str) -> Counter:
+    return REGISTRY.counter(
+        "znicz_anomaly_rollbacks_total",
+        "Rollbacks to the last good snapshot after K consecutive "
+        "anomalous steps", labels=("workflow",)).labels(workflow=workflow)
+
+
+def loader_read_retries(loader: str) -> Counter:
+    return REGISTRY.counter(
+        "znicz_loader_read_retries_total",
+        "Shard read attempts that failed and were retried",
+        labels=("loader",)).labels(loader=loader)
+
+
+def loader_shards_quarantined(loader: str) -> Counter:
+    return REGISTRY.counter(
+        "znicz_loader_shards_quarantined_total",
+        "Shards quarantined after exhausting read retries (their rows "
+        "deliver zeros for the rest of the run)",
+        labels=("loader",)).labels(loader=loader)
+
+
+def loader_pipeline_restarts(loader: str) -> Counter:
+    return REGISTRY.counter(
+        "znicz_loader_pipeline_restarts_total",
+        "Streaming pipelines rebuilt after a producer/uploader thread "
+        "died", labels=("loader",)).labels(loader=loader)
+
+
+def snapshot_failures(op: str) -> Counter:
+    return REGISTRY.counter(
+        "znicz_snapshot_failures_total",
+        "Snapshot operations that failed and were absorbed "
+        "(op=write: training continued on the last good snapshot; "
+        "op=load: a corrupt file fell back to an older snapshot)",
+        labels=("op",)).labels(op=op)
+
+
+def serving_breaker_state(engine: str) -> Gauge:
+    """0 = closed (healthy), 1 = half-open (probing), 2 = open
+    (shedding load with fast Overloaded replies)."""
+    return REGISTRY.gauge(
+        "znicz_serving_breaker_state",
+        "Circuit-breaker state (0 closed, 1 half-open, 2 open)",
+        labels=("engine",)).labels(engine=engine)
+
+
+def serving_breaker_transitions(engine: str, to: str) -> Counter:
+    return REGISTRY.counter(
+        "znicz_serving_breaker_transitions_total",
+        "Circuit-breaker state transitions by target state",
+        labels=("engine", "to")).labels(engine=engine, to=to)
+
+
+def serving_queue_age_seconds(engine: str) -> Gauge:
+    """Age of the oldest pending request (live callback gauge) — the
+    breaker's stall signal and a /readyz input."""
+    return REGISTRY.gauge(
+        "znicz_serving_queue_age_seconds",
+        "Age of the oldest request pending in the batcher queue",
+        labels=("engine",)).labels(engine=engine)
+
+
+def last_step_timestamp(workflow: str) -> Gauge:
+    """Unix time of the last completed training step — /readyz turns
+    this into last-step staleness for external supervisors."""
+    return REGISTRY.gauge(
+        "znicz_last_step_timestamp_seconds",
+        "Unix timestamp of the workflow's last completed step",
+        labels=("workflow",)).labels(workflow=workflow)

@@ -17,6 +17,8 @@ evaluator's scalar metric (``n_err`` / ``metrics``) per step.
 
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 from znicz_tpu.loader.base import CLASS_NAME, TRAIN, VALID
@@ -80,6 +82,49 @@ class DecisionBase(Unit):
                 self.info("no improvement for %d epochs — stopping",
                           self._epochs_without_improvement)
                 self.complete.value = True
+        self._resilience_tick()
+
+    def _resilience_tick(self) -> None:
+        """Round-11 host hook, every fire: translate the anomaly
+        guard's on-device totals into registry counters, trigger the
+        K-streak rollback, and stamp the last-step gauge /readyz turns
+        into staleness.  One tiny d2h read per step when the guard is
+        on; nothing otherwise."""
+        wf = self.workflow
+        if wf is None:
+            return
+        if _metrics.enabled():
+            _metrics.last_step_timestamp(wf.name).set(time.time())
+        guard = getattr(wf, "anomaly_guard", None)
+        if guard is None or not guard.is_initialized:
+            return
+        from znicz_tpu.utils.config import root
+        # the guard state read is a tiny d2h sync; on a tunneled TPU
+        # per-step path raise the interval to amortize it (rollback
+        # detection latency grows to `interval` steps — the skip
+        # itself is on-device and never waits for this read)
+        interval = int(root.common.engine.get("anomaly_check_interval",
+                                              1))
+        self._guard_tick = getattr(self, "_guard_tick", 0) + 1
+        if interval > 1 and self._guard_tick % interval:
+            return
+        streak, loss_t, grad_t = guard.read_state()
+        base_l, base_g = guard._metric_base
+        if loss_t > base_l:
+            _metrics.step_anomalies(wf.name, "loss").inc(loss_t - base_l)
+        if grad_t > base_g:
+            _metrics.step_anomalies(wf.name, "grad").inc(grad_t - base_g)
+        delta = (loss_t - base_l) + (grad_t - base_g)
+        if delta > 0:
+            # every anomalous step the guard absorbed (update skipped,
+            # run continued) is a recovery the chaos dryrun attests
+            _metrics.recoveries("anomaly_step").inc(delta)
+            guard._metric_base = (loss_t, grad_t)
+            self.warning("%d non-finite step(s) skipped by the "
+                         "anomaly guard (streak %d)", delta, streak)
+        k = int(root.common.engine.get("anomaly_rollback_k", 5))
+        if streak >= k > 0 and hasattr(wf, "rollback_to_snapshot"):
+            wf.rollback_to_snapshot(streak)
 
     def accumulate_minibatch(self) -> None:
         raise NotImplementedError

@@ -35,11 +35,40 @@ class EvaluatorBase(AcceleratedUnit):
         self.minibatch_valid: Vector | None = None  # link from loader
         self.err_output = Vector(name=f"{self.name}.err_output",
                                  batch_major=True)
+        # anomaly guard hooks, linked by StandardWorkflow when the
+        # guard is on (resilience.guard): step_flags is seeded here
+        # ([running_ok, loss_ok] = isfinite(step loss)); fault_inject
+        # is the chaos harness's [loss_add, grad_add] leaf (None
+        # unless a fault plan configures a train site)
+        self.step_flags: Vector | None = None
+        self.fault_inject: Vector | None = None
 
     def _valid_mask(self, xp, n_rows):
         valid = self.minibatch_valid.devmem if xp is jnp \
             else self.minibatch_valid.mem
         return (xp.arange(n_rows) < valid), valid
+
+    def _inject(self, xp, idx: int):
+        """The chaos leaf's additive term (0.0 normally, NaN on an
+        injected step); 0.0 when no fault plan is configured."""
+        inj = self.fault_inject
+        if inj is None or not inj:
+            return None
+        return inj.devmem[idx] if xp is jnp else inj.mem[idx]
+
+    def _seed_step_flags(self, xp, loss_ok) -> None:
+        """Write [running_ok, loss_ok]; the backward chain ANDs its
+        gradient-finiteness into slot 0 and the AnomalyGuard commits
+        the verdict at the end of the step."""
+        flags = self.step_flags
+        if flags is None or not flags:
+            return
+        if xp is jnp:
+            f = loss_ok.astype(jnp.float32)
+            flags.devmem = jnp.stack([f, f])
+        else:
+            f = np.float32(1.0 if loss_ok else 0.0)
+            flags.mem[...] = [f, f]
 
 
 class EvaluatorSoftmax(EvaluatorBase):
@@ -102,9 +131,12 @@ class EvaluatorSoftmax(EvaluatorBase):
         mask, valid = self._valid_mask(np, p.shape[0])
         onehot = np.zeros_like(p)
         onehot[np.arange(p.shape[0]), t] = 1.0
+        err = mask[:, None] * (p - onehot) / max(int(valid), 1)
+        grad_inj = self._inject(np, 1)
+        if grad_inj is not None:
+            err = err + grad_inj
         self.err_output.map_invalidate()
-        self.err_output.mem[...] = (
-            mask[:, None] * (p - onehot) / max(int(valid), 1))
+        self.err_output.mem[...] = err
         self.n_err.map_invalidate()
         n_err = int(np.sum((self.max_idx.mem != t) & mask))
         self.n_err.mem[...] = n_err
@@ -112,8 +144,16 @@ class EvaluatorSoftmax(EvaluatorBase):
         self.epoch_n_err.mem[int(self.minibatch_class)] += n_err
         self.epoch_loss.map_write()
         p_true = np.maximum(p[np.arange(p.shape[0]), t], 1e-30)
+        loss_sum = np.float32(np.sum(mask * -np.log(p_true)))
+        loss_inj = self._inject(np, 0)
+        if loss_inj is not None:
+            loss_sum = loss_sum + np.float32(loss_inj)
+        loss_ok = bool(np.isfinite(loss_sum))
+        # a non-finite step must not poison the epoch accumulator —
+        # the guard skips its update; the accumulator skips its sample
         self.epoch_loss.mem[int(self.minibatch_class)] += float(
-            np.sum(mask * -np.log(p_true)))
+            loss_sum if loss_ok else 0.0)
+        self._seed_step_flags(np, loss_ok)
         if self.compute_confusion:
             self.confusion_matrix.map_write()
             cm = self.confusion_matrix.mem[int(self.minibatch_class)]
@@ -126,15 +166,27 @@ class EvaluatorSoftmax(EvaluatorBase):
         mask, valid = self._valid_mask(jnp, p.shape[0])
         onehot = jax_onehot(t, p.shape[1], p.dtype)
         denom = jnp.maximum(valid, 1).astype(p.dtype)
-        self.err_output.devmem = mask[:, None] * (p - onehot) / denom
+        err = mask[:, None] * (p - onehot) / denom
+        grad_inj = self._inject(jnp, 1)
+        if grad_inj is not None:
+            err = err + grad_inj.astype(err.dtype)
+        self.err_output.devmem = err
         n_err = jnp.sum((self.max_idx.devmem != t) & mask).astype(jnp.int32)
         self.n_err.devmem = n_err
         self.epoch_n_err.devmem = self.epoch_n_err.devmem.at[
             int(self.minibatch_class)].add(n_err)
         p_true = jnp.maximum(p[jnp.arange(p.shape[0]), t], 1e-30)
+        loss_sum = jnp.sum(mask * -jnp.log(p_true)).astype(jnp.float32)
+        loss_inj = self._inject(jnp, 0)
+        if loss_inj is not None:
+            loss_sum = loss_sum + loss_inj
+        loss_ok = jnp.isfinite(loss_sum)
+        # a non-finite step must not poison the epoch accumulator —
+        # the guard skips its update; the accumulator skips its sample
         self.epoch_loss.devmem = self.epoch_loss.devmem.at[
             int(self.minibatch_class)].add(
-                jnp.sum(mask * -jnp.log(p_true)).astype(jnp.float32))
+                jnp.where(loss_ok, loss_sum, 0.0))
+        self._seed_step_flags(jnp, loss_ok)
         if self.compute_confusion:
             # masked rows contribute 0; duplicate (t, pred) pairs
             # accumulate via scatter-add
@@ -178,14 +230,23 @@ class EvaluatorMSE(EvaluatorBase):
         y2 = y.reshape(batch, -1)
         mask, valid = self._valid_mask(np, batch)
         diff = mask[:, None] * (y2 - t)
+        err = (diff * (2.0 / max(int(valid), 1))).reshape(y.shape)
+        grad_inj = self._inject(np, 1)
+        if grad_inj is not None:
+            err = err + grad_inj
         self.err_output.map_invalidate()
-        self.err_output.mem[...] = (
-            diff * (2.0 / max(int(valid), 1))).reshape(y.shape)
+        self.err_output.mem[...] = err
         self.metrics.map_invalidate()
-        sse = np.sum(diff * diff)
+        sse = np.float32(np.sum(diff * diff))
+        loss_inj = self._inject(np, 0)
+        if loss_inj is not None:
+            sse = sse + np.float32(loss_inj)
         self.metrics.mem[...] = sse
+        loss_ok = bool(np.isfinite(sse))
         self.epoch_sse.map_write()
-        self.epoch_sse.mem[int(self.minibatch_class)] += sse
+        self.epoch_sse.mem[int(self.minibatch_class)] += \
+            sse if loss_ok else 0.0
+        self._seed_step_flags(np, loss_ok)
 
     def xla_run(self) -> None:
         # f32 math regardless of the activation storage dtype: the SSE
@@ -198,11 +259,20 @@ class EvaluatorMSE(EvaluatorBase):
         mask, valid = self._valid_mask(jnp, batch)
         diff = mask[:, None] * (y2 - t)
         denom = jnp.maximum(valid, 1).astype(y.dtype)
-        self.err_output.devmem = (diff * (2.0 / denom)).reshape(y.shape)
+        err = (diff * (2.0 / denom)).reshape(y.shape)
+        grad_inj = self._inject(jnp, 1)
+        if grad_inj is not None:
+            err = err + grad_inj.astype(err.dtype)
+        self.err_output.devmem = err
         sse = jnp.sum(diff * diff)
+        loss_inj = self._inject(jnp, 0)
+        if loss_inj is not None:
+            sse = sse + loss_inj
         self.metrics.devmem = sse
+        loss_ok = jnp.isfinite(sse)
         self.epoch_sse.devmem = self.epoch_sse.devmem.at[
-            int(self.minibatch_class)].add(sse)
+            int(self.minibatch_class)].add(jnp.where(loss_ok, sse, 0.0))
+        self._seed_step_flags(jnp, loss_ok)
 
 
 def jax_onehot(labels, n_classes: int, dtype):

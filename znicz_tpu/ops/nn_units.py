@@ -217,6 +217,13 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         #: the update runs ZeRO-1 sharded over the mesh's data axis
         self._zero1 = False
         self._grad_comms_bf16 = False
+        #: anomaly-guard flag vector ([running_ok, loss_ok], linked by
+        #: StandardWorkflow to the AnomalyGuard's step_flags); when
+        #: set, every parameter update folds isfinite(‖grad‖²) into
+        #: the running flag and applies through where(ok, new, old) —
+        #: a non-finite step leaves weights and momentum untouched.
+        #: None (the default for standalone units) = exact seed path.
+        self.anomaly_flag: Vector | None = None
         # linked from the paired forward unit by StandardWorkflow:
         self.input: Vector | None = None
         self.output: Vector | None = None
@@ -368,6 +375,20 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         scale = xp.minimum(1.0, clip / xp.maximum(norm, 1e-30))
         return grad * scale
 
+    def _np_grad_ok(self, grad: np.ndarray) -> bool:
+        """Numpy-path mirror of the guard's on-device finite check:
+        AND this gradient's ‖g‖² finiteness into the shared flag and
+        return whether the update may apply."""
+        guard = self.anomaly_flag
+        if guard is None or not guard:
+            return True
+        own = bool(np.isfinite(
+            np.sum(np.square(grad, dtype=np.float64))))
+        ok = own and guard.mem[0] > 0.5
+        if not own:
+            guard.mem[0] = 0.0
+        return ok
+
     # ``vec``/``acc`` parameters let units with EXTRA parameter pairs
     # (e.g. attention's output projection) reuse the exact update rule
     # instead of copy-pasting the momentum/decay/clip math
@@ -376,6 +397,8 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         vec = vec if vec is not None else self.weights
         acc_vec = acc_vec if acc_vec is not None \
             else self.accumulated_gradient_weights
+        if not self._np_grad_ok(grad_w):
+            return  # anomaly guard: skip, don't poison
         w = vec.mem
         g = self._regularized(np, self._clipped(np, grad_w), w,
                               self.weights_decay)
@@ -395,6 +418,8 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             else self.accumulated_gradient_bias
         if vec is None or not vec:
             return
+        if not self._np_grad_ok(grad_b):
+            return  # anomaly guard: skip, don't poison
         b = vec.mem
         g = self._regularized(np, self._clipped(np, grad_b), b,
                               self.weights_decay_bias)
@@ -437,23 +462,51 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
           identical momentum/decay/clip update runs on every chip;
         - ZeRO-1 (``engine.zero1``, auto-on for data axes > 1): see
           :meth:`_apply_param_zero1`.
+
+        With :attr:`anomaly_flag` linked (the default under
+        ``StandardWorkflow``'s anomaly guard) the whole update —
+        either form — is applied through ``where(ok, new, old)``,
+        where ``ok`` = the step's running flag (loss finite, every
+        previously-checked gradient finite) AND ``isfinite(‖grad‖²)``
+        of THIS tensor.  A non-finite step leaves the parameter and
+        its momentum bitwise untouched; finite steps are bitwise
+        identical to the unguarded path (``where`` with a true
+        predicate selects the new value exactly).
         """
         from znicz_tpu.parallel.axis import current_data_axis
         grad = maybe_pmean(grad)
+        guard = self.anomaly_flag \
+            if self.anomaly_flag is not None and self.anomaly_flag else None
+        if guard is not None:
+            g32 = grad.astype(jnp.float32)
+            own_ok = jnp.isfinite(jnp.sum(g32 * g32))
+            flags = guard.devmem
+            step_ok = (flags[0] > 0.5) & own_ok
+            guard.devmem = flags.at[0].set(
+                jnp.where(own_ok, flags[0], 0.0))
+            w_before = vec.devmem
+            acc_before = (acc_vec.devmem
+                          if moment and acc_vec is not None and acc_vec
+                          else None)
         if self._zero1 and current_data_axis() is None:
             self._apply_param_zero1(grad, vec, acc_vec, decay, lr, moment)
-            return
-        w = vec.devmem
-        g = self._regularized(jnp, self._clipped(jnp, grad), w, decay)
-        if moment:
-            # momentum math in f32 regardless of the accumulator's
-            # STORAGE dtype (opt_state_dtype); the setter rounds the
-            # store back down
-            acc = moment * acc_vec.devmem.astype(jnp.float32) - lr * g
-            acc_vec.devmem = acc
-            vec.devmem = w + acc
         else:
-            vec.devmem = w - lr * g
+            w = vec.devmem
+            g = self._regularized(jnp, self._clipped(jnp, grad), w, decay)
+            if moment:
+                # momentum math in f32 regardless of the accumulator's
+                # STORAGE dtype (opt_state_dtype); the setter rounds
+                # the store back down
+                acc = moment * acc_vec.devmem.astype(jnp.float32) - lr * g
+                acc_vec.devmem = acc
+                vec.devmem = w + acc
+            else:
+                vec.devmem = w - lr * g
+        if guard is not None:
+            vec.devmem = jnp.where(step_ok, vec.devmem, w_before)
+            if acc_before is not None:
+                acc_vec.devmem = jnp.where(step_ok, acc_vec.devmem,
+                                           acc_before)
 
     def _apply_param_zero1(self, grad, vec: Vector, acc_vec,
                            decay: float, lr, moment: float) -> None:

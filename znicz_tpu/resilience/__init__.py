@@ -1,0 +1,37 @@
+"""Resilience: deterministic fault injection + the recovery machinery.
+
+Veles's defining production trait (PAPER.md SURVEY §0) was surviving
+partial failure — slaves could drop, stall, or send garbage and the
+master kept training and serving.  This package is the rebuild's
+equivalent substrate, wired through every layer of the modern stack:
+
+- :mod:`znicz_tpu.resilience.faults` — a seeded, deterministic
+  fault-injection harness: every injection point in the framework is a
+  *named site* gated on ``root.common.engine.faults`` (default off,
+  one dict lookup when off), so a chaos run is a config recipe, not a
+  code fork, and replays bit-for-bit from its seed;
+- :mod:`znicz_tpu.resilience.guard` — the training anomaly guard: an
+  on-device finite check folded into the existing jit region that
+  skips the optimizer update on a non-finite loss/grad step, counts
+  anomalies, and (via the Decision unit) rolls back to the last good
+  snapshot after K consecutive anomalous steps;
+- the streaming loader's shard-CRC/retry/quarantine and
+  producer-death propagation live in :mod:`znicz_tpu.loader.streaming`;
+- the serving deadline/retry/circuit-breaker path lives in
+  :mod:`znicz_tpu.serving`;
+- snapshot retention + digest-verified load lives in
+  :mod:`znicz_tpu.utils.snapshotter`.
+
+Every fault, retry, skip, quarantine, rollback and breaker transition
+is a canonical :mod:`znicz_tpu.observe` registry series scraped by
+``/metrics`` (``znicz_faults_injected_total``,
+``znicz_recoveries_total``, ``znicz_step_anomalies_total``, …) and
+attested by the chaos dryrun (``GRAFT_CHAOS=1 __graft_entry__.py``).
+"""
+
+from znicz_tpu.resilience.faults import (  # noqa: F401
+    FaultInjected,
+    FaultPlan,
+    SITES,
+    fire,
+)

@@ -1,0 +1,232 @@
+"""Deterministic fault injection: named sites, seeded recipes.
+
+The chaos contract: a fault recipe is **configuration**
+(``root.common.engine.faults``), every injection point in the
+framework is a **named site**, and a given ``(recipe, seed)`` replays
+the identical fault sequence — so a chaos soak is as reproducible as
+the counter-based shuffle made the data plane.
+
+Usage (the injecting side)::
+
+    from znicz_tpu.resilience import faults as _faults
+    payload = _faults.fire("loader.corrupt_shard", shard=3)
+    if payload is not None:
+        raise ShardReadError(3, "injected corrupt shard")
+
+``fire`` returns ``None`` in one dict lookup when no plan is
+configured — the zero-overhead-when-off guarantee every hot path
+relies on.  When a plan is active, each call counts one *arrival* at
+the site (optionally filtered by keyword context, e.g. only arrivals
+for ``shard=3``) and the site's spec decides whether this arrival
+fires.
+
+Recipe forms (``root.common.engine.faults = {...}``), per site:
+
+- ``3`` or ``[3, 7]`` — fire on exactly those arrival ordinals
+  (1-based); each listed arrival is one counted fault event;
+- ``{"at": [3]}`` — same, dict form (extra keys become the payload
+  and double as context filters);
+- ``{"after": 1}`` — fire on every arrival from that ordinal on — a
+  *persistent* fault (a corrupt shard stays corrupt); counted as ONE
+  fault event no matter how many reads hit it;
+- ``{"p": 0.05}`` — fire each arrival with probability p from the
+  plan's Philox stream (deterministic per seed); each fire is one
+  event;
+- ``True`` — shorthand for ``{"after": 1}``.
+
+The reserved recipe key ``"_seed"`` (default 0) seeds the
+probabilistic streams.  Any other spec key that also appears in the
+``fire`` call's context must match for the arrival to count — e.g.
+``{"shard": 1, "after": 1}`` only ever fires for ``fire(site,
+shard=1)``.
+
+Every fired event increments ``znicz_faults_injected_total{site}`` so
+the dryrun tail and the tests attest injection counts from the same
+series ``/metrics`` exposes.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+
+import numpy as np
+
+from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.utils.config import root
+
+#: the framework's named injection sites (the docstring of record —
+#: greppable, and the recipe validator rejects unknown names so a typo
+#: fails loudly instead of silently injecting nothing)
+SITES = {
+    "train.nonfinite_loss":
+        "NaN added to the evaluator's per-step loss (rides the guard's "
+        "device-resident inject leaf — no recompile)",
+    "train.nonfinite_grad":
+        "NaN added to the evaluator's err_output seed — every weight "
+        "gradient of the step goes non-finite while the loss stays "
+        "clean",
+    "loader.reader_death":
+        "streaming producer thread raises mid-epoch (exercises the "
+        "poison-pill propagation + bounded pipeline restart)",
+    "loader.corrupt_shard":
+        "a shard read raises as if its CRC failed; with {'after': n} "
+        "the shard is persistently bad and must be quarantined",
+    "loader.short_read":
+        "a shard read raises as a transient short read (retry path)",
+    "serving.program_error":
+        "the serving dispatch raises before touching the AOT program "
+        "(exercises the retry budget / breaker)",
+    "serving.latency_spike":
+        "the serving dispatch sleeps payload 'ms' (default 50) before "
+        "running (exercises deadlines + queue-age shedding)",
+    "snapshot.write_fail":
+        "Snapshotter.write raises OSError mid-write (exercises "
+        "tolerate-and-continue + retention of the last good snapshot)",
+}
+
+#: spec keys that steer firing rather than ride the payload
+_CONTROL_KEYS = ("at", "after", "p")
+
+
+class FaultInjected(RuntimeError):
+    """The exception injected faults raise where a real fault would."""
+
+
+def _normalize(site: str, spec) -> dict:
+    if spec is True:
+        spec = {"after": 1}
+    elif isinstance(spec, (int, np.integer)) and not isinstance(spec, bool):
+        spec = {"at": [int(spec)]}
+    elif isinstance(spec, (list, tuple)):
+        spec = {"at": [int(a) for a in spec]}
+    if not isinstance(spec, dict):
+        raise ValueError(f"fault site '{site}': bad spec {spec!r}")
+    if not any(k in spec for k in _CONTROL_KEYS):
+        raise ValueError(
+            f"fault site '{site}': spec needs one of {_CONTROL_KEYS}")
+    return dict(spec)
+
+
+class FaultPlan:
+    """One chaos recipe: per-site firing specs + deterministic state.
+
+    Thread-safe — loader reader pools, the serving scheduler thread
+    and the training control plane all call :meth:`fire` concurrently.
+    """
+
+    def __init__(self, recipe: dict, seed: int | None = None) -> None:
+        recipe = dict(recipe)
+        self.seed = int(recipe.pop("_seed", 0) if seed is None else seed)
+        unknown = sorted(set(recipe) - set(SITES))
+        if unknown:
+            raise ValueError(
+                f"unknown fault site(s) {unknown} — see "
+                f"znicz_tpu.resilience.faults.SITES")
+        self._specs = {site: _normalize(site, spec)
+                       for site, spec in recipe.items()}
+        self._lock = threading.Lock()
+        self._arrivals: dict[str, int] = {}
+        self._events: dict[str, int] = {}
+        self._rngs: dict[str, np.random.Generator] = {}
+
+    # ------------------------------------------------------------------
+    def _rng(self, site: str) -> np.random.Generator:
+        gen = self._rngs.get(site)
+        if gen is None:
+            key = np.array([self.seed & ((1 << 64) - 1),
+                            zlib.crc32(site.encode())], dtype=np.uint64)
+            gen = self._rngs[site] = np.random.Generator(
+                np.random.Philox(key=key))
+        return gen
+
+    def fire(self, site: str, **ctx):
+        """One arrival at ``site``: the payload dict when the plan says
+        this arrival faults, else ``None``."""
+        spec = self._specs.get(site)
+        if spec is None:
+            return None
+        with self._lock:
+            for key, want in spec.items():
+                if key in _CONTROL_KEYS:
+                    continue
+                if key in ctx and ctx[key] != want:
+                    return None  # context mismatch: not our arrival
+            n = self._arrivals.get(site, 0) + 1
+            self._arrivals[site] = n
+            fired = event = False
+            if "at" in spec:
+                fired = event = n in set(int(a) for a in spec["at"])
+            elif "after" in spec:
+                fired = n >= int(spec["after"])
+                # a persistent fault is ONE event however often it is
+                # observed (one corrupt shard, many reads of it)
+                event = fired and not self._events.get(site)
+            elif "p" in spec:
+                fired = event = bool(
+                    self._rng(site).random() < float(spec["p"]))
+            if not fired:
+                return None
+            if event:
+                self._events[site] = self._events.get(site, 0) + 1
+                _metrics.faults_injected(site).inc()
+        payload = {k: v for k, v in spec.items() if k not in _CONTROL_KEYS}
+        payload.update(ctx)
+        payload["site"] = site
+        payload["arrival"] = n
+        return payload
+
+    # ------------------------------------------------------------------
+    @property
+    def events_fired(self) -> int:
+        """Distinct fault events fired so far (what the dryrun tail
+        attests as ``faults_injected``)."""
+        with self._lock:
+            return sum(self._events.values())
+
+    def counts(self) -> dict:
+        with self._lock:
+            return dict(self._events)
+
+    def configured_sites(self) -> set:
+        return set(self._specs)
+
+    def __repr__(self) -> str:
+        return f"FaultPlan(seed={self.seed}, sites={sorted(self._specs)})"
+
+
+# ----------------------------------------------------------------------
+# the module-level gate every injection point calls
+# ----------------------------------------------------------------------
+def active() -> FaultPlan | None:
+    """The configured plan, or None (the fast path: one dict lookup).
+    A plain dict recipe in ``root.common.engine.faults`` is wrapped
+    into a :class:`FaultPlan` on first touch and stored back, so its
+    arrival counters persist for the run."""
+    plan = root.common.engine.get("faults", None)
+    if plan is None or plan is False:
+        return None
+    if not isinstance(plan, FaultPlan):
+        if hasattr(plan, "as_dict"):  # the config tree nodified the
+            plan = plan.as_dict()     # recipe dict on assignment
+        plan = FaultPlan(plan)
+        root.common.engine.faults = plan
+    return plan
+
+
+def fire(site: str, **ctx):
+    """Arrival at a named site: payload dict when it faults, else
+    None.  Zero work when no plan is configured."""
+    plan = active()
+    if plan is None:
+        return None
+    return plan.fire(site, **ctx)
+
+
+def site_configured(*sites: str) -> bool:
+    """True when the active plan injects at ANY of the given sites —
+    lets initialize-time code (the guard's inject leaf) avoid touching
+    the traced program when no training fault can ever fire."""
+    plan = active()
+    return plan is not None and bool(
+        plan.configured_sites() & set(sites))

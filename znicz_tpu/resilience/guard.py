@@ -1,0 +1,157 @@
+"""Training anomaly guard: on-device finite checks, skip-don't-poison.
+
+The failure mode this kills: one non-finite training step (bad batch,
+overflowing logits, a flipped bit on the wire) used to poison the
+weights forever — every later step multiplies NaN by something and the
+run is dead long before a human reads the loss curve.
+
+Design (all inside the EXISTING jit region — zero new compiles on
+warmed paths, ``tests/test_retrace_guard.py``):
+
+- the evaluator seeds a device-resident ``step_flags`` f32[2] vector
+  each step: ``[running_ok, loss_ok]``, both = isfinite(step loss);
+- every weighted GD unit folds ``isfinite(‖grad‖²)`` into
+  ``running_ok`` and applies its parameter update through
+  ``where(ok, new, old)`` — a non-finite step leaves weights AND
+  momentum untouched (see ``GradientDescentBase._apply_param_xla``);
+- this unit runs LAST in the region and maintains
+  ``anomaly_state`` int32[3] = ``[consecutive_streak,
+  loss_anomalies_total, grad_anomalies_total]`` on device;
+- the Decision unit reads the state each fire (host control plane),
+  translates the totals into ``znicz_step_anomalies_total{kind}`` /
+  ``znicz_recoveries_total{kind=anomaly_step}`` registry deltas, and
+  after K consecutive anomalies (``engine.anomaly_rollback_k``,
+  default 5) asks the workflow to roll back to the Snapshotter's last
+  good checkpoint (the round-10 mid-epoch resume path) and continue.
+
+Fault injection (``train.nonfinite_loss`` / ``train.nonfinite_grad``):
+when the active fault plan configures either site, the guard allocates
+a ``fault_inject`` f32[2] leaf the evaluator adds into the step loss /
+the err_output seed — the NaN rides a leaf VALUE, so injecting never
+recompiles, and the poisoned numbers flow through the real data path
+(the gradients genuinely go non-finite).  Without a plan the leaf is
+never allocated and the traced program is byte-identical to a
+guard-only build.
+
+Gate: ``root.common.engine.anomaly_guard`` (default on) — built by
+``StandardWorkflow``; the measured warmed-step overhead is within
+noise (PERF.md round 11).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from znicz_tpu.accelerated_units import AcceleratedUnit
+from znicz_tpu.loader.base import TRAIN
+from znicz_tpu.memory import Vector
+from znicz_tpu.resilience import faults as _faults
+
+#: the two training injection sites this unit hosts
+TRAIN_SITES = ("train.nonfinite_loss", "train.nonfinite_grad")
+
+
+class AnomalyGuard(AcceleratedUnit):
+    """Region member that finalizes the per-step anomaly verdict.
+
+    Trace order: loader → forwards → evaluator → backwards → **guard**
+    — by the time this unit runs, ``step_flags[0]`` has been ANDed by
+    the evaluator (loss finite) and every weighted GD (grad finite).
+    """
+
+    # per-step transients + process-lifetime totals: neither belongs in
+    # a checkpoint (restoring old totals would run the host-side metric
+    # deltas backwards)
+    SNAPSHOT_EXCLUDE = ("step_flags", "anomaly_state", "fault_inject")
+
+    def __init__(self, workflow, name: str | None = None, **kwargs) -> None:
+        super().__init__(workflow, name=name, **kwargs)
+        #: [running_ok, loss_ok] — seeded by the evaluator each step,
+        #: ANDed by each GD unit, read+committed here
+        self.step_flags = Vector(name=f"{self.name}.step_flags")
+        #: [consecutive_streak, loss_total, grad_total]
+        self.anomaly_state = Vector(name=f"{self.name}.anomaly_state")
+        #: [loss_add, grad_add] — 0.0 normally, NaN on injected steps;
+        #: allocated ONLY when a fault plan configures a train site
+        self.fault_inject: Vector | None = (
+            Vector(name=f"{self.name}.fault_inject")
+            if _faults.site_configured(*TRAIN_SITES) else None)
+        #: host mirror of the last totals the Decision translated into
+        #: registry counters (delta base)
+        self._metric_base = (0, 0)
+        self._last_inject = (False, False)
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        self.step_flags.reset(np.ones(2, dtype=np.float32))
+        self.anomaly_state.reset(np.zeros(3, dtype=np.int32))
+        self.init_vectors(self.step_flags, self.anomaly_state)
+        if self.fault_inject is not None:
+            self.fault_inject.reset(np.zeros(2, dtype=np.float32))
+            self.init_vectors(self.fault_inject)
+        self._metric_base = (0, 0)
+        self._last_inject = (False, False)
+
+    # ------------------------------------------------------------------
+    # host control plane: arm/disarm the injection leaf per step
+    # ------------------------------------------------------------------
+    def host_run(self) -> None:
+        inj = self.fault_inject
+        if inj is None or not inj:
+            return
+        loader = getattr(self.workflow, "loader", None)
+        on_train = (loader is None
+                    or loader.minibatch_class == TRAIN)
+        want = ((bool(_faults.fire("train.nonfinite_loss")),
+                 bool(_faults.fire("train.nonfinite_grad")))
+                if on_train else (False, False))
+        if want == self._last_inject:
+            return  # leaf value unchanged: no host write, no upload
+        self._last_inject = want
+        inj.map_invalidate()
+        inj.mem[...] = [np.nan if want[0] else 0.0,
+                        np.nan if want[1] else 0.0]
+        if self.device is not None and not self.device.is_host_only:
+            inj.unmap()
+
+    # ------------------------------------------------------------------
+    # the per-step commit (inside the region on XLA; eager on numpy)
+    # ------------------------------------------------------------------
+    def xla_run(self) -> None:
+        import jax.numpy as jnp
+        flags = self.step_flags.devmem
+        ok = flags[0] > 0.5
+        loss_ok = flags[1] > 0.5
+        st = self.anomaly_state.devmem
+        one = jnp.ones((), dtype=st.dtype)
+        zero = jnp.zeros((), dtype=st.dtype)
+        self.anomaly_state.devmem = jnp.stack([
+            jnp.where(ok, zero, st[0] + 1),
+            st[1] + jnp.where(loss_ok, zero, one),
+            st[2] + jnp.where(loss_ok & ~ok, one, zero)])
+
+    def numpy_run(self) -> None:
+        flags = self.step_flags.mem
+        ok = bool(flags[0] > 0.5)
+        loss_ok = bool(flags[1] > 0.5)
+        st = self.anomaly_state.mem
+        st[0] = 0 if ok else st[0] + 1
+        if not loss_ok:
+            st[1] += 1
+        elif not ok:
+            st[2] += 1
+
+    # ------------------------------------------------------------------
+    # host-side readers (Decision unit / rollback)
+    # ------------------------------------------------------------------
+    def read_state(self) -> tuple[int, int, int]:
+        """(streak, loss_total, grad_total) — one tiny d2h read."""
+        self.anomaly_state.map_read()
+        s = self.anomaly_state.mem
+        return int(s[0]), int(s[1]), int(s[2])
+
+    def reset_streak(self) -> None:
+        """Zero the consecutive-anomaly streak (post-rollback), keeping
+        the monotone totals the metric deltas ride on."""
+        self.anomaly_state.map_write()
+        self.anomaly_state.mem[0] = 0

@@ -20,6 +20,8 @@ See :mod:`znicz_tpu.serving.engine` for the design notes.
 
 from znicz_tpu.serving.batcher import (  # noqa: F401
     ContinuousBatcher,
+    DeadlineExceeded,
+    Overloaded,
     QueueFull,
 )
 from znicz_tpu.serving.buckets import (  # noqa: F401

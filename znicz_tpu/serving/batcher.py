@@ -20,10 +20,31 @@ Policy (all knobs on the constructor):
 - shutdown drains: everything admitted before :meth:`shutdown` is
   served before the scheduler exits.
 
+Round-11 resilience (graceful degradation under in-flight faults):
+
+- **deadlines** — ``submit(x, deadline_ms=…)``; a request whose
+  deadline passes while queued fails fast with
+  :class:`DeadlineExceeded` and is **evicted before dispatch** — a
+  timed-out caller's rows never occupy a bucket;
+- **retry budget** — a dispatch that raises re-queues its requests at
+  the queue front up to ``retry_budget`` times each (0 = the
+  fail-the-batch seed behavior) before failing their futures; a
+  request served after a retry counts a
+  ``znicz_recoveries_total{kind=serving_retry}``;
+- **circuit breaker** — closed → open when the recent-dispatch
+  failure rate crosses ``breaker_failure_rate`` (over a
+  ``breaker_window`` outcome window, min ``breaker_min_samples``) or
+  the oldest pending request exceeds ``max_queue_age_ms``; while open,
+  :meth:`submit` sheds load with a fast :class:`Overloaded` (a
+  ``QueueFull`` subclass, so existing backpressure handling still
+  catches it); after ``breaker_cooldown_ms`` the breaker goes
+  half-open and the next dispatch outcome decides (success → closed,
+  failure → open again).  Every transition is a registry counter and
+  the live state a gauge (``/metrics``, ``/readyz``).
+
 The batcher knows nothing about models or devices — it hands each
 coalesced batch (a list of :class:`Request`) to the ``run_batch``
-callable and that callable resolves the futures.  Exceptions from
-``run_batch`` fail that batch's futures and the scheduler keeps going.
+callable and that callable resolves the futures.
 """
 
 from __future__ import annotations
@@ -35,6 +56,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from znicz_tpu.observe import metrics as _metrics
 from znicz_tpu.observe import tracing as _tracing
 from znicz_tpu.utils.logger import Logger
 
@@ -44,16 +66,39 @@ class QueueFull(RuntimeError):
     request queue has no room — the caller's backpressure signal."""
 
 
+class Overloaded(QueueFull):
+    """Load shed: the circuit breaker is open (recent dispatches
+    failing, or the queue has grown stale) — the caller gets this
+    reply in microseconds instead of a future that times out."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's ``deadline_ms`` passed while it was queued; it
+    was evicted before ever reaching a program."""
+
+
+#: breaker states, also the gauge encoding on /metrics
+_CLOSED, _HALF_OPEN, _OPEN = "closed", "half_open", "open"
+_STATE_CODE = {_CLOSED: 0, _HALF_OPEN: 1, _OPEN: 2}
+
+
 class Request:
     """One submitted batch of rows riding the queue."""
 
-    __slots__ = ("x", "n", "future", "t_submit")
+    __slots__ = ("x", "n", "future", "t_submit", "deadline", "attempts")
 
-    def __init__(self, x: np.ndarray) -> None:
+    def __init__(self, x: np.ndarray,
+                 deadline_ms: float | None = None) -> None:
         self.x = x
         self.n = int(x.shape[0])
         self.future: Future = Future()
         self.t_submit = time.monotonic()
+        self.deadline = (None if deadline_ms is None
+                         else self.t_submit + float(deadline_ms) / 1e3)
+        self.attempts = 0
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
 
 
 class ContinuousBatcher(Logger):
@@ -61,7 +106,14 @@ class ContinuousBatcher(Logger):
 
     def __init__(self, run_batch, *, max_batch: int,
                  max_delay_ms: float = 5.0, max_queue: int = 1024,
-                 name: str = "serving", queue_gauge=None) -> None:
+                 name: str = "serving", queue_gauge=None,
+                 retry_budget: int = 0,
+                 breaker_failure_rate: float = 0.5,
+                 breaker_window: int = 8,
+                 breaker_min_samples: int = 4,
+                 breaker_cooldown_ms: float = 1000.0,
+                 max_queue_age_ms: float | None = 10_000.0,
+                 obs_id: str | None = None) -> None:
         super().__init__()
         if max_queue < max_batch:
             raise ValueError(
@@ -71,14 +123,37 @@ class ContinuousBatcher(Logger):
         self.max_batch = int(max_batch)
         self.max_delay = float(max_delay_ms) / 1000.0
         self.max_queue = int(max_queue)
+        self.retry_budget = max(0, int(retry_budget))
+        self.breaker_failure_rate = float(breaker_failure_rate)
+        self.breaker_min_samples = int(breaker_min_samples)
+        self.breaker_cooldown = float(breaker_cooldown_ms) / 1e3
+        self.max_queue_age = (None if max_queue_age_ms is None
+                              else float(max_queue_age_ms) / 1e3)
         #: optional observe.metrics Gauge tracking pending rows live
         #: (the engine passes its per-engine-labeled child)
         self._queue_gauge = queue_gauge
+        #: per-engine label for the breaker/deadline registry series
+        #: (None = bare batcher: counters tracked locally only)
+        self._obs_id = obs_id
+        self._m_state = (_metrics.serving_breaker_state(obs_id)
+                         if obs_id else None)
+        if self._m_state is not None:
+            self._m_state.set(_STATE_CODE[_CLOSED])
+            _metrics.serving_queue_age_seconds(obs_id).set_function(
+                self.oldest_age_s)
         self._pending: deque[Request] = deque()
         self._rows = 0
         self._cond = threading.Condition()
         self._stop = False
         self._flush_now = False
+        # breaker state (all under _cond)
+        self._state = _CLOSED
+        self._opened_at = 0.0
+        self._outcomes: deque[bool] = deque(maxlen=int(breaker_window))
+        # plain counters (stats views; registry series ride obs_id)
+        self.expired_total = 0
+        self.shed_total = 0
+        self.retries_total = 0
         self._thread = threading.Thread(
             target=self._loop, name=f"{name}-batcher", daemon=True)
         self._thread.start()
@@ -89,19 +164,103 @@ class ContinuousBatcher(Logger):
         """Rows currently pending (telemetry; racy by nature)."""
         return self._rows
 
-    def submit(self, x: np.ndarray) -> Future:
+    @property
+    def breaker_state(self) -> str:
+        return self._state
+
+    def oldest_age_s(self) -> float:
+        """Age of the oldest pending request (0 when idle)."""
+        pending = self._pending
+        if not pending:
+            return 0.0
+        try:
+            return max(0.0, time.monotonic() - pending[0].t_submit)
+        except IndexError:  # drained between the check and the peek
+            return 0.0
+
+    # ------------------------------------------------------------------
+    # circuit breaker (call under _cond)
+    # ------------------------------------------------------------------
+    def _transition(self, state: str) -> None:
+        if state == self._state:
+            return
+        self.warning("circuit breaker %s → %s", self._state, state)
+        self._state = state
+        if state == _OPEN:
+            self._opened_at = time.monotonic()
+        if self._m_state is not None:
+            self._m_state.set(_STATE_CODE[state])
+        if self._obs_id:
+            _metrics.serving_breaker_transitions(self._obs_id,
+                                                 state).inc()
+
+    def _trip(self, why: str) -> None:
+        if self._state != _OPEN:
+            self.warning("circuit breaker tripped: %s", why)
+            self._transition(_OPEN)
+            self._outcomes.clear()
+            # a stale queue is a stall: force the pending prefix out
+            # rather than letting it age further behind the window
+            self._flush_now = True
+            self._cond.notify_all()
+
+    def _breaker_tick(self, now: float) -> None:
+        """Open → half-open after the cooldown; age-trip when the
+        oldest pending request exceeds the stall threshold."""
+        if self._state == _OPEN \
+                and now - self._opened_at >= self.breaker_cooldown:
+            self._transition(_HALF_OPEN)
+        if (self._state == _CLOSED and self.max_queue_age is not None
+                and self._pending
+                and now - self._pending[0].t_submit > self.max_queue_age):
+            self._trip(f"oldest request pending "
+                       f"{now - self._pending[0].t_submit:.1f}s "
+                       f"(> {self.max_queue_age:.1f}s)")
+
+    def _record_outcome(self, ok: bool) -> None:
+        with self._cond:
+            if self._state == _HALF_OPEN:
+                # the probe decides: healthy again, or back to shedding
+                self._transition(_CLOSED if ok else _OPEN)
+                self._outcomes.clear()
+                return
+            self._outcomes.append(ok)
+            n = len(self._outcomes)
+            if n >= self.breaker_min_samples:
+                failure_rate = self._outcomes.count(False) / n
+                if failure_rate >= self.breaker_failure_rate:
+                    self._trip(f"failure rate {failure_rate:.0%} over "
+                               f"last {n} dispatches")
+
+    # ------------------------------------------------------------------
+    def submit(self, x: np.ndarray,
+               deadline_ms: float | None = None) -> Future:
         """Enqueue a request; returns the future of its output rows.
 
-        Raises :class:`QueueFull` when the bounded queue has no room
-        for ``x``'s rows, and ``RuntimeError`` after shutdown."""
-        req = Request(x)
+        Raises :class:`QueueFull` when the bounded queue has no room,
+        :class:`Overloaded` while the breaker sheds load,
+        :class:`DeadlineExceeded` for a non-positive deadline, and
+        ``RuntimeError`` after shutdown."""
+        req = Request(x, deadline_ms=deadline_ms)
         if req.n < 1 or req.n > self.max_batch:
             raise ValueError(
                 f"request of {req.n} rows outside 1..{self.max_batch} "
                 f"(max_batch) — split it client-side")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise DeadlineExceeded(
+                f"deadline_ms={deadline_ms} already expired at submit")
         with self._cond:
             if self._stop:
                 raise RuntimeError("batcher is shut down")
+            self._breaker_tick(time.monotonic())
+            if self._state == _OPEN:
+                self.shed_total += 1
+                if self._obs_id:
+                    _metrics.serving_requests(self._obs_id,
+                                              "shed").inc()
+                raise Overloaded(
+                    "circuit breaker open — load shed (retry after "
+                    f"{self.breaker_cooldown * 1e3:.0f}ms)")
             if self._rows + req.n > self.max_queue:
                 raise QueueFull(
                     f"serving queue full ({self._rows} rows pending, "
@@ -128,6 +287,43 @@ class ContinuousBatcher(Logger):
         self._thread.join(timeout=timeout)
 
     # ------------------------------------------------------------------
+    def _evict_expired(self, now: float) -> None:
+        """Fail-fast every pending request whose deadline passed —
+        they are removed BEFORE coalescing, so a timed-out request
+        never occupies bucket rows.  Call under ``_cond``."""
+        if not any(r.deadline is not None for r in self._pending):
+            return
+        keep: deque[Request] = deque()
+        for req in self._pending:
+            if req.expired(now):
+                self._rows -= req.n
+                self.expired_total += 1
+                if self._obs_id:
+                    _metrics.serving_requests(self._obs_id,
+                                              "expired").inc()
+                req.future.set_exception(DeadlineExceeded(
+                    f"deadline passed after "
+                    f"{(now - req.t_submit) * 1e3:.0f}ms in queue"))
+            else:
+                keep.append(req)
+        if len(keep) != len(self._pending):
+            self._pending = keep
+            if self._queue_gauge is not None:
+                self._queue_gauge.set(self._rows)
+
+    def _wait_timeout(self, now: float) -> float:
+        """How long the admission wait may sleep: bounded by the
+        window remainder, the nearest pending deadline, and a 250 ms
+        housekeeping tick (age-trip + eviction responsiveness)."""
+        remain = self._pending[0].t_submit + self.max_delay - now
+        deadlines = [r.deadline for r in self._pending
+                     if r.deadline is not None]
+        if deadlines:
+            remain = min(remain, max(0.0, min(deadlines) - now))
+        if self.max_queue_age is not None:
+            remain = min(remain, 0.25)
+        return remain
+
     def _loop(self) -> None:
         while True:
             with self._cond:
@@ -137,14 +333,21 @@ class ContinuousBatcher(Logger):
                     return
                 # admission window: sleep until the batch fills, the
                 # oldest request's delay budget runs out, or someone
-                # forces a flush
-                while (self._rows < self.max_batch and not self._stop
-                       and not self._flush_now):
-                    remain = (self._pending[0].t_submit + self.max_delay
-                              - time.monotonic())
+                # forces a flush; expired requests are swept out and
+                # the breaker's stall detector runs on each tick
+                while not self._stop and not self._flush_now:
+                    now = time.monotonic()
+                    self._evict_expired(now)
+                    self._breaker_tick(now)
+                    if not self._pending:
+                        break
+                    if self._rows >= self.max_batch:
+                        break
+                    remain = self._wait_timeout(now)
                     if remain <= 0:
                         break
                     self._cond.wait(timeout=remain)
+                self._evict_expired(time.monotonic())
                 batch: list[Request] = []
                 rows = 0
                 while (self._pending
@@ -157,16 +360,48 @@ class ContinuousBatcher(Logger):
                     self._queue_gauge.set(self._rows)
                 self._flush_now = False
                 self._cond.notify_all()
-            if not batch:  # pragma: no cover - spurious wakeup guard
+            if not batch:  # everything expired / spurious wakeup
                 continue
             try:
                 with _tracing.TRACER.span("serve_batch", cat="serving",
                                           requests=len(batch),
                                           rows=rows):
                     self._run_batch(batch)
-            except Exception as exc:  # noqa: BLE001 - fail THIS batch only
-                self.warning("batch of %d requests failed: %s",
-                             len(batch), exc)
-                for req in batch:
-                    if not req.future.done():
-                        req.future.set_exception(exc)
+            except Exception as exc:  # noqa: BLE001 - isolate the batch
+                self._record_outcome(False)
+                self._dispatch_failed(batch, exc)
+            else:
+                self._record_outcome(True)
+                retried = sum(1 for r in batch if r.attempts)
+                if retried:
+                    _metrics.recoveries("serving_retry").inc(retried)
+
+    def _dispatch_failed(self, batch: list[Request], exc) -> None:
+        """Retry-budget accounting: requests with budget left re-enter
+        the queue FRONT (order preserved); the rest fail.  During
+        shutdown nothing retries — the drain must terminate."""
+        retry: list[Request] = []
+        now = time.monotonic()
+        with self._cond:
+            for req in batch:
+                if (not self._stop and req.attempts < self.retry_budget
+                        and not req.expired(now)):
+                    req.attempts += 1
+                    retry.append(req)
+            if retry:
+                self.retries_total += len(retry)
+                if self._obs_id:
+                    _metrics.serving_requests(
+                        self._obs_id, "retried").inc(len(retry))
+                self._pending.extendleft(reversed(retry))
+                self._rows += sum(r.n for r in retry)
+                if self._queue_gauge is not None:
+                    self._queue_gauge.set(self._rows)
+                self._cond.notify_all()
+        failed = [r for r in batch if r not in retry]
+        if failed:
+            self.warning("batch of %d requests failed: %s",
+                         len(failed), exc)
+        for req in failed:
+            if not req.future.done():
+                req.future.set_exception(exc)

@@ -49,11 +49,15 @@ from concurrent.futures import Future
 import numpy as np
 
 from znicz_tpu.observe import metrics as _metrics
-from znicz_tpu.serving.batcher import ContinuousBatcher, QueueFull
+from znicz_tpu.resilience import faults as _faults
+from znicz_tpu.serving.batcher import (ContinuousBatcher,
+                                       DeadlineExceeded, Overloaded,
+                                       QueueFull)
 from znicz_tpu.serving.buckets import bucket_for, ladder
 from znicz_tpu.utils.logger import Logger
 
-__all__ = ["ServingEngine", "QueueFull"]
+__all__ = ["ServingEngine", "QueueFull", "Overloaded",
+           "DeadlineExceeded"]
 
 #: distinguishes same-named engines in the registry's labels
 _ENGINE_SEQ = itertools.count()
@@ -91,7 +95,12 @@ class ServingEngine(Logger):
     def __init__(self, model, *, max_batch: int = 64,
                  max_delay_ms: float = 5.0, max_queue: int | None = None,
                  replicate: bool | None = None,
-                 device=None) -> None:
+                 device=None,
+                 retry_budget: int = 1,
+                 breaker_failure_rate: float = 0.5,
+                 breaker_window: int = 8,
+                 breaker_cooldown_ms: float = 1000.0,
+                 max_queue_age_ms: float | None = 10_000.0) -> None:
         super().__init__()
         from znicz_tpu.export import ExportedModel  # deferred: cycle
         if max_batch < 1:
@@ -100,6 +109,14 @@ class ServingEngine(Logger):
         self.max_delay_ms = float(max_delay_ms)
         self.max_queue = int(max_queue if max_queue is not None
                              else max(4 * max_batch, 1024))
+        # round-11 degradation knobs (see serving.batcher): a failed
+        # dispatch retries once by default; sustained failure or a
+        # stale queue opens the breaker and sheds load
+        self.retry_budget = int(retry_budget)
+        self.breaker_failure_rate = float(breaker_failure_rate)
+        self.breaker_window = int(breaker_window)
+        self.breaker_cooldown_ms = float(breaker_cooldown_ms)
+        self.max_queue_age_ms = max_queue_age_ms
         if isinstance(model, (str, bytes)) or hasattr(model, "__fspath__"):
             if device is None:
                 device = self.resolve_device(replicate)
@@ -187,7 +204,13 @@ class ServingEngine(Logger):
             self._run_batch, max_batch=self.max_batch,
             max_delay_ms=self.max_delay_ms, max_queue=self.max_queue,
             name=self.model.manifest.get("workflow", "model"),
-            queue_gauge=self._m_queue)
+            queue_gauge=self._m_queue,
+            retry_budget=self.retry_budget,
+            breaker_failure_rate=self.breaker_failure_rate,
+            breaker_window=self.breaker_window,
+            breaker_cooldown_ms=self.breaker_cooldown_ms,
+            max_queue_age_ms=self.max_queue_age_ms,
+            obs_id=self._obs_id)
         self._started = True
         self.info(
             "serving '%s': %d AOT programs warmed in %.2fs "
@@ -214,10 +237,15 @@ class ServingEngine(Logger):
     # ------------------------------------------------------------------
     # request path
     # ------------------------------------------------------------------
-    def submit(self, x: np.ndarray) -> Future:
+    def submit(self, x: np.ndarray,
+               deadline_ms: float | None = None) -> Future:
         """Enqueue a request (``x``: batch of samples, 1..max_batch
         rows); returns a future of the output rows.  Raises
-        :class:`QueueFull` under backpressure."""
+        :class:`QueueFull` under backpressure and :class:`Overloaded`
+        while the breaker sheds load.  With ``deadline_ms`` the future
+        fails fast with :class:`DeadlineExceeded` if the request is
+        still queued when the deadline passes — its rows are evicted
+        before dispatch and never reach a program."""
         if self._batcher is None:
             raise RuntimeError("engine not started — call start()")
         x = np.ascontiguousarray(x, dtype=self.model.serve_dtype)
@@ -226,17 +254,18 @@ class ServingEngine(Logger):
                 f"input sample shape {x.shape[1:]} != exported "
                 f"{self.model.input_shape}")
         try:
-            future = self._batcher.submit(x)
-        except QueueFull:
+            future = self._batcher.submit(x, deadline_ms=deadline_ms)
+        except QueueFull:  # includes Overloaded load shedding
             self._m_rejected.inc()
             raise
         self._m_submitted.inc()
         return future
 
-    def __call__(self, x: np.ndarray, timeout: float | None = None
-                 ) -> np.ndarray:
+    def __call__(self, x: np.ndarray, timeout: float | None = None,
+                 deadline_ms: float | None = None) -> np.ndarray:
         """Synchronous convenience: submit + wait."""
-        return self.submit(x).result(timeout=timeout)
+        return self.submit(x, deadline_ms=deadline_ms).result(
+            timeout=timeout)
 
     def flush(self) -> None:
         """Dispatch pending requests without waiting out the admission
@@ -249,6 +278,12 @@ class ServingEngine(Logger):
         """Scheduler-thread dispatch: coalesce → pad → one AOT program
         → split replies.  Sole caller of the compiled programs, so the
         model's cache bookkeeping needs no locking."""
+        spike = _faults.fire("serving.latency_spike")
+        if spike is not None:  # chaos: a slow program / stalled device
+            time.sleep(float(spike.get("ms", 50.0)) / 1e3)
+        if _faults.fire("serving.program_error") is not None:
+            raise _faults.FaultInjected(
+                "injected serving program failure")
         total = sum(req.n for req in batch)
         size = bucket_for(total, self.model._align)
         staging = self._staging.get(size)
@@ -334,6 +369,16 @@ class ServingEngine(Logger):
                                if self._batcher else 0),
                 "buckets": buckets,
             }
+            b = self._batcher
+            out["resilience"] = {
+                "breaker": b.breaker_state if b else "closed",
+                "retry_budget": self.retry_budget,
+                "retried": b.retries_total if b else 0,
+                "expired": b.expired_total if b else 0,
+                "shed": b.shed_total if b else 0,
+                "queue_age_ms": round(1e3 * b.oldest_age_s(), 1)
+                if b else 0.0,
+            }
         if lat:
             out["latency_ms"] = {
                 "p50": round(1e3 * _percentile(lat, 50), 3),
@@ -343,6 +388,12 @@ class ServingEngine(Logger):
                 "window": len(lat),
             }
         return out
+
+    def ready(self) -> bool:
+        """/readyz signal: started and not shedding load."""
+        b = self._batcher
+        return bool(self._started and b is not None
+                    and b.breaker_state != "open")
 
     def serving_status(self) -> dict:
         """``web_status.gather_status`` hook: the dashboard entry for

@@ -24,19 +24,55 @@ a single device — ``tests/test_zero1.py`` pins this.
 
 Trigger semantics preserved: fires when the Decision unit raises
 ``improved`` (best-on-validation naming via ``snapshot_suffix``).
+
+Round-11 resilience (this file is the rollback substrate the anomaly
+guard and crash auto-resume both stand on, so it must survive its own
+faults):
+
+- every write leaves a ``<file>.sha256`` sidecar; :meth:`load`
+  verifies it (and the gzip/pickle stream) and **falls back to the
+  previous good snapshot** on corruption instead of raising into the
+  resume path;
+- ``keep_last`` (default 5) retains a ladder of recent snapshots —
+  the fallback has somewhere to land and the directory stays bounded;
+- a failed write (disk full, injected ``snapshot.write_fail``) is
+  absorbed by default: the unit warns, counts
+  ``znicz_snapshot_failures_total{op=write}``, keeps ``destination``
+  pointing at the last GOOD snapshot and training continues
+  (``engine.snapshot_tolerate_failures = False`` restores
+  raise-on-failure).
 """
 
 from __future__ import annotations
 
+import glob
 import gzip
+import hashlib
+import logging
 import os
 import pickle
 import time
 
 from znicz_tpu.observe import metrics as _metrics
 from znicz_tpu.observe import tracing as _tracing
+from znicz_tpu.resilience import faults as _faults
 from znicz_tpu.units import Unit
 from znicz_tpu.utils.config import root
+
+
+class SnapshotCorrupt(RuntimeError):
+    """A snapshot failed digest verification (or would not unpickle)
+    and no fallback snapshot in its directory loads either."""
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        while True:
+            buf = fh.read(chunk)
+            if not buf:
+                return h.hexdigest()
+            h.update(buf)
 
 
 class Snapshotter(Unit):
@@ -51,11 +87,16 @@ class Snapshotter(Unit):
                  prefix: str = "snapshot",
                  directory: str | None = None,
                  interval: int = 1,
+                 keep_last: int = 5,
                  **kwargs) -> None:
         super().__init__(workflow, name=name, **kwargs)
         self.prefix = prefix
         self.directory = directory or str(root.common.dirs.snapshots)
         self.interval = max(1, int(interval))
+        #: snapshots retained on disk (0 = unbounded); pruned oldest-
+        #: first after each successful write, so the corruption
+        #: fallback always has a ladder of recent good files
+        self.keep_last = max(0, int(keep_last))
         self.decision = None  # linked by workflow builder
         self.destination: str | None = None  # last written file
         self._fire_count = 0
@@ -95,6 +136,8 @@ class Snapshotter(Unit):
         path = os.path.join(self.directory,
                             f"{self.prefix}_{suffix}.pickle.gz")
         multi = jax.process_count() > 1
+        tolerate = bool(root.common.engine.get(
+            "snapshot_tolerate_failures", True))
         write_exc: "Exception | None" = None
         if jax.process_index() == 0:
             try:
@@ -103,10 +146,11 @@ class Snapshotter(Unit):
                 assert written == path
                 self.info("snapshot → %s", path)
             except Exception as exc:
-                if not multi:
+                if not multi and not tolerate:
                     raise
-                # a lone raise here would strand the peers in the
-                # barrier below — gather the failure, raise together
+                # multi: a lone raise here would strand the peers in
+                # the barrier below — gather the failure, decide
+                # together
                 write_exc = exc
         if multi:
             import numpy as np
@@ -115,10 +159,13 @@ class Snapshotter(Unit):
             # doubles as the write barrier for the existence check
             if allgather_sum(
                     np.array([1.0 if write_exc else 0.0]))[0] > 0:
-                raise RuntimeError(
-                    "snapshot write failed on process 0; every "
-                    "process aborts together") from write_exc
-            if jax.process_index() != 0 and not os.path.exists(path):
+                if not tolerate:
+                    raise RuntimeError(
+                        "snapshot write failed on process 0; every "
+                        "process aborts together") from write_exc
+                write_exc = write_exc or RuntimeError(
+                    "snapshot write failed on process 0")
+            elif jax.process_index() != 0 and not os.path.exists(path):
                 self.warning(
                     "snapshot %s is not visible on process %d — the "
                     "snapshot directory is NOT a shared filesystem; "
@@ -126,14 +173,27 @@ class Snapshotter(Unit):
                     "`directory` (or root.common.dirs.snapshots) at "
                     "storage mounted on every host.", path,
                     jax.process_index())
+        if write_exc is not None:
+            # absorbed write failure: training continues; rollback and
+            # auto-resume keep pointing at the last GOOD snapshot
+            _metrics.snapshot_failures("write").inc()
+            _metrics.recoveries("snapshot_write").inc()
+            self.warning(
+                "snapshot write failed (%s) — continuing; last good "
+                "snapshot remains %s", write_exc, self.destination)
+            return
         self.destination = path
+        if jax.process_index() == 0 and self.keep_last:
+            self.prune(self.directory, self.prefix, self.keep_last,
+                       keep=path)
 
     @staticmethod
     def write(state: dict, directory: str, prefix: str,
               suffix: str) -> str:
         """Atomic ``<prefix>_<suffix>.pickle.gz`` state write — the one
         serialization point (the launcher's emergency snapshots and the
-        periodic unit both use it)."""
+        periodic unit both use it).  Leaves a ``.sha256`` sidecar whose
+        digest :meth:`load` verifies before trusting the file."""
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, f"{prefix}_{suffix}.pickle.gz")
         # per-process tmp: concurrent writers on a shared fs (defense
@@ -141,20 +201,112 @@ class Snapshotter(Unit):
         # each other's in-progress stream before the atomic replace
         tmp = f"{path}.{os.getpid()}.tmp"
         start = time.perf_counter()
-        with _tracing.TRACER.span("snapshot_save", cat="snapshot"):
-            with gzip.open(tmp, "wb") as f:
-                pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
-            os.replace(tmp, path)
+        try:
+            with _tracing.TRACER.span("snapshot_save", cat="snapshot"):
+                with gzip.open(tmp, "wb") as f:
+                    if _faults.fire("snapshot.write_fail") is not None:
+                        raise OSError(
+                            "injected snapshot write failure")
+                    pickle.dump(state, f,
+                                protocol=pickle.HIGHEST_PROTOCOL)
+                digest = _sha256_file(tmp)
+                os.replace(tmp, path)
+        except BaseException:
+            if os.path.exists(tmp):  # never leave half a stream behind
+                os.unlink(tmp)
+            raise
+        # sidecar AFTER the data replace: a crash between the two
+        # leaves a digestless (still loadable) file, never a digest
+        # pointing at missing data
+        side_tmp = f"{path}.sha256.{os.getpid()}.tmp"
+        with open(side_tmp, "w") as f:
+            f.write(digest + "\n")
+        os.replace(side_tmp, f"{path}.sha256")
         _metrics.snapshot_seconds("save").observe(
             time.perf_counter() - start)
         return path
 
     @staticmethod
+    def _load_verified(path: str) -> dict:
+        """One file: digest check (when a sidecar exists) + unpickle;
+        any integrity failure raises :class:`SnapshotCorrupt`."""
+        sidecar = f"{path}.sha256"
+        if os.path.exists(sidecar):
+            with open(sidecar) as f:
+                want = f.read().strip()
+            got = _sha256_file(path)
+            if got != want:
+                raise SnapshotCorrupt(
+                    f"{path}: sha256 {got[:12]}… != sidecar "
+                    f"{want[:12]}…")
+        try:
+            with gzip.open(path, "rb") as f:
+                return pickle.load(f)
+        except SnapshotCorrupt:
+            raise
+        except Exception as exc:  # truncated gzip, bad pickle, ...
+            raise SnapshotCorrupt(f"{path}: unreadable snapshot "
+                                  f"({exc})") from exc
+
+    @staticmethod
     def load(path: str) -> dict:
+        """Load a snapshot, verifying its sha256 sidecar.  On
+        corruption, fall back to the newest OTHER snapshot in the same
+        directory that verifies (counting
+        ``znicz_snapshot_failures_total{op=load}`` /
+        ``znicz_recoveries_total{kind=snapshot_fallback}``) so the
+        resume/rollback path lands on the previous good state instead
+        of dying on one bad file.  Raises :class:`SnapshotCorrupt`
+        when nothing in the directory loads."""
+        log = logging.getLogger("Snapshotter")
         start = time.perf_counter()
         with _tracing.TRACER.span("snapshot_load", cat="snapshot"):
-            with gzip.open(path, "rb") as f:
-                state = pickle.load(f)
+            try:
+                state = Snapshotter._load_verified(path)
+            except SnapshotCorrupt as exc:
+                _metrics.snapshot_failures("load").inc()
+                log.warning("%s — trying older snapshots", exc)
+                fallbacks = [
+                    p for p in glob.glob(os.path.join(
+                        os.path.dirname(path) or ".", "*.pickle.gz"))
+                    if os.path.abspath(p) != os.path.abspath(path)]
+                fallbacks.sort(key=os.path.getmtime, reverse=True)
+                for fb in fallbacks:
+                    try:
+                        state = Snapshotter._load_verified(fb)
+                    except SnapshotCorrupt as fb_exc:
+                        log.warning("%s", fb_exc)
+                        continue
+                    log.warning("recovered from older snapshot %s", fb)
+                    _metrics.recoveries("snapshot_fallback").inc()
+                    break
+                else:
+                    raise SnapshotCorrupt(
+                        f"{path} is corrupt and no fallback snapshot "
+                        f"in its directory verifies") from exc
         _metrics.snapshot_seconds("load").observe(
             time.perf_counter() - start)
         return state
+
+    @staticmethod
+    def prune(directory: str, prefix: str, keep_last: int,
+              keep: str | None = None) -> list[str]:
+        """Keep the ``keep_last`` newest ``<prefix>_*.pickle.gz``
+        snapshots (plus ``keep``, the one just written), delete the
+        rest with their sidecars; returns the deleted paths."""
+        files = glob.glob(os.path.join(directory,
+                                       f"{prefix}_*.pickle.gz"))
+        files.sort(key=os.path.getmtime, reverse=True)
+        deleted = []
+        for path in files[keep_last:]:
+            if keep and os.path.abspath(path) == os.path.abspath(keep):
+                continue
+            try:
+                os.unlink(path)
+                sidecar = f"{path}.sha256"
+                if os.path.exists(sidecar):
+                    os.unlink(sidecar)
+                deleted.append(path)
+            except OSError:  # concurrent pruner / already gone
+                pass
+        return deleted

@@ -13,7 +13,11 @@ exposition of the process-global :mod:`znicz_tpu.observe` registry
 (compile counts, per-unit run-time histograms, transfer bytes,
 serving latency — everything train + serve register), and
 ``/trace.json`` a live Chrome-trace/Perfetto dump of the host-span
-ring buffer (open it in ``ui.perfetto.dev``).
+ring buffer (open it in ``ui.perfetto.dev``), and — round 11 —
+``/healthz`` (liveness, always 200) + ``/readyz`` (readiness fed from
+the registry: circuit-breaker state, serving queue age, last-step
+staleness; 503 while any engine sheds load) so external supervisors
+can probe both the training and the serving engine.
 """
 
 from __future__ import annotations
@@ -83,6 +87,27 @@ class WebStatusServer(Logger):
                 if self.path.startswith("/status.json"):
                     body = json.dumps(status_server.status()).encode()
                     ctype = "application/json"
+                elif self.path.startswith("/healthz"):
+                    # liveness: the process answers — always 200
+                    body = json.dumps(status_server.health()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                elif self.path.startswith("/readyz"):
+                    # readiness: fed from the observe registry (breaker
+                    # state, queue age, last-step staleness) — 503
+                    # tells an external supervisor to stop routing here
+                    report = status_server.readiness()
+                    body = json.dumps(report).encode()
+                    self.send_response(200 if report["ready"] else 503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 elif self.path.startswith("/metrics"):
                     from znicz_tpu.observe import metrics
                     body = metrics.REGISTRY.to_prometheus().encode()
@@ -132,6 +157,72 @@ class WebStatusServer(Logger):
             "uptime_s": round(time.time() - self._started, 1),
             "workflows": [gather_status(wf) for wf in workflows],
         }
+
+    # -- supervisor probes (round 11) ----------------------------------
+    def health(self) -> dict:
+        """/healthz body: liveness only — the process is up and the
+        status thread answers."""
+        with self._lock:
+            n = len(self._workflows)
+        return {"status": "ok",
+                "uptime_s": round(time.time() - self._started, 1),
+                "workflows": n}
+
+    def readiness(self) -> dict:
+        """/readyz body, fed from the observe REGISTRY (so it reflects
+        exactly what ``/metrics`` exports, not object state):
+
+        - ``znicz_serving_breaker_state`` — any engine with an OPEN
+          breaker (2) makes the process not-ready (it is shedding);
+        - ``znicz_serving_queue_age_seconds`` — reported per engine;
+          not-ready when it exceeds ``engine.ready_max_queue_age_s``
+          (default unset = report-only);
+        - ``znicz_last_step_timestamp_seconds`` — per-workflow step
+          staleness; not-ready when older than
+          ``engine.ready_max_staleness_s`` (default unset =
+          report-only, so a finished training run does not flip a
+          serving process to 503).
+        """
+        from znicz_tpu.observe import metrics
+        from znicz_tpu.utils.config import root
+        now = time.time()
+        out: dict = {"ready": True, "reasons": [],
+                     "engines": {}, "workflows": {}}
+
+        def not_ready(reason: str) -> None:
+            out["ready"] = False
+            out["reasons"].append(reason)
+
+        fam = metrics.REGISTRY.get("znicz_serving_breaker_state")
+        if fam is not None:
+            for key, child in fam.items():
+                (engine,) = key
+                state = {0: "closed", 1: "half_open",
+                         2: "open"}.get(int(child.value), "?")
+                out["engines"].setdefault(engine, {})["breaker"] = state
+                if state == "open":
+                    not_ready(f"breaker open on engine {engine}")
+        fam = metrics.REGISTRY.get("znicz_serving_queue_age_seconds")
+        max_age = root.common.engine.get("ready_max_queue_age_s", None)
+        if fam is not None:
+            for key, child in fam.items():
+                (engine,) = key
+                age = round(float(child.value), 3)
+                out["engines"].setdefault(engine, {})["queue_age_s"] = age
+                if max_age is not None and age > float(max_age):
+                    not_ready(f"queue age {age:.1f}s on engine "
+                              f"{engine}")
+        fam = metrics.REGISTRY.get("znicz_last_step_timestamp_seconds")
+        max_stale = root.common.engine.get("ready_max_staleness_s", None)
+        if fam is not None:
+            for key, child in fam.items():
+                (workflow,) = key
+                stale = round(max(0.0, now - float(child.value)), 3)
+                out["workflows"][workflow] = {"last_step_age_s": stale}
+                if max_stale is not None and stale > float(max_stale):
+                    not_ready(f"workflow {workflow} last step "
+                              f"{stale:.0f}s ago")
+        return out
 
     # ------------------------------------------------------------------
     def render_html(self) -> str:
