@@ -1,7 +1,26 @@
-"""Serving A/B: seed per-exact-size path vs the bucketed AOT engine.
+"""Serving A/B: seed per-exact-size path vs the bucketed AOT engine,
+plus (round 12) the autoregressive **decode** replay.
 
-Replays ragged open-loop traffic (Poisson arrivals, mixed request
-sizes) against the same exported forward chain twice:
+Decode mode (``--decode`` / ``SERVE_MODE=decode``, or part of the
+default ``main()``) trains a tiny attention LM, exports it, and
+replays open-loop Poisson *prompt* traffic (ragged prompt lengths,
+ragged per-prompt token budgets) through
+:class:`znicz_tpu.serving.DecodeEngine` twice:
+
+- **continuous arm** — prompts admitted into the in-flight decode
+  batch between token steps (iteration-level scheduling);
+- **run-to-completion arm** — ``admission="static"``: a batch decodes
+  to full completion before the next prompts are admitted (the
+  classic request-level baseline).
+
+Greedy decoding makes the arms token-identical (asserted), so the A/B
+isolates pure *scheduling* effect on tokens/s, time-to-first-token and
+per-token latency.  Chip arm queued like prior rounds — no chip in
+this container; CPU rows measure scheduling + compile amortization,
+not MXU decode speed.
+
+Score mode replays ragged open-loop traffic (Poisson arrivals, mixed
+request sizes) against the same exported forward chain twice:
 
 - **seed arm** — the pre-round-8 ``ExportedModel`` behavior
   (``bucketing=False``): a synchronous, single-request server whose
@@ -24,10 +43,11 @@ replay from compile amortization alone.  CPU-container caveat: chip
 p99 numbers are the queued measurement through the tunnel — re-run on
 a real slice for serving latency truth.
 
-Run: ``python benchmarks/serve_bench.py`` (env: SERVE_N=240
+Run: ``python benchmarks/serve_bench.py`` (both modes; env: SERVE_N=240
 SERVE_RATE=400 SERVE_MAX_BATCH=64 SERVE_DELAY_MS=5 SERVE_DEVICES=0
 SERVE_SEED_ARM=1 SERVE_EPOCHS=2; SERVE_DEVICES=N forces an N-way
-virtual mesh, SERVE_TPU=1 keeps the ambient platform).
+virtual mesh, SERVE_TPU=1 keeps the ambient platform; decode knobs:
+DEC_N=48 DEC_RATE=6 DEC_SLOTS=4 DEC_MAX_T=64).
 """
 
 from __future__ import annotations
@@ -116,6 +136,176 @@ def train_and_export(path: str, dim: int = 16, n_classes: int = 5,
     wf.run()
     wf.export_forward(path)
     return path
+
+
+def train_and_export_lm(path: str, vocab: int = 12, dim: int = 16,
+                        seq_len: int = 8, n_heads: int = 2,
+                        epochs: int = 4, seed: int = 31) -> str:
+    """A tiny attention LM (embedding → pos_encoding → causal
+    attention → last_token → softmax head) trained on a synthetic
+    next-token task (``x_{t+1} = (x_t + 1) mod V``) — seconds on CPU,
+    enough chain to exercise every decode-cache path."""
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils import prng
+
+    rng = np.random.default_rng(seed)
+    n = 256
+    start = rng.integers(0, vocab, size=n)
+    data = ((start[:, None] + np.arange(seq_len)[None, :])
+            % vocab).astype(np.float32)
+    labels = ((start + seq_len) % vocab).astype(np.int32)
+    prng.seed_all(seed)
+    wf = StandardWorkflow(
+        name="serve_bench_lm",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:192], train_labels=labels[:192],
+            valid_data=data[192:], valid_labels=labels[192:],
+            minibatch_size=32),
+        layers=[
+            {"type": "embedding",
+             "->": {"vocab_size": vocab, "dim": dim},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+            {"type": "pos_encoding", "->": {}},
+            {"type": "attention",
+             "->": {"n_heads": n_heads, "causal": True},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "last_token", "->": {}},
+            {"type": "softmax",
+             "->": {"output_sample_shape": vocab},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+        ],
+        decision_config={"max_epochs": epochs})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    wf.export_forward(path)
+    return path
+
+
+def make_prompt_trace(n: int, rate: float, max_prompt: int,
+                      vocab: int, seed: int = 29):
+    """Open-loop decode traffic: Poisson arrivals, ragged prompt
+    lengths (1..max_prompt, biased short like interactive traffic)
+    and ragged token budgets (4..48 — the spread is the point: under
+    run-to-completion batching a 48-token straggler idles every other
+    slot in its batch)."""
+    rng = np.random.default_rng(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
+    lens = np.minimum(max_prompt,
+                      1 + rng.geometric(2.0 / max_prompt, size=n))
+    budgets = rng.integers(4, 49, size=n)
+    prompts = [rng.integers(0, vocab, size=int(ln)).astype(np.int32)
+               for ln in lens]
+    return list(zip(arrivals.tolist(), prompts,
+                    [int(b) for b in budgets]))
+
+
+def replay_decode(engine, trace) -> tuple:
+    """Open-loop prompt replay through a DecodeEngine arm."""
+    from znicz_tpu.serving import QueueFull
+
+    futures = []
+    rejects = 0
+    t0 = time.monotonic()
+    for arrival, prompt, budget in trace:
+        now = time.monotonic()
+        t_arr = t0 + arrival
+        if now < t_arr:
+            time.sleep(t_arr - now)
+        while True:
+            try:
+                futures.append(engine.submit(
+                    prompt, max_new_tokens=budget))
+                break
+            except QueueFull:
+                rejects += 1
+                time.sleep(0.002)
+    outputs = [np.asarray(f.result(timeout=600)) for f in futures]
+    wall = time.monotonic() - (t0 + trace[0][0])
+    st = engine.stats()
+    row = {
+        "arm": f"decode-{st['admission']}",
+        "prompts": len(trace),
+        "tokens_generated": st["tokens_generated"],
+        "tokens_prompt": st["tokens_prompt"],
+        "tok_s": round(st["tokens_generated"] / wall, 1),
+        "prompts_per_s": round(len(trace) / wall, 2),
+        "ttft_ms": st["ttft_ms"],
+        "token_ms": st["token_ms"],
+        "programs_compiled": st["programs_compiled"],
+        "prompt_buckets": st["prompt_buckets"],
+        "batch_buckets": st["batch_buckets"],
+        "backpressure_retries": rejects,
+        "wall_s": round(wall, 3),
+    }
+    return row, outputs
+
+
+def run_decode(n_prompts: int | None = None, rate: float | None = None,
+               max_slots: int | None = None,
+               max_t: int | None = None,
+               bundle: str | None = None) -> dict:
+    """The decode A/B: continuous admission vs run-to-completion over
+    the same greedy replay (token-identical outputs asserted — the
+    arms differ ONLY in scheduling)."""
+    import jax
+
+    from znicz_tpu.serving import DecodeEngine
+
+    n_prompts = n_prompts or int(os.environ.get("DEC_N", "64"))
+    rate = rate or float(os.environ.get("DEC_RATE", "400"))
+    max_slots = max_slots or int(os.environ.get("DEC_SLOTS", "4"))
+    max_t = max_t or int(os.environ.get("DEC_MAX_T", "64"))
+    vocab, max_prompt = 12, 16
+    if bundle is None:
+        bundle = os.path.join("/tmp", f"serve_bench_lm_{os.getpid()}.npz")
+        train_and_export_lm(bundle, vocab=vocab)
+    report: dict = {
+        "mode": "decode",
+        "date": time.strftime("%Y-%m-%d"),
+        "platform": jax.devices()[0].platform,
+        "config": {"max_slots": max_slots, "max_t": max_t,
+                   "max_prompt": max_prompt,
+                   "decoding": "greedy (arms token-identical)"},
+    }
+    # two load points: "interactive" (arrival-bound — continuous
+    # admission wins TTFT: a new prompt rides the NEXT token step
+    # instead of waiting out the batch) and "saturated" (backlog,
+    # service-bound — continuous wins tokens/s: run-to-completion
+    # idles slots behind each batch's longest straggler)
+    loads = (("interactive", n_prompts, rate),
+             ("saturated", max(n_prompts, 96), rate * 10))
+    for load_name, n, r in loads:
+        trace = make_prompt_trace(n, r, max_prompt, vocab)
+        point: dict = {"n_prompts": n, "offered_rate_prompt_s": r}
+        outs = {}
+        for key, admission in (("run_to_completion", "static"),
+                               ("continuous", "continuous")):
+            engine = DecodeEngine(
+                bundle, max_slots=max_slots, max_t=max_t,
+                max_prompt=max_prompt, prompt_align=8,
+                admission=admission)
+            engine.start()
+            point[key], outs[key] = replay_decode(engine, trace)
+            engine.shutdown()
+        for a, b in zip(outs["continuous"], outs["run_to_completion"]):
+            np.testing.assert_array_equal(
+                a, b, err_msg="greedy arms diverged — scheduling "
+                              "changed the tokens, not just the "
+                              "timing")
+        cont, rtc = point["continuous"], point["run_to_completion"]
+        point["ab"] = {
+            "tok_s_ratio": round(cont["tok_s"] / rtc["tok_s"], 2),
+            "ttft_p50_ratio": round(
+                rtc["ttft_ms"]["p50"]
+                / max(cont["ttft_ms"]["p50"], 1e-9), 2),
+            "outputs_checked": "token-identical across arms (greedy)",
+        }
+        report[load_name] = point
+    report["chip_arm"] = "queued — no chip in this container"
+    return report
 
 
 def make_trace(n: int, rate: float, max_batch: int, dim: int,
@@ -294,8 +484,19 @@ def run(n_requests: int = N_REQUESTS, rate: float = RATE,
 
 def main() -> None:
     _ensure_platform()
-    report = run()
+    mode = os.environ.get("SERVE_MODE", "")
+    decode_only = "--decode" in sys.argv or mode == "decode"
+    score_only = mode == "score"
+    report = {} if decode_only else run()
+    if not score_only:
+        report["decode"] = run_decode()
     out = os.path.join(REPO, "SERVE_BENCH.json")
+    if decode_only and os.path.exists(out):
+        # merge: keep the score rows, refresh the decode rows
+        with open(out) as f:
+            merged = json.load(f)
+        merged["decode"] = report["decode"]
+        report = merged
     with open(out, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report, indent=2))

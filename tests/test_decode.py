@@ -1,0 +1,506 @@
+"""Autoregressive decode serving (round 12): KV-cache correctness,
+prefill/decode AOT split, continuous token batching.
+
+The ground truth everywhere is a step-by-step **full-forward numpy
+oracle**: at every generated position it re-runs the whole causal
+chain over the entire sequence so far (no cache, no incremental
+state) and takes the argmax.  The engine — incremental KV-cache
+attention, masked LSTM carries, bucketed prefill padding, scratch-slot
+padded decode lanes — must reproduce the oracle's token ids EXACTLY
+(integers, so equality is bitwise).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from znicz_tpu.export import ExportedModel
+from znicz_tpu.observe import metrics as obs_metrics
+from znicz_tpu.ops.pos_encoding import sinusoid_table
+from znicz_tpu.serving import (DecodeEngine, DecodeModel, Overloaded,
+                               QueueFull)
+from znicz_tpu.serving.batcher import DeadlineExceeded
+
+VOCAB = 12
+
+
+# ----------------------------------------------------------------------
+# trained bundles (one training run per module, not per test)
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def lm_bundle(tmp_path_factory):
+    """Tiny attention LM: embedding → pos_encoding → causal attention
+    → last_token → softmax."""
+    from benchmarks.serve_bench import train_and_export_lm
+    path = str(tmp_path_factory.mktemp("decode") / "lm.npz")
+    return train_and_export_lm(path, vocab=VOCAB, epochs=3)
+
+
+@pytest.fixture(scope="module")
+def rnn_bundle(tmp_path_factory):
+    """Tiny LSTM LM: embedding → lstm(return_sequence=False) →
+    softmax (the carry doubles as the sequence→sample bridge)."""
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils import prng
+
+    path = str(tmp_path_factory.mktemp("decode") / "rnn_lm.npz")
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, VOCAB, size=(128, 6)).astype(np.float32)
+    labels = (data[:, -1].astype(np.int32) + 1) % VOCAB
+    prng.seed_all(7)
+    wf = StandardWorkflow(
+        name="tiny_rnn_lm",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:96], train_labels=labels[:96],
+            valid_data=data[96:], valid_labels=labels[96:],
+            minibatch_size=32),
+        layers=[
+            {"type": "embedding",
+             "->": {"vocab_size": VOCAB, "dim": 12},
+             "<-": {"learning_rate": 0.1}},
+            {"type": "lstm", "->": {"units": 20},
+             "<-": {"learning_rate": 0.1}},
+            {"type": "softmax", "->": {"output_sample_shape": VOCAB},
+             "<-": {"learning_rate": 0.1}},
+        ],
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    wf.export_forward(path)
+    return path
+
+
+def _params(bundle):
+    import json
+    with np.load(bundle) as b:
+        manifest = json.loads(bytes(b["manifest"]).decode())
+        params = {k: np.array(b[k]) for k in b.files if k != "manifest"}
+    return manifest, params
+
+
+# ----------------------------------------------------------------------
+# numpy oracles: full forward over the whole sequence, every step
+# ----------------------------------------------------------------------
+def attn_oracle_logits(man, P, seq):
+    ids = np.asarray(seq, np.int32)
+    x = P["layer0_weights"][ids][None].astype(np.float32)
+    t, d = x.shape[1], x.shape[2]
+    x = x + sinusoid_table(t, d)
+    qkv = x.reshape(t, d) @ P["layer2_weights"] + P["layer2_bias"]
+    h = man["layers"][2]["config"]["n_heads"]
+    dh = d // h
+    qkv = qkv.reshape(1, t, 3 * d)
+    q = qkv[..., :d].reshape(1, t, h, dh)
+    k = qkv[..., d:2 * d].reshape(1, t, h, dh)
+    v = qkv[..., 2 * d:].reshape(1, t, h, dh)
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(dh)
+    mask = np.arange(t)[:, None] >= np.arange(t)[None, :]
+    s = np.where(mask[None, None], s, -1e30)
+    s = s - s.max(-1, keepdims=True)
+    p = np.exp(s)
+    p /= p.sum(-1, keepdims=True)
+    o = np.einsum("bhqk,bkhd->bqhd", p, v)
+    y = o.reshape(t, d) @ P["layer2_weights_out"] + P["layer2_bias_out"]
+    feat = y.reshape(t, d)[-1]
+    return feat @ P["layer4_weights"] + P["layer4_bias"]
+
+
+def lstm_oracle_logits(man, P, seq):
+    def sig(z):
+        return 1.0 / (1.0 + np.exp(-z))
+
+    w, b = P["layer1_weights"], P["layer1_bias"]
+    hsz = w.shape[1] // 4
+    h = np.zeros((1, hsz), np.float32)
+    c = np.zeros((1, hsz), np.float32)
+    for t in seq:
+        x = P["layer0_weights"][int(t)][None].astype(np.float32)
+        z = np.concatenate([x, h], 1) @ w + b
+        i, f = sig(z[:, :hsz]), sig(z[:, hsz:2 * hsz])
+        g, o = np.tanh(z[:, 2 * hsz:3 * hsz]), sig(z[:, 3 * hsz:])
+        c = f * c + i * g
+        h = o * np.tanh(c)
+    return (h @ P["layer2_weights"] + P["layer2_bias"])[0]
+
+
+def oracle_greedy(logits_fn, man, P, prompt, n):
+    seq = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        tok = int(np.argmax(logits_fn(man, P, seq)))
+        out.append(tok)
+        seq.append(tok)
+    return out
+
+
+# ----------------------------------------------------------------------
+# manifest metadata (satellite: export round-trip)
+# ----------------------------------------------------------------------
+def test_manifest_records_kind_and_sequence(lm_bundle):
+    man, _ = _params(lm_bundle)
+    assert man["kind"] == "lm"
+    seq = man["sequence"]
+    assert seq["vocab"] == VOCAB and seq["train_t"] == 8
+    assert seq["cache"] == [{"layer": 2, "kind": "attention",
+                             "heads": 2, "head_dim": 8,
+                             "features": 16}]
+    model = ExportedModel.load(lm_bundle)
+    assert model.kind == "lm" and model.sequence == seq
+
+
+def test_manifest_scorer_kind(tmp_path):
+    from benchmarks.serve_bench import train_and_export
+    path = str(tmp_path / "fc.npz")
+    train_and_export(path, epochs=1)
+    model = ExportedModel.load(path)
+    assert model.kind == "scorer" and model.sequence is None
+    with pytest.raises(ValueError, match="'scorer'"):
+        DecodeModel(model)
+
+
+def test_legacy_bundle_rederives_metadata(lm_bundle):
+    """A pre-round-12 bundle (no kind/sequence keys) must decode
+    unchanged — the metadata re-derives from the layer table (the
+    round-8 dtype-default pattern)."""
+    man, params = _params(lm_bundle)
+    legacy = {k: v for k, v in man.items()
+              if k not in ("kind", "sequence")}
+    model = ExportedModel(legacy, params)
+    assert model.kind == "lm"
+    assert model.sequence["vocab"] == VOCAB
+    assert model.sequence["cache"][0]["kind"] == "attention"
+    with DecodeEngine(model, max_slots=2, max_t=32, max_prompt=8,
+                      prompt_align=4, max_new_tokens=4) as eng:
+        got = list(eng.generate(np.array([1, 2, 3]), timeout=120))
+    want = oracle_greedy(attn_oracle_logits, man, params, [1, 2, 3], 4)
+    assert got == want
+
+
+def test_lstm_sequence_metadata(rnn_bundle):
+    model = ExportedModel.load(rnn_bundle)
+    assert model.kind == "lm"
+    assert model.sequence["cache"] == [
+        {"layer": 1, "kind": "lstm", "hidden": 20}]
+
+
+# ----------------------------------------------------------------------
+# greedy decode ≡ numpy oracle, bitwise on token ids
+# ----------------------------------------------------------------------
+def test_greedy_attention_engine_vs_oracle(lm_bundle):
+    man, P = _params(lm_bundle)
+    with DecodeEngine(lm_bundle, max_slots=4, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=8) as eng:
+        for plen in (1, 3, 5, 11):
+            prompt = (np.arange(plen) * 3) % VOCAB
+            got = list(eng.generate(prompt, timeout=120))
+            want = oracle_greedy(attn_oracle_logits, man, P, prompt, 8)
+            assert got == want, f"prompt len {plen}"
+
+
+def test_greedy_lstm_engine_vs_oracle(rnn_bundle):
+    man, P = _params(rnn_bundle)
+    with DecodeEngine(rnn_bundle, max_slots=2, max_t=32, max_prompt=8,
+                      prompt_align=4, max_new_tokens=6) as eng:
+        for plen in (1, 4, 7):
+            prompt = (np.arange(plen) * 2 + 1) % VOCAB
+            got = list(eng.generate(prompt, timeout=120))
+            want = oracle_greedy(lstm_oracle_logits, man, P, prompt, 6)
+            assert got == want, f"prompt len {plen}"
+
+
+def test_continuous_admission_matches_sequential_oracle(lm_bundle):
+    """More prompts than slots, submitted at once: admission happens
+    MID-decode of earlier sequences, lanes sit at ragged depths, and
+    every result must still equal the one-at-a-time oracle."""
+    man, P = _params(lm_bundle)
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(0, VOCAB, size=int(n)).astype(np.int32)
+               for n in rng.integers(1, 13, size=10)]
+    budgets = [int(b) for b in rng.integers(3, 12, size=10)]
+    with DecodeEngine(lm_bundle, max_slots=3, max_t=32, max_prompt=16,
+                      prompt_align=4) as eng:
+        futs = [eng.submit(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        results = [list(f.result(timeout=240)) for f in futs]
+    for i, (p, b, got) in enumerate(zip(prompts, budgets, results)):
+        want = oracle_greedy(attn_oracle_logits, man, P, p, b)
+        assert got == want, f"prompt {i} diverged under admission"
+
+
+def test_static_admission_same_tokens(lm_bundle):
+    """Run-to-completion scheduling (the serve_bench A/B arm) changes
+    timing, never tokens."""
+    man, P = _params(lm_bundle)
+    prompts = [np.array([2, 5]), np.array([7]), np.array([1, 2, 3, 4]),
+               np.array([9, 0, 4])]
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=8,
+                      prompt_align=4, max_new_tokens=6,
+                      admission="static") as eng:
+        futs = [eng.submit(p) for p in prompts]
+        for p, f in zip(prompts, futs):
+            got = list(f.result(timeout=240))
+            assert got == oracle_greedy(attn_oracle_logits, man, P,
+                                        p, 6)
+
+
+# ----------------------------------------------------------------------
+# cache-slot lifecycle
+# ----------------------------------------------------------------------
+def test_slot_reuse_after_eviction_is_clean(lm_bundle):
+    """A slot's stale rows from a LONG previous tenant must be
+    unreachable for the next (shorter) one: prefill overwrites the
+    live prefix and the decode mask hides everything past ``pos``."""
+    man, P = _params(lm_bundle)
+    long_p = (np.arange(14) * 5) % VOCAB
+    short_p = np.array([4, 1])
+    with DecodeEngine(lm_bundle, max_slots=1, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=10) as eng:
+        first = list(eng.generate(long_p, timeout=240))
+        second = list(eng.generate(short_p, timeout=240))
+    assert first == oracle_greedy(attn_oracle_logits, man, P,
+                                  long_p, 10)
+    assert second == oracle_greedy(attn_oracle_logits, man, P,
+                                   short_p, 10), \
+        "slot reuse leaked the previous tenant's cache rows"
+
+
+def test_eos_evicts_slot(lm_bundle):
+    man, P = _params(lm_bundle)
+    prompt = np.array([3, 4, 5])
+    full = oracle_greedy(attn_oracle_logits, man, P, prompt, 8)
+    # an eos value whose FIRST occurrence is mid-stream, so the stop
+    # point is unambiguous
+    idx = next((i for i in range(1, len(full))
+                if full[i] not in full[:i]), 0)
+    eos = full[idx]
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=8,
+                      prompt_align=4, max_new_tokens=8,
+                      eos_token=eos) as eng:
+        got = list(eng.generate(prompt, timeout=240))
+        assert got == full[:idx + 1]
+        assert eng.model.cache.free_slots == 2  # evicted
+
+
+def test_max_t_page_boundary_force_finishes(lm_bundle):
+    """A sequence hitting the bucketed max-T page is force-finished
+    (truncated), never writes past the page."""
+    with DecodeEngine(lm_bundle, max_slots=1, max_t=16, max_prompt=8,
+                      prompt_align=4, max_new_tokens=1000) as eng:
+        prompt = np.array([1, 2, 3, 4, 5])
+        got = eng.generate(prompt, timeout=240)
+    # positions prompt..max_t-1 hold generated inputs; the final
+    # sampled token is never written back, so budget = max_t - len + 1
+    assert len(got) == 16 - 5 + 1
+
+
+def test_sampled_continuations_seeded(lm_bundle):
+    """temperature > 0: same seed → same continuation, tokens in
+    vocab; different seed → (almost surely) different continuation."""
+    prompt = np.array([6, 7])
+
+    def gen(seed):
+        with DecodeEngine(lm_bundle, max_slots=1, max_t=32,
+                          max_prompt=8, prompt_align=4,
+                          max_new_tokens=12, temperature=1.0,
+                          seed=seed) as eng:
+            return list(eng.generate(prompt, timeout=240))
+
+    a, b, c = gen(5), gen(5), gen(6)
+    assert a == b
+    assert all(0 <= t < VOCAB for t in a)
+    assert a != c  # 12 draws over 12 tokens: collision ~impossible
+
+
+# ----------------------------------------------------------------------
+# retrace guard: ZERO compiles per warmed decode token
+# ----------------------------------------------------------------------
+def test_warmed_decode_loop_zero_compiles(lm_bundle):
+    """The acceptance-bar pin: after warmup (both program families
+    compiled), an arbitrary ragged generation mix adds ZERO entries to
+    ``znicz_xla_compiles_total`` — no compile per token, per prompt
+    length, per live-batch size."""
+    prefill_c = obs_metrics.xla_compiles("serving-prefill")
+    decode_c = obs_metrics.xla_compiles("serving-decode")
+    with DecodeEngine(lm_bundle, max_slots=4, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=9) as eng:
+        assert eng.warmup_compiles == len(eng.model.prompt_ladder()) \
+            + len(eng.model.batch_ladder())
+        before = prefill_c.value + decode_c.value
+        rng = np.random.default_rng(4)
+        futs = [eng.submit(rng.integers(0, VOCAB, size=int(n)))
+                for n in rng.integers(1, 16, size=9)]
+        tokens = sum(len(f.result(timeout=240)) for f in futs)
+        assert tokens >= 9 * 9
+        assert prefill_c.value + decode_c.value == before, \
+            "a warmed decode loop compiled a new XLA program"
+        assert eng.stats()["programs_compiled"] == eng.warmup_compiles
+
+
+# ----------------------------------------------------------------------
+# resilience: TTFT deadline + breaker drain semantics
+# ----------------------------------------------------------------------
+def test_ttft_deadline_evicts_queued_prompt(lm_bundle):
+    """deadline_ms bounds TIME-TO-FIRST-TOKEN: a prompt still queued
+    when it passes fails fast and never occupies a slot; prompts
+    without deadlines are untouched."""
+    gate = threading.Event()
+    with DecodeEngine(lm_bundle, max_slots=1, max_t=32, max_prompt=8,
+                      prompt_align=4, max_new_tokens=4) as eng:
+        real_prefill = eng.model.run_prefill
+
+        def slow_prefill(tokens, slot):
+            gate.wait(timeout=30)
+            return real_prefill(tokens, slot)
+
+        eng.model.run_prefill = slow_prefill
+        blocker = eng.submit(np.array([1]))      # holds the scheduler
+        doomed = eng.submit(np.array([2]), deadline_ms=30.0)
+        survivor = eng.submit(np.array([3]))
+        time.sleep(0.15)                         # deadline passes
+        gate.set()
+        with pytest.raises(DeadlineExceeded):
+            doomed.result(timeout=60)
+        assert blocker.result(timeout=120).shape == (4,)
+        assert survivor.result(timeout=120).shape == (4,)
+        assert eng.stats()["resilience"]["expired"] == 1
+
+
+def test_breaker_sheds_new_prompts_while_inflight_drains(lm_bundle):
+    """The drain contract: an OPEN breaker rejects new prompts with
+    Overloaded, but sequences already generating run to completion."""
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=128, max_prompt=8,
+                      prompt_align=4, max_new_tokens=600,
+                      breaker_cooldown_ms=60_000.0) as eng:
+        inflight = eng.submit(np.array([5, 6]))  # ~125-token runway
+        time.sleep(0.01)                          # let it go live
+        for _ in range(eng._outcomes.maxlen):     # force the trip
+            eng._record_outcome(False)
+        assert eng.breaker_state == "open"
+        with pytest.raises(Overloaded):
+            eng.submit(np.array([1]))
+        assert eng.stats()["resilience"]["shed"] == 1
+        out = inflight.result(timeout=300)        # drained, not killed
+        assert len(out) > 0
+        assert not eng.ready()
+
+
+def test_breaker_opens_on_consecutive_prefill_failures(lm_bundle):
+    """Organic trip: consecutive failed dispatches (injected prefill
+    errors) open the breaker; the cooldown half-opens it and a
+    healthy probe closes it again."""
+    with DecodeEngine(lm_bundle, max_slots=1, max_t=32, max_prompt=8,
+                      prompt_align=4, max_new_tokens=3,
+                      retry_budget=0, breaker_window=4,
+                      breaker_min_samples=4,
+                      breaker_cooldown_ms=50.0) as eng:
+        real_prefill = eng.model.run_prefill
+        boom = {"on": True}
+
+        def flaky_prefill(tokens, slot):
+            if boom["on"]:
+                raise RuntimeError("injected prefill failure")
+            return real_prefill(tokens, slot)
+
+        eng.model.run_prefill = flaky_prefill
+        futs = [eng.submit(np.array([i + 1])) for i in range(4)]
+        for f in futs:
+            with pytest.raises(RuntimeError):
+                f.result(timeout=60)
+        deadline = time.monotonic() + 10
+        while eng.breaker_state != "open" \
+                and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng.breaker_state == "open"
+        boom["on"] = False
+        time.sleep(0.08)                 # cooldown → half-open probe
+        deadline = time.monotonic() + 10
+        tokens = None
+        while time.monotonic() < deadline:
+            try:
+                tokens = eng.generate(np.array([3]), timeout=60)
+                break
+            except (Overloaded, QueueFull):
+                time.sleep(0.02)
+        assert tokens is not None and len(tokens) == 3
+        assert eng.breaker_state == "closed"
+
+
+def test_prefill_failure_isolated_to_its_prompt(lm_bundle):
+    """One poisoned prompt fails alone — neighbors are served."""
+    man, P = _params(lm_bundle)
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=8,
+                      prompt_align=4, max_new_tokens=4,
+                      retry_budget=0) as eng:
+        real_prefill = eng.model.run_prefill
+
+        def poison_prefill(tokens, slot):
+            if tokens[0] == 9:
+                raise RuntimeError("poisoned prompt")
+            return real_prefill(tokens, slot)
+
+        eng.model.run_prefill = poison_prefill
+        good1 = eng.submit(np.array([1, 2]))
+        bad = eng.submit(np.array([9, 9]))
+        good2 = eng.submit(np.array([3]))
+        with pytest.raises(RuntimeError):
+            bad.result(timeout=60)
+        assert list(good1.result(timeout=120)) == oracle_greedy(
+            attn_oracle_logits, man, P, [1, 2], 4)
+        assert list(good2.result(timeout=120)) == oracle_greedy(
+            attn_oracle_logits, man, P, [3], 4)
+        assert eng.model.cache.free_slots == 2  # poisoned slot freed
+
+
+# ----------------------------------------------------------------------
+# API edges
+# ----------------------------------------------------------------------
+def test_submit_validation(lm_bundle):
+    with DecodeEngine(lm_bundle, max_slots=1, max_t=32, max_prompt=8,
+                      prompt_align=4) as eng:
+        with pytest.raises(ValueError, match="empty"):
+            eng.submit(np.array([], np.int32))
+        with pytest.raises(ValueError, match="max_prompt"):
+            eng.submit(np.arange(9))
+        with pytest.raises(DeadlineExceeded):
+            eng.submit(np.array([1]), deadline_ms=-1)
+    with pytest.raises(RuntimeError, match="not started|shut down"):
+        eng.submit(np.array([1]))
+
+
+def test_queue_backpressure(lm_bundle):
+    gate = threading.Event()
+    with DecodeEngine(lm_bundle, max_slots=1, max_t=32, max_prompt=8,
+                      prompt_align=4, max_new_tokens=2,
+                      max_queue=1) as eng:
+        real_prefill = eng.model.run_prefill
+
+        def gated_prefill(tokens, slot):
+            gate.wait(timeout=30)
+            return real_prefill(tokens, slot)
+
+        eng.model.run_prefill = gated_prefill
+        first = eng.submit(np.array([1]))      # popped by scheduler
+        time.sleep(0.05)
+        second = eng.submit(np.array([2]))     # fills the queue
+        with pytest.raises(QueueFull):
+            eng.submit(np.array([3]))
+        gate.set()
+        assert first.result(timeout=120) is not None
+        assert second.result(timeout=120) is not None
+        assert eng.stats()["rejected"] == 1
+
+
+def test_geometry_validation(lm_bundle):
+    with pytest.raises(ValueError, match="max_prompt"):
+        DecodeModel(ExportedModel.load(lm_bundle), max_t=16,
+                    max_prompt=16)
+    with pytest.raises(ValueError, match="ladder top"):
+        DecodeModel(ExportedModel.load(lm_bundle), max_t=32,
+                    max_prompt=30, prompt_align=12)

@@ -51,6 +51,48 @@ FORMAT_VERSION = 1
 DEFAULT_MAX_BATCH = 1024
 
 
+def _sequence_meta(layers: list[dict],
+                   input_shape: tuple) -> dict | None:
+    """Decode metadata for an autoregressive LM chain, derived from
+    the layer specs: the sequence axis (``train_t``), the vocabulary,
+    and one cache-shape entry per stateful layer (attention K/V pages,
+    LSTM carries).  Returns ``None`` for chains the decode path cannot
+    drive — not token-first (no leading ``embedding``), stateless
+    (nothing to cache), or non-causal attention (a bidirectional layer
+    has no valid incremental step).
+
+    This is ALSO the legacy-bundle fallback: bundles exported before
+    round 12 carry no ``kind``/``sequence`` keys, so
+    :class:`ExportedModel` re-derives both from the layer table it
+    always had (mirroring the round-8 dtype-default pattern)."""
+    if not layers or layers[0]["type"] != "embedding":
+        return None
+    cfg0 = layers[0].get("config", {})
+    vocab = int(cfg0["vocab_size"])
+    dim = int(cfg0["dim"])
+    d = dim
+    cache: list[dict] = []
+    for i, spec in enumerate(layers):
+        kind, cfg = spec["type"], spec.get("config", {})
+        if kind == "attention":
+            if not cfg.get("causal"):
+                return None  # bidirectional: no incremental step
+            heads = int(cfg["n_heads"])
+            cache.append({"layer": i, "kind": "attention",
+                          "heads": heads, "head_dim": d // heads,
+                          "features": d})
+        elif kind == "lstm":
+            hidden = cfg.get("units",
+                             cfg.get("output_sample_shape"))
+            cache.append({"layer": i, "kind": "lstm",
+                          "hidden": int(hidden)})
+            d = int(hidden)
+    if not cache:
+        return None
+    return {"train_t": int(input_shape[0]), "vocab": vocab,
+            "dim": dim, "cache": cache}
+
+
 def _manifest_for(workflow) -> dict:
     """Collect layer specs + geometry from a trained
     StandardWorkflow."""
@@ -76,17 +118,27 @@ def _manifest_for(workflow) -> dict:
     else:
         from znicz_tpu.utils.config import root
         dtype = np.dtype(root.common.get("precision_type", "float32"))
-    return {
+    input_shape = tuple(workflow.loader.minibatch_data.shape[1:])
+    manifest = {
         "format": FORMAT_NAME,
         "version": FORMAT_VERSION,
         "workflow": workflow.name,
         "loss": workflow.loss,
-        "input_shape": list(workflow.loader.minibatch_data.shape[1:]),
+        "input_shape": list(input_shape),
         # the precision mode the net TRAINED under — serving must run
         # the same mode, not silently upcast bf16 nets to f32
         "dtype": str(dtype),
         "layers": layers,
     }
+    # round 12: model kind + sequence/cache metadata so the serving
+    # layer can construct decode state (KV pages, LSTM carries,
+    # prompt-length ladder) from the bundle alone; scorer bundles
+    # carry the kind so the engine refuses generate() loudly
+    seq = _sequence_meta(layers, input_shape)
+    manifest["kind"] = "lm" if seq is not None else "scorer"
+    if seq is not None:
+        manifest["sequence"] = seq
+    return manifest
 
 
 def export_forward(workflow, path: str) -> str:
@@ -170,6 +222,28 @@ class ExportedModel(Logger):
         return cls(manifest, params, device=device, **kwargs)
 
     # ------------------------------------------------------------------
+    @property
+    def kind(self) -> str:
+        """``"lm"`` (token-first causal chain the decode engine can
+        drive) or ``"scorer"`` (one-shot forward).  Legacy bundles
+        (pre-round-12, no ``kind`` key) re-derive it from the layer
+        table — the round-8 dtype-default pattern."""
+        kind = self.manifest.get("kind")
+        if kind is None:
+            kind = "lm" if self.sequence is not None else "scorer"
+        return kind
+
+    @property
+    def sequence(self) -> dict | None:
+        """Decode metadata (``train_t``, ``vocab``, per-layer cache
+        shapes) for LM bundles; ``None`` for scorers.  Derived on the
+        fly for legacy bundles."""
+        seq = self.manifest.get("sequence")
+        if seq is None and "kind" not in self.manifest:
+            seq = _sequence_meta(self.manifest["layers"],
+                                 self.input_shape)
+        return seq
+
     @property
     def serve_dtype(self) -> np.dtype:
         """Input/compute dtype requests are cast to: the manifest

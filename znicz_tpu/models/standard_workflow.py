@@ -94,6 +94,7 @@ for _name, _cls in {
     "lstm": lstm.LSTM,
     "attention": attention.MultiHeadAttention,
     "to_sequence": seq_reshape.ToSequence,
+    "last_token": seq_reshape.LastToken,
     "pos_encoding": pos_encoding.PositionalEncoding,
     "layer_norm": layer_norm.LayerNorm,
     "embedding": embedding.Embedding,

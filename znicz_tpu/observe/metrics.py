@@ -511,6 +511,47 @@ def serving_bucket_rows(engine: str, bucket: int) -> Counter:
                                             bucket=bucket)
 
 
+def serving_ttft_seconds(engine: str) -> Histogram:
+    """Time-to-first-token: prompt submit → first generated token
+    available (queue wait + prefill + first sample).  The interactive
+    half of decode latency — kept as its OWN canonical series beside
+    :func:`serving_token_seconds` because the two move independently
+    (admission policy moves TTFT, cache locality moves per-token)."""
+    return REGISTRY.histogram(
+        "znicz_serving_ttft_seconds",
+        "Decode time-to-first-token (submit -> first token)",
+        labels=("engine",)).labels(engine=engine)
+
+
+def serving_token_seconds(engine: str) -> Histogram:
+    """Per-token decode latency: one observation per generated token
+    after the first (the steady-state token cadence a streaming client
+    sees)."""
+    return REGISTRY.histogram(
+        "znicz_serving_token_seconds",
+        "Decode per-token latency (inter-token cadence after the "
+        "first token)", labels=("engine",)).labels(engine=engine)
+
+
+def serving_tokens(engine: str, kind: str) -> Counter:
+    """Token throughput counters: ``prompt`` (prefilled positions)
+    vs ``generated`` (sampled tokens) — tokens/s on a dashboard is
+    ``rate(generated)``."""
+    return REGISTRY.counter(
+        "znicz_serving_tokens_total",
+        "Decode tokens by kind (prompt=prefilled, generated=sampled)",
+        labels=("engine", "kind")).labels(engine=engine, kind=kind)
+
+
+def serving_decode_slots(engine: str) -> Gauge:
+    """Live decode slots (sequences mid-generation) — occupancy of
+    the preallocated KV-cache pages."""
+    return REGISTRY.gauge(
+        "znicz_serving_decode_slots",
+        "Sequences currently occupying KV-cache decode slots",
+        labels=("engine",)).labels(engine=engine)
+
+
 def serving_warmup_seconds(engine: str) -> Gauge:
     return REGISTRY.gauge(
         "znicz_serving_warmup_seconds",

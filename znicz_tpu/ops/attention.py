@@ -351,6 +351,84 @@ class MultiHeadAttention(Forward):
                 x, wq, bq, wo, bo), *args)
         self.output.devmem = out
 
+    # -- autoregressive decode (round 12, serving.decode) ---------------
+    # Pure functions of their arguments (weights ride in as leaves, no
+    # Vector state) so the decode engine can AOT-compile them exactly
+    # like export's forward programs.  Math is plain f32 einsum — the
+    # decode-side GEMMs are (B,1,·) slivers where the flash kernel's
+    # tiling has nothing to win, and f32 keeps the incremental path
+    # numerically aligned with the full-forward oracle.
+    def xla_prefill(self, x, w_qkv, b_qkv, w_out, b_out):
+        """Causal forward over a (possibly right-padded) prompt that
+        also returns the per-position K/V: (B, T, D) →
+        ``(y, k, v)`` with k/v shaped (B, T, H, Dh) for the cache.
+
+        Padded tail positions produce garbage k/v rows — harmless by
+        construction: causal masking keeps them out of every real
+        position's softmax here, and the decode step overwrites row
+        ``pos`` before its mask (``<= pos``) ever admits it.
+        """
+        b, t, d = x.shape
+        qkv = x.astype(jnp.float32).reshape(b * t, d) @ w_qkv
+        if b_qkv is not None:
+            qkv = qkv + b_qkv
+        q, k, v = _split_heads(qkv.reshape(b, t, 3 * d), self.n_heads)
+        dh = d // self.n_heads
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+            jnp.float32(dh))
+        if self.causal:
+            mask = (jnp.arange(t)[:, None] >= jnp.arange(t)[None, :])
+            s = jnp.where(mask[None, None], s, -1e30)
+        s = s - s.max(axis=-1, keepdims=True)
+        p = jnp.exp(s)
+        p = p / p.sum(axis=-1, keepdims=True)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        y = o.reshape(b * t, d) @ w_out
+        if b_out is not None:
+            y = y + b_out
+        return y.reshape(b, t, d), k, v
+
+    def xla_decode_step(self, x, k_cache, v_cache, pos,
+                        w_qkv, b_qkv, w_out, b_out):
+        """One incremental token: write this position's K/V into the
+        cache, attend the new query over the cached prefix.
+
+        ``x``: (B, 1, D) current-token features; ``k_cache``/
+        ``v_cache``: (B, Tmax, H, Dh) per-sequence cache pages;
+        ``pos``: (B,) int32 position index of THIS token per sequence
+        (ragged — sequences in one decode batch sit at different
+        depths).  Returns ``(y, k_cache, v_cache)`` with the caches
+        functionally updated at ``pos`` — under input donation the
+        update is in-place in HBM, so a warmed decode loop allocates
+        nothing per token and compiles nothing (shapes pinned by the
+        live-batch bucket).
+        """
+        b, one, d = x.shape
+        t_max = k_cache.shape[1]
+        qkv = x.astype(jnp.float32).reshape(b, d) @ w_qkv
+        if b_qkv is not None:
+            qkv = qkv + b_qkv
+        q, k, v = _split_heads(qkv.reshape(b, 1, 3 * d), self.n_heads)
+        dh = d // self.n_heads
+        rows = jnp.arange(b)
+        k_cache = k_cache.at[rows, pos].set(k[:, 0])
+        v_cache = v_cache.at[rows, pos].set(v[:, 0])
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) / jnp.sqrt(
+            jnp.float32(dh))
+        # length mask: the prefix [0, pos] is live, everything beyond
+        # is stale garbage from a prior tenant of the slot or the
+        # prefill's padded tail — never admitted
+        mask = jnp.arange(t_max)[None, :] <= pos[:, None]
+        s = jnp.where(mask[:, None, None, :], s, -1e30)
+        s = s - s.max(axis=-1, keepdims=True)
+        p = jnp.exp(s)
+        p = p / p.sum(axis=-1, keepdims=True)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
+        y = o.reshape(b, d) @ w_out
+        if b_out is not None:
+            y = y + b_out
+        return y.reshape(b, 1, d), k_cache, v_cache
+
     # -- numpy oracle ---------------------------------------------------
     def _forward_np(self, x):
         b, t, d = x.shape

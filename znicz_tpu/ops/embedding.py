@@ -79,6 +79,15 @@ class Embedding(Forward):
         self.output.devmem = jnp.take(self.weights.devmem, tokens,
                                       axis=0)
 
+    # -- autoregressive decode (round 12, serving.decode) ---------------
+    def xla_embed(self, w, x):
+        """Pure gather for the decode path: token ids (any float/int
+        array, any shape) → table rows of shape ``x.shape + (D,)``.
+        Same rounding/clipping contract as the training forward, so a
+        decode engine feeding raw sampled ids sees identical
+        embeddings."""
+        return jnp.take(w, self._tokens(jnp, x), axis=0)
+
 
 class GDEmbedding(GradientDescentBase):
     """Embedding backward: scatter-add of the error into the table

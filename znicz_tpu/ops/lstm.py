@@ -105,6 +105,46 @@ class LSTM(Forward):
         self.output.devmem = self.xla_forward(
             self.input.devmem, self.weights.devmem, b)
 
+    # -- autoregressive decode (round 12, serving.decode) ---------------
+    def xla_prefill(self, x, w, b, length=None):
+        """Scan the prompt and ALSO return the final recurrent state:
+        (B, T, F) → ``(y, h, c)`` with h/c shaped (B, H) — the decode
+        cache for a recurrent layer IS its carry.
+
+        ``length`` (optional (B,) int32): per-sequence true prompt
+        length for right-padded prompts — steps at ``t >= length``
+        hold the carry instead of folding padded garbage into it.
+        """
+        batch, steps, _ = x.shape
+        h0 = jnp.zeros((batch, self.units), jnp.float32)
+        c0 = jnp.zeros((batch, self.units), jnp.float32)
+
+        def step(carry, inp):
+            h_prev, c_prev = carry
+            t, x_t = inp
+            h, c, _ = self._step(jnp, x_t, h_prev, c_prev, w, b)
+            if length is not None:
+                live = (t < length)[:, None]
+                h = jnp.where(live, h, h_prev)
+                c = jnp.where(live, c, c_prev)
+            return (h, c), h
+
+        (h_last, c_last), hs = jax.lax.scan(
+            step, (h0, c0),
+            (jnp.arange(steps), jnp.swapaxes(x, 0, 1)))
+        y = jnp.swapaxes(hs, 0, 1) if self.return_sequence else h_last
+        return y, h_last, c_last
+
+    def xla_decode_step(self, x, h, c, w, b):
+        """One incremental token: (B, F) input + (B, H) carry →
+        ``(y, h, c)`` — the recurrent analogue of attention's cached
+        step (state read/written in place of a position-indexed
+        page)."""
+        if x.ndim == 3:
+            x = x.reshape(x.shape[0], -1)
+        h, c, _ = self._step(jnp, x.astype(jnp.float32), h, c, w, b)
+        return h, h, c
+
     # -- numpy oracle: explicit loop ------------------------------------
     def numpy_run(self) -> None:
         self.input.map_read()

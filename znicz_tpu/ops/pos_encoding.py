@@ -65,6 +65,21 @@ class PositionalEncoding(Forward):
             self.input.devmem.astype(jnp.float32)
             + jnp.asarray(self._table))
 
+    # -- autoregressive decode (round 12, serving.decode) ---------------
+    def table_to(self, t: int, d: int) -> np.ndarray:
+        """The scaled (t, D) table up to an arbitrary horizon —
+        positions are GLOBAL indices, so a decode engine extends the
+        training-time table to its ``max_t`` without retraining
+        anything (the table is parameter-free)."""
+        return self.scale * sinusoid_table(t, d)
+
+    def xla_decode_step(self, x, pos, table):
+        """Position-offset add for one incremental token: (B, 1, D)
+        features + (B,) int32 positions + a baked (Tmax, D) table →
+        ``x + PE[pos]`` per sequence (ragged positions — each decode
+        lane sits at its own depth)."""
+        return x.astype(jnp.float32) + table[pos][:, None, :]
+
 
 class GDPositionalEncoding(WeightlessGradientUnit):
     """Backward of an additive constant: identity pass-through."""

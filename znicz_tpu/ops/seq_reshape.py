@@ -1,14 +1,21 @@
-"""ToSequence: flatten spatial positions into a token axis.
+"""Sequence-axis reshape units.
 
-(B, H, W, C) → (B, H·W, C) — the ViT-style bridge from the conv
-feature map to the sequence stack (attention / layer_norm consume
-(batch, time, features)).  The 2015 reference predates attention
-(SURVEY.md §5.7); this unit exists so conv front-ends and the
+``ToSequence``: (B, H, W, C) → (B, H·W, C) — the ViT-style bridge from
+the conv feature map to the sequence stack (attention / layer_norm
+consume (batch, time, features)).  The 2015 reference predates
+attention (SURVEY.md §5.7); this unit exists so conv front-ends and the
 long-context op family compose in one workflow — e.g. the multichip
 dryrun trains conv → attention in a single GSPMD program.
 
-Backward is the exact reshape adjoint (a reshape), so the pair is
-weightless and loss-free in both directions.
+``LastToken``: (B, T, D) → (B, D), the final position's features — the
+bridge from a causal sequence stack to a position-independent LM head
+(a ``softmax`` layer over the vocabulary).  Training a next-token
+model through this unit is what makes the head's weights T-independent
+and therefore reusable verbatim by the single-token decode path
+(``serving.decode``), where the "sequence" is one position long.
+
+Backwards are the exact adjoints (a reshape; a zero-pad scatter into
+the last position), so both pairs are weightless and loss-free.
 """
 
 from __future__ import annotations
@@ -46,6 +53,31 @@ class ToSequence(Forward):
             self.output.shape)
 
 
+class LastToken(Forward):
+    """Select the final time position: (B, T, D) → (B, D)."""
+
+    def initialize(self, device=None, **kwargs) -> None:
+        super().initialize(device=device, **kwargs)
+        if self.input is None or not self.input:
+            raise AttributeError(f"{self}: input not linked yet")
+        shape = self.input.shape
+        if len(shape) != 3:
+            raise ValueError(f"{self}: need (batch, time, features), "
+                             f"got {shape}")
+        b, _, d = shape
+        self.output.reset(np.zeros((b, d),
+                                   dtype=self.output_store_dtype))
+        self.init_vectors(self.input, self.output)
+
+    def numpy_run(self) -> None:
+        self.input.map_read()
+        self.output.map_invalidate()
+        self.output.mem[...] = self.input.mem[:, -1]
+
+    def xla_run(self) -> None:
+        self.output.devmem = self.input.devmem[:, -1]
+
+
 class GDToSequence(WeightlessGradientUnit):
     """Reshape the error back to the spatial shape."""
 
@@ -63,3 +95,26 @@ class GDToSequence(WeightlessGradientUnit):
         if self.need_err_input:
             self.err_input.devmem = self.err_output.devmem.reshape(
                 self.err_input.shape)
+
+
+class GDLastToken(WeightlessGradientUnit):
+    """Adjoint of the last-position select: scatter the error into
+    position T-1, zeros elsewhere."""
+
+    MATCHES = (LastToken,)
+
+    def numpy_run(self) -> None:
+        if not self.need_err_input:
+            return
+        self.err_output.map_read()
+        self.err_input.map_invalidate()
+        self.err_input.mem[...] = 0
+        self.err_input.mem[:, -1] = self.err_output.mem
+
+    def xla_run(self) -> None:
+        if not self.need_err_input:
+            return
+        import jax.numpy as jnp
+        err = jnp.zeros(self.err_input.shape, jnp.float32)
+        self.err_input.devmem = err.at[:, -1].set(
+            self.err_output.devmem.astype(jnp.float32))

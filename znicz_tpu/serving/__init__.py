@@ -8,14 +8,20 @@ into a power-of-two bucket ladder of AOT-compiled programs, optionally
 replicated across a data-axis mesh (GSPMD) — one compiled program,
 N-chip throughput, zero compiles at serve time.
 
-Entry point::
+Entry points::
 
     from znicz_tpu.serving import ServingEngine
     with ServingEngine("model.npz", max_batch=64) as engine:
         probs = engine(x)               # sync
         future = engine.submit(x)       # async → future
 
-See :mod:`znicz_tpu.serving.engine` for the design notes.
+    from znicz_tpu.serving import DecodeEngine      # round 12
+    with DecodeEngine("lm.npz", max_slots=4, max_t=64) as eng:
+        tokens = eng.generate(prompt)   # autoregressive generation
+
+See :mod:`znicz_tpu.serving.engine` (one-shot scoring) and
+:mod:`znicz_tpu.serving.decode` (KV-cache generation) for the design
+notes.
 """
 
 from znicz_tpu.serving.batcher import (  # noqa: F401
@@ -28,5 +34,10 @@ from znicz_tpu.serving.buckets import (  # noqa: F401
     bucket_for,
     ladder,
     next_pow2,
+)
+from znicz_tpu.serving.decode import (  # noqa: F401
+    DecodeEngine,
+    DecodeModel,
+    KVCache,
 )
 from znicz_tpu.serving.engine import ServingEngine  # noqa: F401

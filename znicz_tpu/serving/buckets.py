@@ -13,6 +13,13 @@ toward full buckets).
 ragged splits), so the ladder becomes ``align·1, align·2, align·4, …``
 — every bucket a legal data-axis split, ladder length
 ``log2(max_batch / align) + 1 ≤ log2(max_batch) + 1``.
+
+The same math quantizes every dynamic axis of the round-12 decode
+path (:mod:`znicz_tpu.serving.decode`): prompt lengths ride the
+ladder on the T axis (``align = prompt_align``) for the prefill
+program family, and live-batch sizes ride it for the single-token
+decode family — the reason a warmed generation loop needs no
+compiles at any prompt mix or batch occupancy.
 """
 
 from __future__ import annotations

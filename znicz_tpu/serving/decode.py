@@ -1,0 +1,1038 @@
+"""Autoregressive decode serving: KV-cache + prefill/decode AOT split
++ continuous token batching.
+
+The round-8 engine scores fixed-shape one-shot requests; this module
+is the *generation* half of the serving story (ROADMAP item 2 — the
+heaviest-traffic scenario a millions-of-users deployment runs).  It
+converts any exported causal LM bundle (``manifest["kind"] == "lm"``:
+token-first chain of embedding / pos_encoding / causal attention /
+LSTM, a position-independent head) into a continuous-batching token
+server, built from three pieces:
+
+1. **KV cache** (:class:`KVCache`) — per-replica device buffers
+   preallocated at :meth:`DecodeModel.warmup`: one (S+1, maxT, H, Dh)
+   K and V page array per attention layer and one (S+1, H) carry pair
+   per LSTM layer, where S is ``max_slots`` sequence slots (+1 scratch
+   row that absorbs padded decode lanes).  Pages are *functionally*
+   updated by the decode program and donated back, so on
+   donation-capable platforms a warmed decode loop mutates HBM in
+   place and allocates nothing per token.
+
+2. **Prefill / decode AOT split** (:class:`DecodeModel`) — two
+   separate program families, both real ``jit().lower().compile()``
+   AOT like the round-8 ladder:
+
+   - *prefill*, bucketed on **prompt length** via the same
+     ``serving/buckets.py`` ladder math applied to the T axis
+     (``prompt_align·2^k``): runs the full causal forward over the
+     padded prompt, writes every position's K/V (or the masked LSTM
+     carry) into the request's slot, and returns the last real
+     position's logits — the first token;
+   - *decode*, bucketed on **live-batch size**: one token for every
+     in-flight sequence per dispatch — embedding gather → positional
+     offset add → per-layer cached step
+     (``MultiHeadAttention.xla_decode_step`` /
+     ``LSTM.xla_decode_step``) → head logits — with ragged per-lane
+     position indices, so sequences at different depths share one
+     program.
+
+   Warmed, the token loop performs ZERO XLA compiles
+   (``znicz_xla_compiles_total{site=serving-prefill|serving-decode}``
+   stays flat — pinned by tests/test_retrace_guard.py).
+
+3. **Continuous token batching** (:class:`DecodeEngine`) — the Orca
+   iteration-level insight applied to generation: the scheduler
+   admits queued prompts into the *in-flight* decode batch between
+   token steps (``admission="continuous"``; ``"static"`` keeps the
+   run-to-completion behavior as the measured A/B arm in
+   serve_bench), and evicts slots the moment a sequence finishes
+   (EOS, token budget, or the bucketed max-T page boundary) so a
+   long straggler never holds the batch hostage.
+
+Telemetry splits decode latency into its two canonical halves —
+``znicz_serving_ttft_seconds`` (queue + prefill + first sample) and
+``znicz_serving_token_seconds`` (steady-state cadence) — because the
+two move independently: admission policy moves TTFT, cache residency
+moves per-token.  Resilience (round 11 carried forward):
+``deadline_ms`` applies to **TTFT** — a prompt still queued past its
+deadline is evicted before prefill and never occupies a slot — and
+the circuit breaker sheds *new prompts* with fast
+:class:`Overloaded` replies while in-flight decodes drain to
+completion.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.observe import tracing as _tracing
+from znicz_tpu.resilience import faults as _faults
+from znicz_tpu.serving.batcher import (_CLOSED, _HALF_OPEN, _OPEN,
+                                       _STATE_CODE, DeadlineExceeded,
+                                       Overloaded, QueueFull)
+from znicz_tpu.serving.buckets import bucket_for, ladder, next_pow2
+from znicz_tpu.utils.logger import Logger
+
+__all__ = ["DecodeModel", "DecodeEngine", "KVCache"]
+
+#: distinguishes same-named engines in the registry's labels
+_DECODE_SEQ = itertools.count()
+
+#: layer kinds the decode planner knows how to step incrementally
+_SEQ_KINDS = ("embedding", "pos_encoding", "attention", "lstm")
+_HEAD_KINDS = ("all2all", "all2all_tanh", "all2all_relu",
+               "all2all_str", "all2all_sigmoid", "softmax")
+
+
+class _Op:
+    """One planned chain step: the unit (config carrier), its weight
+    leaves, and — for stateful layers — its cache array indices."""
+
+    __slots__ = ("kind", "unit", "w", "aux", "table")
+
+    def __init__(self, kind, unit, w=(), aux=None, table=None):
+        self.kind = kind
+        self.unit = unit
+        self.w = tuple(w)      # device weight arrays, layer-specific
+        self.aux = aux or {}   # cache indices etc.
+        self.table = table     # pos_encoding: baked (maxT, D) table
+
+
+class KVCache:
+    """The preallocated decode state for one replica: the page/carry
+    arrays (functionally threaded through every program call) plus the
+    host-side slot free list.
+
+    Slot reuse needs no zeroing: prefill overwrites ``[0, t_bucket)``
+    of a reused slot, and every attention step masks positions
+    ``> pos``, so a prior tenant's rows beyond the new sequence's live
+    prefix are unreachable by construction (pinned by
+    tests/test_decode.py's eviction-reuse case).
+    """
+
+    def __init__(self, specs: list[tuple[str, tuple]], max_slots: int,
+                 dtype=np.float32) -> None:
+        import jax.numpy as jnp
+        self.max_slots = int(max_slots)
+        #: scratch row absorbing padded decode lanes (their scattered
+        #: writes must land somewhere that is never a live sequence)
+        self.trash_slot = self.max_slots
+        self.specs = list(specs)
+        self.arrays: tuple = tuple(
+            jnp.zeros((self.max_slots + 1,) + tuple(shape), dtype)
+            for _name, shape in specs)
+        self._free = list(range(self.max_slots))
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def acquire(self) -> int:
+        return self._free.pop()
+
+    def release(self, slot: int) -> None:
+        self._free.append(slot)
+
+    def nbytes(self) -> int:
+        return int(sum(a.size * a.dtype.itemsize for a in self.arrays))
+
+
+class DecodeModel(Logger):
+    """Prefill/decode program families + KV cache over an exported LM.
+
+    ``model`` is an :class:`~znicz_tpu.export.ExportedModel` (or a
+    bundle path); its manifest must describe a causal LM
+    (``kind == "lm"`` — legacy pre-round-12 bundles re-derive the
+    kind from their layer table, so any previously exported LM
+    decodes without re-export).
+
+    Geometry knobs:
+
+    - ``max_slots`` — concurrent sequences (KV pages preallocated);
+    - ``max_t`` — cache page length, rounded up to a power of two
+      (a sequence reaching it is force-finished);
+    - ``max_prompt`` / ``prompt_align`` — the prompt-length ladder:
+      prefill programs exist for ``prompt_align·2^k ≤ max_prompt``.
+    """
+
+    def __init__(self, model, *, max_slots: int = 4,
+                 max_t: int = 64, max_prompt: int | None = None,
+                 prompt_align: int = 8, device=None) -> None:
+        super().__init__()
+        from znicz_tpu.export import ExportedModel
+        if isinstance(model, (str, bytes)) or hasattr(model,
+                                                      "__fspath__"):
+            model = ExportedModel.load(model, device=device)
+        self.model = model
+        if model.kind != "lm":
+            raise ValueError(
+                f"bundle '{model.manifest.get('workflow', '?')}' is a "
+                f"'{model.kind}' — decode needs an LM (token-first "
+                f"causal chain); re-export a generation model or use "
+                f"ServingEngine for one-shot scoring")
+        self.seq_meta = dict(model.sequence)
+        self.vocab = int(self.seq_meta["vocab"])
+        self.dim = int(self.seq_meta["dim"])
+        self.max_slots = int(max_slots)
+        if self.max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.max_t = next_pow2(int(max_t))
+        self.prompt_align = int(prompt_align)
+        self.max_prompt = int(max_prompt if max_prompt is not None
+                              else min(self.max_t // 2,
+                                       bucket_for(
+                                           self.seq_meta["train_t"],
+                                           self.prompt_align)))
+        if self.max_prompt >= self.max_t:
+            raise ValueError(
+                f"max_prompt ({self.max_prompt}) must leave room to "
+                f"generate below max_t ({self.max_t})")
+        if bucket_for(self.max_prompt, self.prompt_align) > self.max_t:
+            raise ValueError(
+                f"prompt ladder top "
+                f"{bucket_for(self.max_prompt, self.prompt_align)} "
+                f"(max_prompt {self.max_prompt} rounded up to the "
+                f"prompt_align·2^k ladder) exceeds the max_t "
+                f"{self.max_t} cache page — raise max_t or lower "
+                f"max_prompt")
+        self.device = model.device
+        self._plan, cache_specs = self._build_plan()
+        self.cache = KVCache(cache_specs, self.max_slots)
+        self._prefill_programs: dict[int, "callable"] = {}
+        self._decode_programs: dict[int, "callable"] = {}
+        self.compile_count = 0
+        self.donating = model._donate_choice()
+
+    # ------------------------------------------------------------------
+    # chain planning
+    # ------------------------------------------------------------------
+    def _weight(self, i: int, attr: str):
+        import jax.numpy as jnp
+        key = f"layer{i}_{attr}"
+        arr = self.model._params.get(key)
+        return None if arr is None else jnp.asarray(arr, jnp.float32)
+
+    def _build_plan(self) -> tuple[list[_Op], list]:
+        """Walk the manifest layers into decode ops + cache specs.
+
+        Chain grammar: a *sequence* phase (embedding first, then
+        pos_encoding / causal attention / LSTM), a bridge to
+        position-independence (``last_token``, or a final
+        ``return_sequence=False`` LSTM), then a *head* phase of
+        per-sample FC layers ending in the vocabulary softmax."""
+        units = self.model.forwards
+        layers = self.model.manifest["layers"]
+        plan: list[_Op] = []
+        cache_specs: list[tuple[str, tuple]] = []
+        phase = "seq"
+        d = self.dim
+        if not layers or layers[0]["type"] != "embedding":
+            raise ValueError("decode chain must start with an "
+                             "embedding layer (token-first)")
+        for i, (spec, unit) in enumerate(zip(layers, units)):
+            kind = spec["type"]
+            if phase == "head" and kind not in _HEAD_KINDS:
+                raise ValueError(
+                    f"layer {i} ({kind}) after the sequence→sample "
+                    f"bridge — only head layers {_HEAD_KINDS} may "
+                    f"follow")
+            if kind == "embedding":
+                plan.append(_Op(kind, unit,
+                                (self._weight(i, "weights"),)))
+            elif kind == "pos_encoding":
+                import jax.numpy as jnp
+                table = jnp.asarray(
+                    unit.table_to(self.max_t, d), jnp.float32)
+                plan.append(_Op(kind, unit, table=table))
+            elif kind == "attention":
+                if not spec.get("config", {}).get("causal"):
+                    raise ValueError(
+                        f"layer {i}: attention must be causal=True to "
+                        f"decode (a bidirectional layer has no valid "
+                        f"incremental step)")
+                heads = unit.n_heads
+                dh = d // heads
+                k_idx = len(cache_specs)
+                cache_specs.append(
+                    (f"l{i}.k", (self.max_t, heads, dh)))
+                cache_specs.append(
+                    (f"l{i}.v", (self.max_t, heads, dh)))
+                plan.append(_Op(kind, unit, (
+                    self._weight(i, "weights"),
+                    self._weight(i, "bias"),
+                    self._weight(i, "weights_out"),
+                    self._weight(i, "bias_out")),
+                    aux={"k": k_idx, "v": k_idx + 1}))
+            elif kind == "lstm":
+                h_idx = len(cache_specs)
+                cache_specs.append((f"l{i}.h", (unit.units,)))
+                cache_specs.append((f"l{i}.c", (unit.units,)))
+                plan.append(_Op(kind, unit, (
+                    self._weight(i, "weights"),
+                    self._weight(i, "bias")),
+                    aux={"h": h_idx, "c": h_idx + 1}))
+                d = unit.units
+                if not unit.return_sequence:
+                    phase = "head"  # the carry IS the sample bridge
+            elif kind == "last_token":
+                plan.append(_Op(kind, unit))
+                phase = "head"
+            elif kind in _HEAD_KINDS:
+                if phase != "head":
+                    raise ValueError(
+                        f"layer {i} ({kind}) inside the sequence "
+                        f"phase — FC layers flatten the time axis "
+                        f"and cannot decode; bridge with last_token "
+                        f"first")
+                plan.append(_Op(kind, unit, (
+                    self._weight(i, "weights"),
+                    self._weight(i, "bias"))))
+            else:
+                raise ValueError(
+                    f"layer {i} ({kind}): no incremental decode step "
+                    f"(supported: {_SEQ_KINDS + _HEAD_KINDS} + "
+                    f"last_token)")
+        if phase != "head":
+            raise ValueError("chain never bridges to per-sample "
+                             "features (last_token or a final "
+                             "return_sequence=False LSTM)")
+        if layers[-1]["type"] != "softmax":
+            raise ValueError("decode chain must end in the softmax "
+                             "vocabulary head")
+        if not cache_specs:
+            raise ValueError("stateless chain — nothing to cache, "
+                             "nothing to decode")
+        return plan, cache_specs
+
+    # ------------------------------------------------------------------
+    # traced bodies
+    # ------------------------------------------------------------------
+    def _head(self, op: _Op, x, final: bool):
+        """One head layer on (B, D) features; the final softmax layer
+        returns raw logits (softmax is monotone — greedy unchanged,
+        and sampling normalizes on the host)."""
+        import jax.numpy as jnp
+        w, b = op.w
+        if final:
+            return op.unit._logits(jnp, x, w, b)
+        return op.unit._forward(jnp, x, w, b)
+
+    def _prefill_fn(self, t_bucket: int):
+        """The traced prefill body for one prompt-length bucket."""
+        import jax
+        import jax.numpy as jnp
+        plan = self._plan
+
+        def fn(caches, tokens, slot, length):
+            # tokens (1, t_bucket) int32; slot, length () int32
+            caches = list(caches)
+            feat = None
+            logits = None
+            for op in plan:
+                if op.kind == "embedding":
+                    feat = op.unit.xla_embed(op.w[0], tokens)
+                elif op.kind == "pos_encoding":
+                    feat = (feat.astype(jnp.float32)
+                            + op.table[:t_bucket][None])
+                elif op.kind == "attention":
+                    feat, k, v = op.unit.xla_prefill(feat, *op.w)
+                    zero = jnp.int32(0)
+                    caches[op.aux["k"]] = jax.lax.dynamic_update_slice(
+                        caches[op.aux["k"]], k, (slot, zero, zero, zero))
+                    caches[op.aux["v"]] = jax.lax.dynamic_update_slice(
+                        caches[op.aux["v"]], v, (slot, zero, zero, zero))
+                elif op.kind == "lstm":
+                    feat, h, c = op.unit.xla_prefill(
+                        feat, *op.w, length=jnp.reshape(length, (1,)))
+                    caches[op.aux["h"]] = \
+                        caches[op.aux["h"]].at[slot].set(h[0])
+                    caches[op.aux["c"]] = \
+                        caches[op.aux["c"]].at[slot].set(c[0])
+                elif op.kind == "last_token":
+                    # the last REAL position, not the padded tail
+                    feat = jax.lax.dynamic_index_in_dim(
+                        feat, length - 1, axis=1, keepdims=False)
+                else:  # head layer
+                    logits = self._head(op, feat, op is plan[-1])
+                    feat = logits
+            return tuple(caches), logits
+        return fn
+
+    def _decode_fn(self, b_bucket: int):
+        """The traced single-token body for one live-batch bucket."""
+        plan = self._plan
+
+        def fn(caches, tokens, slots, positions):
+            # tokens/slots/positions: (b_bucket,) int32
+            import jax.numpy as jnp
+            caches = list(caches)
+            rows = jnp.arange(b_bucket)
+            feat = None
+            logits = None
+            for op in plan:
+                if op.kind == "embedding":
+                    feat = op.unit.xla_embed(op.w[0],
+                                             tokens)[:, None, :]
+                elif op.kind == "pos_encoding":
+                    feat = op.unit.xla_decode_step(feat, positions,
+                                                   op.table)
+                elif op.kind == "attention":
+                    k_rows = caches[op.aux["k"]][slots]
+                    v_rows = caches[op.aux["v"]][slots]
+                    feat, k_rows, v_rows = op.unit.xla_decode_step(
+                        feat, k_rows, v_rows, positions, *op.w)
+                    # only position `pos` changed per lane: scatter the
+                    # new row back, padded lanes land in the scratch
+                    # slot (duplicate-index writes there are garbage
+                    # by design)
+                    caches[op.aux["k"]] = caches[op.aux["k"]].at[
+                        slots, positions].set(k_rows[rows, positions])
+                    caches[op.aux["v"]] = caches[op.aux["v"]].at[
+                        slots, positions].set(v_rows[rows, positions])
+                elif op.kind == "lstm":
+                    h = caches[op.aux["h"]][slots]
+                    c = caches[op.aux["c"]][slots]
+                    feat, h, c = op.unit.xla_decode_step(
+                        feat, h, c, *op.w)
+                    caches[op.aux["h"]] = \
+                        caches[op.aux["h"]].at[slots].set(h)
+                    caches[op.aux["c"]] = \
+                        caches[op.aux["c"]].at[slots].set(c)
+                    if op.unit.return_sequence:
+                        feat = feat[:, None, :]
+                elif op.kind == "last_token":
+                    feat = feat[:, 0]
+                else:
+                    if feat.ndim == 3:  # head after a seq-phase bridge
+                        feat = feat[:, 0]
+                    logits = self._head(op, feat, op is plan[-1])
+                    feat = logits
+            return tuple(caches), logits
+        return fn
+
+    # ------------------------------------------------------------------
+    # AOT compilation
+    # ------------------------------------------------------------------
+    def _compile(self, fn, in_structs: tuple, site: str):
+        import jax
+        donate = (0,) if self.donating else ()
+        with _tracing.TRACER.span(f"aot_compile:{site}",
+                                  cat="compile"):
+            compiled = jax.jit(fn, donate_argnums=donate).lower(
+                *in_structs).compile()
+        _metrics.xla_compiles(site).inc()
+        self.compile_count += 1
+        return compiled
+
+    def _cache_structs(self) -> tuple:
+        import jax
+        return tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                     for a in self.cache.arrays)
+
+    def prefill_program(self, t_bucket: int):
+        """The AOT prefill program for one prompt-length bucket
+        (compiled on first use; :meth:`warmup` front-loads the whole
+        ladder)."""
+        prog = self._prefill_programs.get(t_bucket)
+        if prog is None:
+            import jax
+            i32 = np.dtype(np.int32)
+            prog = self._compile(
+                self._prefill_fn(t_bucket),
+                (self._cache_structs(),
+                 jax.ShapeDtypeStruct((1, t_bucket), i32),
+                 jax.ShapeDtypeStruct((), i32),
+                 jax.ShapeDtypeStruct((), i32)),
+                "serving-prefill")
+            self._prefill_programs[t_bucket] = prog
+        return prog
+
+    def decode_program(self, b_bucket: int):
+        """The AOT single-token program for one live-batch bucket."""
+        prog = self._decode_programs.get(b_bucket)
+        if prog is None:
+            import jax
+            i32 = np.dtype(np.int32)
+            vec = jax.ShapeDtypeStruct((b_bucket,), np.dtype(np.int32))
+            prog = self._compile(
+                self._decode_fn(b_bucket),
+                (self._cache_structs(), vec, vec, vec),
+                "serving-decode")
+            self._decode_programs[b_bucket] = prog
+        return prog
+
+    def prompt_ladder(self) -> list[int]:
+        return ladder(self.max_prompt, self.prompt_align)
+
+    def batch_ladder(self) -> list[int]:
+        return ladder(self.max_slots)
+
+    def warmup(self) -> int:
+        """Compile BOTH program families up front — after this, a
+        decode loop at any live-batch size over any legal prompt mix
+        performs zero compiles.  Returns programs compiled."""
+        before = self.compile_count
+        for t_b in self.prompt_ladder():
+            self.prefill_program(t_b)
+        for b_b in self.batch_ladder():
+            self.decode_program(b_b)
+        return self.compile_count - before
+
+    @property
+    def programs_live(self) -> int:
+        return len(self._prefill_programs) + len(self._decode_programs)
+
+    # ------------------------------------------------------------------
+    # dispatch (scheduler thread only — no locking needed on cache)
+    # ------------------------------------------------------------------
+    def run_prefill(self, tokens: np.ndarray, slot: int
+                    ) -> np.ndarray:
+        """Prefill one prompt into ``slot``; returns the last real
+        position's logits (V,)."""
+        n = int(tokens.shape[0])
+        if n > self.max_prompt:
+            raise ValueError(f"prompt of {n} tokens exceeds "
+                             f"max_prompt {self.max_prompt}")
+        t_b = bucket_for(n, self.prompt_align)
+        padded = np.zeros((1, t_b), np.int32)
+        padded[0, :n] = tokens
+        prog = self.prefill_program(t_b)
+        caches, logits = prog(self.cache.arrays, padded,
+                              np.asarray(slot, np.int32),
+                              np.asarray(n, np.int32))
+        self.cache.arrays = caches
+        return np.asarray(logits, np.float32)[0]
+
+    def run_decode(self, tokens: np.ndarray, slots: np.ndarray,
+                   positions: np.ndarray) -> np.ndarray:
+        """One token step for ``len(tokens)`` live lanes; pads to the
+        covering live-batch bucket (padded lanes ride the scratch
+        slot).  Returns logits (n_live, V)."""
+        n = int(tokens.shape[0])
+        b_b = bucket_for(n)
+        pad = b_b - n
+
+        def padded(arr, fill):
+            out = np.full((b_b,), fill, np.int32)
+            out[:n] = arr
+            return out
+
+        prog = self.decode_program(b_b)
+        caches, logits = prog(
+            self.cache.arrays, padded(tokens, 0),
+            padded(slots, self.cache.trash_slot), padded(positions, 0))
+        self.cache.arrays = caches
+        return np.asarray(logits, np.float32)[:n]
+
+
+class _PromptReq:
+    """One queued generation request."""
+
+    __slots__ = ("tokens", "n", "max_new", "future", "t_submit",
+                 "deadline")
+
+    def __init__(self, tokens: np.ndarray, max_new: int,
+                 deadline_ms: float | None) -> None:
+        self.tokens = tokens
+        self.n = int(tokens.shape[0])
+        self.max_new = int(max_new)
+        self.future: Future = Future()
+        self.t_submit = time.monotonic()
+        self.deadline = (None if deadline_ms is None
+                         else self.t_submit + float(deadline_ms) / 1e3)
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
+
+class _Live:
+    """Host-side state of one sequence mid-generation."""
+
+    __slots__ = ("req", "slot", "pos", "generated", "t_last")
+
+    def __init__(self, req: _PromptReq, slot: int, first_token: int
+                 ) -> None:
+        self.req = req
+        self.slot = slot
+        #: position the NEXT input token will occupy (= prompt length
+        #: right after prefill; the sampled token is fed back there)
+        self.pos = req.n
+        self.generated = [int(first_token)]
+        self.t_last = time.monotonic()
+
+
+class DecodeEngine(Logger):
+    """Continuous-batching token server over a :class:`DecodeModel`.
+
+    Lifecycle mirrors :class:`~znicz_tpu.serving.ServingEngine`::
+
+        with DecodeEngine("lm.npz", max_slots=4, max_t=64) as eng:
+            tokens = eng.generate(prompt)            # sync
+            future = eng.submit(prompt)              # async
+            tokens = future.result()                 # np.int32 ids
+
+    ``temperature=0`` (default) decodes greedily — byte-for-byte
+    reproducible against the numpy oracle; ``temperature>0`` samples
+    from the softmax on the host with a seeded generator (the logits
+    cross anyway: sampling adds no device work).
+
+    Scheduling: ``admission="continuous"`` (default) admits queued
+    prompts into the in-flight batch between token steps; ``"static"``
+    admits only when the previous batch fully drained —
+    run-to-completion, the serve_bench A/B baseline.
+
+    Degradation: ``deadline_ms`` bounds **TTFT** (a prompt still
+    queued past it fails fast with :class:`DeadlineExceeded` and never
+    occupies a slot); the circuit breaker watches dispatch outcomes
+    and, while open, sheds NEW prompts with :class:`Overloaded` while
+    in-flight sequences keep decoding to completion (the drain
+    contract — generation in progress is the last thing to abandon).
+    """
+
+    def __init__(self, model, *, max_slots: int = 4, max_t: int = 64,
+                 max_prompt: int | None = None, prompt_align: int = 8,
+                 max_new_tokens: int = 32,
+                 eos_token: int | None = None,
+                 temperature: float = 0.0, seed: int = 0,
+                 max_queue: int = 256,
+                 admission: str = "continuous",
+                 retry_budget: int = 1,
+                 breaker_failure_rate: float = 0.5,
+                 breaker_window: int = 8,
+                 breaker_min_samples: int = 4,
+                 breaker_cooldown_ms: float = 1000.0,
+                 device=None) -> None:
+        super().__init__()
+        if not isinstance(model, DecodeModel):
+            model = DecodeModel(model, max_slots=max_slots,
+                                max_t=max_t, max_prompt=max_prompt,
+                                prompt_align=prompt_align,
+                                device=device)
+        self.model = model
+        if admission not in ("continuous", "static"):
+            raise ValueError(f"admission must be 'continuous' or "
+                             f"'static', got {admission!r}")
+        self.admission = admission
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token = eos_token
+        self.temperature = float(temperature)
+        self.max_queue = int(max_queue)
+        self.retry_budget = max(0, int(retry_budget))
+        self.breaker_failure_rate = float(breaker_failure_rate)
+        self.breaker_min_samples = int(breaker_min_samples)
+        self.breaker_cooldown = float(breaker_cooldown_ms) / 1e3
+        self._rng = np.random.default_rng(seed)
+        # telemetry: per-engine children of the canonical series
+        wf_name = model.model.manifest.get("workflow", "model")
+        self._obs_id = f"{wf_name}#decode{next(_DECODE_SEQ)}"
+        self._m_submitted = _metrics.serving_requests(
+            self._obs_id, "submitted")
+        self._m_served = _metrics.serving_requests(self._obs_id,
+                                                   "served")
+        self._m_rejected = _metrics.serving_requests(self._obs_id,
+                                                     "rejected")
+        self._m_ttft = _metrics.serving_ttft_seconds(self._obs_id)
+        self._m_token = _metrics.serving_token_seconds(self._obs_id)
+        self._m_tok_prompt = _metrics.serving_tokens(self._obs_id,
+                                                     "prompt")
+        self._m_tok_gen = _metrics.serving_tokens(self._obs_id,
+                                                  "generated")
+        self._m_slots = _metrics.serving_decode_slots(self._obs_id)
+        self._m_state = _metrics.serving_breaker_state(self._obs_id)
+        self._m_state.set(_STATE_CODE[_CLOSED])
+        # exact-value windows for dashboard percentiles
+        self._ttft_win: deque = deque(maxlen=4096)
+        self._token_win: deque = deque(maxlen=4096)
+        self._pending: deque[_PromptReq] = deque()
+        self._live: list[_Live] = []
+        self._cond = threading.Condition()
+        self._stop = False
+        self._state = _CLOSED
+        self._opened_at = 0.0
+        self._outcomes: deque[bool] = deque(maxlen=int(breaker_window))
+        self.expired_total = 0
+        self.shed_total = 0
+        self.retries_total = 0
+        self.warmup_compiles = 0
+        self.warmup_seconds = 0.0
+        self._thread: threading.Thread | None = None
+        self._started = False
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "DecodeEngine":
+        if self._started:
+            return self
+        t0 = time.monotonic()
+        self.warmup_compiles = self.model.warmup()
+        self.warmup_seconds = time.monotonic() - t0
+        self._thread = threading.Thread(target=self._loop,
+                                        name="decode-scheduler",
+                                        daemon=True)
+        self._started = True
+        self._thread.start()
+        self.info(
+            "decode '%s': %d AOT programs warmed in %.2fs (prompt "
+            "buckets %s, batch buckets %s, slots=%d, max_t=%d, "
+            "cache=%.1f MB, donate=%s)",
+            self.model.model.manifest.get("workflow", "?"),
+            self.warmup_compiles, self.warmup_seconds,
+            self.model.prompt_ladder(), self.model.batch_ladder(),
+            self.model.max_slots, self.model.max_t,
+            self.model.cache.nbytes() / 1e6, self.model.donating)
+        return self
+
+    def shutdown(self, timeout: float = 60.0) -> None:
+        """Drain: everything admitted keeps generating to completion,
+        queued prompts are served, then the scheduler exits."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        self._started = False
+        # a stopped engine is not shedding: clear the breaker so the
+        # process-level /readyz (which scans EVERY engine child of the
+        # breaker gauge) doesn't stay not-ready on a dead engine's
+        # last state
+        with self._cond:
+            self._state = _CLOSED
+            self._outcomes.clear()
+            self._m_state.set(_STATE_CODE[_CLOSED])
+
+    def __enter__(self) -> "DecodeEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # ------------------------------------------------------------------
+    # request path
+    # ------------------------------------------------------------------
+    def submit(self, prompt, *, max_new_tokens: int | None = None,
+               deadline_ms: float | None = None) -> Future:
+        """Enqueue a prompt (1-D array of token ids); returns a future
+        of the generated ids (np.int32, the first sampled token
+        onward).  Raises :class:`QueueFull` under backpressure,
+        :class:`Overloaded` while the breaker sheds, and the future
+        fails with :class:`DeadlineExceeded` if ``deadline_ms`` passes
+        before the first token (TTFT deadline)."""
+        if not self._started:
+            raise RuntimeError("engine not started — call start()")
+        prompt = np.asarray(np.round(np.asarray(prompt, np.float64)),
+                            np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if prompt.size > self.model.max_prompt:
+            raise ValueError(
+                f"prompt of {prompt.size} tokens exceeds max_prompt "
+                f"{self.model.max_prompt} — truncate client-side")
+        if deadline_ms is not None and deadline_ms <= 0:
+            raise DeadlineExceeded(
+                f"deadline_ms={deadline_ms} already expired at submit")
+        req = _PromptReq(prompt,
+                         max_new_tokens or self.max_new_tokens,
+                         deadline_ms)
+        with self._cond:
+            if self._stop:
+                raise RuntimeError("engine is shut down")
+            self._breaker_tick(time.monotonic())
+            if self._state == _OPEN:
+                self.shed_total += 1
+                _metrics.serving_requests(self._obs_id, "shed").inc()
+                self._m_rejected.inc()
+                raise Overloaded(
+                    "circuit breaker open — new prompts shed while "
+                    "in-flight decodes drain (retry after "
+                    f"{self.breaker_cooldown * 1e3:.0f}ms)")
+            if len(self._pending) >= self.max_queue:
+                self._m_rejected.inc()
+                raise QueueFull(
+                    f"decode queue full ({len(self._pending)} prompts "
+                    f"pending, limit {self.max_queue})")
+            self._pending.append(req)
+            self._cond.notify_all()
+        self._m_submitted.inc()
+        return req.future
+
+    def generate(self, prompt, timeout: float | None = None,
+                 **kwargs) -> np.ndarray:
+        """Synchronous convenience: submit + wait."""
+        return self.submit(prompt, **kwargs).result(timeout=timeout)
+
+    # ------------------------------------------------------------------
+    # breaker (under _cond)
+    # ------------------------------------------------------------------
+    def _transition(self, state: str) -> None:
+        if state == self._state:
+            return
+        self.warning("decode breaker %s → %s", self._state, state)
+        self._state = state
+        if state == _OPEN:
+            self._opened_at = time.monotonic()
+        self._m_state.set(_STATE_CODE[state])
+        _metrics.serving_breaker_transitions(self._obs_id, state).inc()
+
+    def _breaker_tick(self, now: float) -> None:
+        if self._state == _OPEN \
+                and now - self._opened_at >= self.breaker_cooldown:
+            self._transition(_HALF_OPEN)
+
+    def _record_outcome(self, ok: bool) -> None:
+        with self._cond:
+            if self._state == _HALF_OPEN:
+                self._transition(_CLOSED if ok else _OPEN)
+                self._outcomes.clear()
+                return
+            self._outcomes.append(ok)
+            n = len(self._outcomes)
+            if n >= self.breaker_min_samples:
+                rate = self._outcomes.count(False) / n
+                if rate >= self.breaker_failure_rate \
+                        and self._state != _OPEN:
+                    self.warning("decode breaker tripped: failure "
+                                 "rate %.0f%% over %d dispatches",
+                                 100 * rate, n)
+                    self._transition(_OPEN)
+                    self._outcomes.clear()
+
+    # ------------------------------------------------------------------
+    # scheduler
+    # ------------------------------------------------------------------
+    def _sample(self, logits: np.ndarray) -> int:
+        if self.temperature <= 0:
+            return int(np.argmax(logits))
+        z = logits / self.temperature
+        z = z - z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(self._rng.choice(len(p), p=p))
+
+    def _sweep_expired(self, now: float) -> None:
+        """TTFT deadline: fail-fast queued prompts whose deadline
+        passed — they never reach prefill or occupy a slot.  Call
+        under ``_cond``."""
+        if not any(r.deadline is not None for r in self._pending):
+            return
+        keep: deque[_PromptReq] = deque()
+        for req in self._pending:
+            if req.expired(now):
+                self.expired_total += 1
+                _metrics.serving_requests(self._obs_id,
+                                          "expired").inc()
+                req.future.set_exception(DeadlineExceeded(
+                    f"TTFT deadline passed after "
+                    f"{(now - req.t_submit) * 1e3:.0f}ms in queue"))
+            else:
+                keep.append(req)
+        self._pending = keep
+
+    def _chaos(self) -> None:
+        spike = _faults.fire("serving.latency_spike")
+        if spike is not None:
+            time.sleep(float(spike.get("ms", 50.0)) / 1e3)
+        if _faults.fire("serving.program_error") is not None:
+            raise _faults.FaultInjected(
+                "injected decode program failure")
+
+    def _dispatch(self, fn, *args):
+        """Run one program dispatch under the retry budget + breaker
+        accounting.  Retries re-run against unchanged cache state —
+        legal only when buffers are NOT donated (the host keeps valid
+        references); under donation a failed dispatch is terminal."""
+        attempts = 0
+        budget = 0 if self.model.donating else self.retry_budget
+        while True:
+            try:
+                self._chaos()
+                out = fn(*args)
+            except Exception:
+                self._record_outcome(False)
+                if attempts >= budget:
+                    raise
+                attempts += 1
+                self.retries_total += 1
+                _metrics.serving_requests(self._obs_id,
+                                          "retried").inc()
+                continue
+            self._record_outcome(True)
+            if attempts:
+                _metrics.recoveries("serving_retry").inc()
+            return out
+
+    def _finish(self, live: _Live) -> None:
+        self.model.cache.release(live.slot)
+        self._m_served.inc()
+        if not live.req.future.done():
+            live.req.future.set_result(
+                np.asarray(live.generated, np.int32))
+
+    def _admit(self, req: _PromptReq) -> None:
+        """Prefill one prompt into a free slot; samples (and times)
+        the first token."""
+        slot = self.model.cache.acquire()
+        try:
+            with _tracing.TRACER.span("prefill", cat="serving",
+                                      tokens=req.n):
+                logits = self._dispatch(self.model.run_prefill,
+                                        req.tokens, slot)
+        except Exception as exc:  # noqa: BLE001 — isolate the prompt
+            self.model.cache.release(slot)
+            self.warning("prefill failed: %s", exc)
+            if not req.future.done():
+                req.future.set_exception(exc)
+            return
+        token = self._sample(logits)
+        ttft = time.monotonic() - req.t_submit
+        self._m_ttft.observe(ttft)
+        self._ttft_win.append(ttft)
+        self._m_tok_prompt.inc(req.n)
+        self._m_tok_gen.inc()
+        live = _Live(req, slot, token)
+        if (self.eos_token is not None and token == self.eos_token) \
+                or req.max_new <= 1:
+            self._finish(live)
+            return
+        self._live.append(live)
+        self._m_slots.set(len(self._live))
+
+    def _step(self) -> None:
+        """One continuous-batching token step over every live lane."""
+        live = self._live
+        tokens = np.asarray([s.generated[-1] for s in live], np.int32)
+        slots = np.asarray([s.slot for s in live], np.int32)
+        positions = np.asarray([s.pos for s in live], np.int32)
+        try:
+            with _tracing.TRACER.span("decode_step", cat="serving",
+                                      lanes=len(live)):
+                logits = self._dispatch(self.model.run_decode,
+                                        tokens, slots, positions)
+        except Exception as exc:  # noqa: BLE001 — the step is shared
+            self.warning("decode step failed for %d lanes: %s",
+                         len(live), exc)
+            for s in live:
+                self.model.cache.release(s.slot)
+                if not s.req.future.done():
+                    s.req.future.set_exception(exc)
+            self._live = []
+            self._m_slots.set(0)
+            return
+        now = time.monotonic()
+        still: list[_Live] = []
+        for i, s in enumerate(live):
+            token = self._sample(logits[i])
+            s.pos += 1
+            s.generated.append(token)
+            self._m_token.observe(now - s.t_last)
+            self._token_win.append(now - s.t_last)
+            s.t_last = now
+            self._m_tok_gen.inc()
+            done = ((self.eos_token is not None
+                     and token == self.eos_token)
+                    or len(s.generated) >= s.req.max_new
+                    # page boundary: the next input position would
+                    # fall off the bucketed max-T cache
+                    or s.pos >= self.model.max_t)
+            if done:
+                self._finish(s)
+            else:
+                still.append(s)
+        self._live = still
+        self._m_slots.set(len(still))
+
+    def _loop(self) -> None:
+        while True:
+            admit: list[_PromptReq] = []
+            with self._cond:
+                while (not self._pending and not self._live
+                       and not self._stop):
+                    self._cond.wait(timeout=0.25)
+                    self._sweep_expired(time.monotonic())
+                if self._stop and not self._pending and not self._live:
+                    return
+                now = time.monotonic()
+                self._sweep_expired(now)
+                self._breaker_tick(now)
+                may_admit = (self.admission == "continuous"
+                             or not self._live)
+                # bound by the free-slot count HERE — slots are only
+                # acquired inside _admit, so the live count cannot
+                # gate this loop
+                free = self.model.cache.free_slots
+                while (may_admit and self._pending
+                       and len(admit) < free):
+                    admit.append(self._pending.popleft())
+            for req in admit:
+                self._admit(req)
+            if self._live:
+                self._step()
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        from znicz_tpu.serving.engine import _percentile
+
+        def window(win):
+            vals = sorted(win)
+            if not vals:
+                return {}
+            return {"p50": round(1e3 * _percentile(vals, 50), 3),
+                    "p95": round(1e3 * _percentile(vals, 95), 3),
+                    "p99": round(1e3 * _percentile(vals, 99), 3),
+                    "mean": round(1e3 * sum(vals) / len(vals), 3),
+                    "window": len(vals)}
+
+        out = {
+            "engine": "decode-bucketed-aot",
+            "admission": self.admission,
+            "max_slots": self.model.max_slots,
+            "max_t": self.model.max_t,
+            "prompt_buckets": self.model.prompt_ladder(),
+            "batch_buckets": self.model.batch_ladder(),
+            "programs_compiled": self.model.compile_count,
+            "programs_live": self.model.programs_live,
+            "warmup_seconds": round(self.warmup_seconds, 3),
+            "cache_bytes": self.model.cache.nbytes(),
+            "submitted": int(self._m_submitted.value),
+            "served": int(self._m_served.value),
+            "rejected": int(self._m_rejected.value),
+            "tokens_prompt": int(self._m_tok_prompt.value),
+            "tokens_generated": int(self._m_tok_gen.value),
+            "live_slots": len(self._live),
+            "queued_prompts": len(self._pending),
+            "ttft_ms": window(self._ttft_win),
+            "token_ms": window(self._token_win),
+            "resilience": {
+                "breaker": self._state,
+                "retry_budget": self.retry_budget,
+                "retried": self.retries_total,
+                "expired": self.expired_total,
+                "shed": self.shed_total,
+            },
+        }
+        return out
+
+    @property
+    def breaker_state(self) -> str:
+        return self._state
+
+    def ready(self) -> bool:
+        return bool(self._started and self._state != _OPEN)
+
+    def serving_status(self) -> dict:
+        """``web_status.gather_status`` hook."""
+        out = {"name": f"decode:{self.model.model.manifest.get('workflow', '?')}",
+               "initialized": self._started,
+               "stopped": not self._started}
+        out.update(self.stats())
+        return out
