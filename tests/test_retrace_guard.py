@@ -197,6 +197,76 @@ def test_warmed_serving_deadline_path_zero_new_compiles(served_bundle):
         engine.shutdown()
 
 
+def test_warmed_ladder_zero_new_compiles_across_3_swaps(
+        served_bundle, tmp_path):
+    """Round 13: weight hot-swaps ride the warmed ladder — weights are
+    call-time operands of every AOT program, so 3 consecutive
+    ``swap_weights`` with ragged traffic between them must not add a
+    single entry to ``znicz_xla_compiles_total{site=serving-aot}``."""
+    from znicz_tpu.serving import ServingEngine
+
+    wf = _build_wf("retrace_swap_b", max_epochs=3)
+    wf.run()
+    other = str(tmp_path / "retrace_swap_b.npz")
+    wf.export_forward(other)
+    serving_compiles = obs_metrics.xla_compiles("serving-aot")
+    engine = ServingEngine(served_bundle, max_batch=16,
+                           max_delay_ms=1.0)
+    engine.start()
+    warmed = serving_compiles.value
+    rng = np.random.default_rng(13)
+    try:
+        for swap_to in (other, served_bundle, other):
+            engine.swap_weights(swap_to)
+            for rows in (1, 5, 16, 3):
+                out = engine(rng.normal(size=(rows, 10)
+                                        ).astype(np.float32),
+                             timeout=60)
+                assert out.shape == (rows, 3)
+        assert serving_compiles.value == warmed, (
+            f"3 hot-swaps compiled {serving_compiles.value - warmed} "
+            f"new AOT programs on the warmed ladder")
+        assert engine.swap_counts["promoted"] == 3
+    finally:
+        engine.shutdown()
+
+
+def test_warmed_decode_loop_zero_new_compiles_across_3_swaps(tmp_path):
+    """Round 13, decode half: a warmed prefill ladder + decode loop
+    stays compile-free across 3 consecutive ``swap_weights`` calls
+    (``site=serving-prefill|serving-decode`` both pinned)."""
+    from benchmarks.serve_bench import train_and_export_lm
+    from znicz_tpu.serving import DecodeEngine
+
+    a = train_and_export_lm(str(tmp_path / "retrace_lm_a.npz"),
+                            epochs=1)
+    b = train_and_export_lm(str(tmp_path / "retrace_lm_b.npz"),
+                            epochs=3)
+    prefill_c = obs_metrics.xla_compiles("serving-prefill")
+    decode_c = obs_metrics.xla_compiles("serving-decode")
+    eng = DecodeEngine(a, max_slots=4, max_t=64, max_prompt=16,
+                       prompt_align=8, max_new_tokens=8)
+    eng.start()
+    rng = np.random.default_rng(14)
+    try:
+        for n in (2, 9, 16):  # warm every prompt bucket
+            eng.generate(rng.integers(0, 12, size=n), timeout=120)
+        warmed = prefill_c.value + decode_c.value
+        for swap_to in (b, a, b):
+            eng.swap_weights(swap_to, drain_ms=10_000)
+            for n in (1, 7, 12):
+                out = eng.generate(rng.integers(0, 12, size=n),
+                                   timeout=120)
+                assert len(out) >= 1
+        assert prefill_c.value + decode_c.value == warmed, (
+            f"3 decode hot-swaps compiled "
+            f"{prefill_c.value + decode_c.value - warmed} new XLA "
+            f"programs on the warmed loop")
+        assert eng.swap_counts["promoted"] == 3
+    finally:
+        eng.shutdown()
+
+
 def test_warmed_serving_bucket_zero_new_compiles(served_bundle):
     """The engine's warmup covers the whole ladder; ragged traffic
     afterwards — partial, odd, full, repeated — must not compile."""

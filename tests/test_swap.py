@@ -1,0 +1,481 @@
+"""Round 13: zero-downtime weight hot-swap — train-to-serve handoff.
+
+Pins the four layers of the swap pipeline:
+
+- **engines** — ``swap_weights`` serves the NEW model's outputs with
+  zero recompiles, ``SwapIncompatible`` leaves the incumbent
+  untouched, and a dispatch that pinned its weight tuple before the
+  flip completes BITWISE on the pre-swap weights (the no-torn-state
+  contract);
+- **publication** — monotonic versions, digest-sidecar verification,
+  corrupt-newest falls back to the newest older good bundle;
+- **canary gating + rollback** — a regressing candidate is rejected
+  with the incumbent still serving; a promoted model that trips
+  probation is automatically rolled back;
+- **decode drain** — in-flight generations finish on the OLD model
+  before the flip; the ``engine.swap_drain_ms`` bound evicts
+  stragglers with their tokens-so-far instead of hanging the swap.
+
+Plus the round-13 snapshotter satellites: prune never deletes the
+newest GOOD snapshot (corrupt files stop counting toward
+``keep_last``), and ``znicz_snapshot_age_seconds`` feeds /readyz.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from conftest import make_blobs
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.export import ExportedModel, SwapIncompatible, read_bundle
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.observe import metrics as obs_metrics
+from znicz_tpu.serving import ServingEngine
+from znicz_tpu.serving.buckets import bucket_for
+from znicz_tpu.utils import prng
+from znicz_tpu.utils.config import root
+
+DIM, CLASSES = 10, 3
+
+
+def _build_wf(name: str, max_epochs: int, seed: int = 17,
+              **kwargs) -> StandardWorkflow:
+    data, labels = make_blobs(24, CLASSES, DIM)
+    prng.seed_all(seed)
+    wf = StandardWorkflow(
+        name=name,
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:48], train_labels=labels[:48],
+            valid_data=data[48:], valid_labels=labels[48:],
+            minibatch_size=12),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 16},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": CLASSES},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}}],
+        decision_config={"max_epochs": max_epochs},
+        **kwargs)
+    wf._max_fires = 100_000
+    wf.initialize(device=XLADevice())
+    return wf
+
+
+def _bundle(tmp_path, name: str, epochs: int, seed: int = 17) -> str:
+    wf = _build_wf(name, epochs, seed=seed)
+    wf.run()
+    path = str(tmp_path / f"{name}.npz")
+    wf.export_forward(path)
+    return path
+
+
+def _oracle(path: str, x: np.ndarray) -> np.ndarray:
+    return np.asarray(ExportedModel.load(
+        path, device=NumpyDevice())(x), np.float32)
+
+
+@pytest.fixture()
+def two_bundles(tmp_path):
+    a = _bundle(tmp_path, "swap_a", epochs=1)
+    b = _bundle(tmp_path, "swap_b", epochs=4)
+    return a, b
+
+
+# ----------------------------------------------------------------------
+# engine-level swap
+# ----------------------------------------------------------------------
+def test_engine_swap_serves_new_weights(two_bundles):
+    a, b = two_bundles
+    rng = np.random.default_rng(3)
+    x = rng.normal(size=(5, DIM)).astype(np.float32)
+    oa, ob = _oracle(a, x), _oracle(b, x)
+    assert not np.allclose(oa, ob, atol=1e-4), "bundles identical?"
+    with ServingEngine(a, max_batch=8, max_delay_ms=1.0) as eng:
+        assert np.allclose(eng(x, timeout=60), oa, atol=1e-4)
+        res = eng.swap_weights(b)
+        assert res["version"] == 1 and res["outcome"] == "promoted"
+        assert eng.model_version == 1
+        out = eng(x, timeout=60)
+        assert np.allclose(out, ob, atol=1e-4), \
+            "post-swap replies are not the new model's"
+        st = eng.stats()
+        assert st["swaps"]["promoted"] == 1
+        assert st["model_version"] == 1
+
+
+def test_swap_incompatible_leaves_incumbent(two_bundles, tmp_path):
+    a, _b = two_bundles
+    rng = np.random.default_rng(4)
+    x = rng.normal(size=(3, DIM)).astype(np.float32)
+    with ServingEngine(a, max_batch=8, max_delay_ms=1.0) as eng:
+        before = eng(x, timeout=60)
+        # wrong shapes
+        with pytest.raises(SwapIncompatible, match="shape"):
+            eng.swap_weights(
+                {"layer0_weights": np.zeros((2, 2), np.float32)})
+        # wrong layer table (a conv bundle manifest against an FC
+        # chain) — build a manifest-shaped candidate
+        manifest, params = read_bundle(a)
+        bad = dict(manifest)
+        bad["layers"] = [dict(spec, type="conv")
+                         for spec in manifest["layers"]]
+        with pytest.raises(SwapIncompatible, match="layer table"):
+            eng.swap_weights((bad, params))
+        # missing parameter
+        partial = {k: v for k, v in params.items()
+                   if k != "layer1_weights"}
+        with pytest.raises(SwapIncompatible, match="missing"):
+            eng.swap_weights(partial)
+        after = eng(x, timeout=60)
+        np.testing.assert_array_equal(
+            np.asarray(before), np.asarray(after),
+            err_msg="failed swaps disturbed the incumbent weights")
+        assert eng.model_version == 0
+        assert eng.swap_counts["promoted"] == 0
+
+
+def test_mid_swap_dispatch_is_bitwise_pre_swap(two_bundles):
+    """The atomicity contract, pinned bitwise: a dispatch that read
+    the published weight tuple BEFORE the flip completes on exactly
+    the pre-swap weights — the swap replaces the tuple for later
+    dispatches, never buffers under a running one."""
+    a, b = two_bundles
+    rng = np.random.default_rng(5)
+    x = rng.normal(size=(4, DIM)).astype(np.float32)
+    model = ExportedModel.load(a, device=XLADevice(), max_batch=8)
+    model.warmup(8)
+    size = bucket_for(4, model._align)
+    padded = np.zeros((size, DIM), np.float32)
+    padded[:4] = x
+    want_pre = np.asarray(model.program_for(size)(padded))
+    pinned = model.live_params  # what an in-flight dispatch holds
+    model.swap_weights(read_bundle(b)[1], manifest=read_bundle(b)[0])
+    got_mid = np.asarray(model.program_for(size)(padded,
+                                                 _params=pinned))
+    np.testing.assert_array_equal(
+        got_mid, want_pre,
+        err_msg="a dispatch pinned pre-swap saw post-swap weights")
+    got_post = np.asarray(model.program_for(size)(padded))
+    assert not np.array_equal(got_post, want_pre), \
+        "the swap never actually published the new weights"
+
+
+def test_swap_hammer_never_torn(two_bundles):
+    """Requests racing 6 swaps must each equal ONE of the two models'
+    replies bitwise — never a mix."""
+    a, b = two_bundles
+    rng = np.random.default_rng(6)
+    x = rng.normal(size=(4, DIM)).astype(np.float32)
+    with ServingEngine(a, max_batch=8, max_delay_ms=0.5) as eng:
+        ref_a = np.asarray(eng(x, timeout=60))
+        eng.swap_weights(b)
+        ref_b = np.asarray(eng(x, timeout=60))
+        results: list = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                results.append(np.asarray(eng(x, timeout=60)))
+
+        t = threading.Thread(target=hammer, daemon=True)
+        t.start()
+        for state in (a, b, a, b, a, b):
+            eng.swap_weights(state)
+        stop.set()
+        t.join(timeout=30)
+        assert len(results) >= 2
+        for i, out in enumerate(results):
+            assert (np.array_equal(out, ref_a)
+                    or np.array_equal(out, ref_b)), \
+                f"reply {i} matches neither model bitwise (torn swap?)"
+
+
+# ----------------------------------------------------------------------
+# publication + watcher
+# ----------------------------------------------------------------------
+def test_publish_monotonic_versions_and_pickup(tmp_path):
+    from znicz_tpu.resilience.publisher import (PublicationWatcher,
+                                                publish_bundle)
+    wf = _build_wf("pub_wf", 1)
+    wf.run()
+    pubdir = str(tmp_path / "published")
+    v1, p1 = publish_bundle(wf, pubdir)
+    v2, p2 = publish_bundle(wf, pubdir)
+    assert (v1, v2) == (1, 2)
+    assert os.path.exists(p2) and os.path.exists(p2 + ".sha256")
+    watcher = PublicationWatcher(pubdir)
+    got = watcher.poll()
+    assert got is not None and got[0] == 2, "newest version wins"
+    manifest, params = got[2], got[3]
+    assert manifest["workflow"] == "pub_wf"
+    assert any(k.startswith("layer0_") for k in params)
+    assert watcher.poll() is None, "nothing new → None"
+    # age gauge went live on publish
+    fam = obs_metrics.REGISTRY.get("znicz_snapshot_age_seconds")
+    ages = {k[0]: c.value for k, c in fam.items()}
+    assert "publish:model" in ages and ages["publish:model"] < 60
+
+
+def test_watcher_rejects_corrupt_falls_back(tmp_path):
+    from znicz_tpu.resilience.publisher import (PublicationWatcher,
+                                                publish_bundle)
+    wf = _build_wf("corrupt_wf", 1)
+    wf.run()
+    pubdir = str(tmp_path / "published")
+    publish_bundle(wf, pubdir)
+    # arrivals count from plan activation: the NEXT publish (v2)
+    # is arrival 1 and gets corrupted after its digest
+    root.common.engine.faults = {"publish.corrupt": {"at": [1]}}
+    _v2, p2 = publish_bundle(wf, pubdir)  # corrupted after digest
+    fails = obs_metrics.snapshot_failures("publish")
+    before = fails.value
+    watcher = PublicationWatcher(pubdir)
+    got = watcher.poll()
+    assert got is not None and got[0] == 1, \
+        "corrupt newest must fall back to the older good version"
+    assert fails.value == before + 1
+    assert watcher.poll() is None  # v2 quarantined, never retried
+    # v3 (good) is picked up as usual afterwards
+    root.common.engine.faults = False
+    publish_bundle(wf, pubdir)
+    got = watcher.poll()
+    assert got is not None and got[0] == 3
+
+
+# ----------------------------------------------------------------------
+# canary gate + probation rollback
+# ----------------------------------------------------------------------
+@pytest.fixture()
+def controlled_engine(tmp_path):
+    from znicz_tpu.resilience.publisher import (PublicationWatcher,
+                                                SwapController,
+                                                classifier_score,
+                                                publish_bundle)
+    data, labels = make_blobs(24, CLASSES, DIM)
+    wf = _build_wf("ctl_wf", 2)
+    wf.run()
+    pubdir = str(tmp_path / "published")
+    _v1, p1 = publish_bundle(wf, pubdir)
+    eng = ServingEngine(p1, max_batch=8, max_delay_ms=1.0)
+    eng.start()
+    eng.set_model_version(1)
+    watcher = PublicationWatcher(pubdir)
+    watcher.version = 1
+    ctl = SwapController(eng, watcher,
+                         classifier_score(data[48:], labels[48:]),
+                         guard_margin=0.05, probation_steps=1)
+    yield wf, pubdir, eng, ctl
+    eng.shutdown()
+
+
+def test_canary_rejects_regressing_candidate(controlled_engine):
+    from znicz_tpu.resilience.publisher import publish_bundle
+    wf, pubdir, eng, ctl = controlled_engine
+    rng = np.random.default_rng(8)
+    x = rng.normal(size=(3, DIM)).astype(np.float32)
+    incumbent = np.asarray(eng(x, timeout=60))
+    root.common.engine.faults = {"swap.canary_regress": {"at": [1]}}
+    publish_bundle(wf, pubdir)
+    events = ctl.tick()
+    assert any("rejected" in e for e in events), events
+    assert eng.model_version == 1
+    assert eng.swap_counts == {"promoted": 0, "rejected": 1,
+                               "rolled_back": 0}
+    np.testing.assert_array_equal(
+        incumbent, np.asarray(eng(x, timeout=60)),
+        err_msg="rejection disturbed the incumbent")
+    # the rejected version is quarantined; the next good one promotes
+    root.common.engine.faults = False
+    publish_bundle(wf, pubdir)
+    events = ctl.tick()
+    assert any("promoted" in e for e in events), events
+    assert eng.model_version == 3
+
+
+def test_probation_rollback_restores_prior(controlled_engine):
+    from znicz_tpu.resilience.publisher import publish_bundle
+    wf, pubdir, eng, ctl = controlled_engine
+    rng = np.random.default_rng(9)
+    x = rng.normal(size=(3, DIM)).astype(np.float32)
+    incumbent = np.asarray(eng(x, timeout=60))
+    root.common.engine.faults = {"swap.probation_fail": {"at": [1]}}
+    publish_bundle(wf, pubdir)
+    events = ctl.tick()
+    assert any("promoted" in e for e in events), events
+    assert eng.model_version == 2 and ctl.on_probation
+    events = ctl.tick()  # probation check fires the fault → rollback
+    assert any("rolled back" in e for e in events), events
+    assert eng.model_version == 1 and not ctl.on_probation
+    assert eng.swap_counts["rolled_back"] == 1
+    np.testing.assert_array_equal(
+        incumbent, np.asarray(eng(x, timeout=60)),
+        err_msg="rollback did not restore the prior weights bitwise")
+    # /readyz carries the rolled-back version + swap series
+    from znicz_tpu.web_status import WebStatusServer
+    server = WebStatusServer(port=0)
+    try:
+        report = server.readiness()
+        assert report["engines"][eng._obs_id]["model_version"] == 1
+        assert report["ready"], report
+    finally:
+        server.stop()
+
+
+# ----------------------------------------------------------------------
+# decode drain semantics
+# ----------------------------------------------------------------------
+def _lm_bundles(tmp_path):
+    from benchmarks.serve_bench import train_and_export_lm
+    a = train_and_export_lm(str(tmp_path / "lm_a.npz"), epochs=1)
+    b = train_and_export_lm(str(tmp_path / "lm_b.npz"), epochs=4)
+    return a, b
+
+
+def test_decode_swap_drains_old_model_generations(tmp_path):
+    from znicz_tpu.serving import DecodeEngine
+    a, b = _lm_bundles(tmp_path)
+    kw = dict(max_slots=4, max_t=64, max_prompt=16, prompt_align=8,
+              max_new_tokens=16)
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, 12, size=n).astype(np.int32)
+               for n in (3, 7)]
+    with DecodeEngine(a, **kw) as ora:
+        want_a = [np.asarray(ora.generate(p, timeout=120))
+                  for p in prompts]
+    with DecodeEngine(b, **kw) as orb:
+        want_b = [np.asarray(orb.generate(p, timeout=120))
+                  for p in prompts]
+    eng = DecodeEngine(a, **kw)
+    eng.start()
+    try:
+        import time
+        futs = [eng.submit(p) for p in prompts]
+        # wait for admission: prompts still queued when the swap
+        # request lands would (correctly) prefill on the NEW model —
+        # this test pins the drain contract for ADMITTED lanes
+        deadline = time.monotonic() + 10
+        while eng._pending and time.monotonic() < deadline:
+            time.sleep(0.001)
+        res = eng.swap_weights(b, drain_ms=30_000)
+        # in-flight generations completed on the OLD model, bitwise
+        for fut, want in zip(futs, want_a):
+            np.testing.assert_array_equal(
+                np.asarray(fut.result(timeout=120)), want,
+                err_msg="an in-flight generation mixed in new-model "
+                        "logits")
+        assert res["evicted"] == 0
+        assert res["version"] == 1
+        # prompts after the flip prefill against the NEW model
+        for p, want in zip(prompts, want_b):
+            np.testing.assert_array_equal(
+                np.asarray(eng.generate(p, timeout=120)), want,
+                err_msg="post-swap generation is not the new model's")
+    finally:
+        eng.shutdown()
+
+
+def test_decode_swap_drain_bound_evicts_stragglers(tmp_path):
+    from znicz_tpu.serving import DecodeEngine
+    a, b = _lm_bundles(tmp_path)
+    # max_t high enough that ~0.1 ms/token CPU decode cannot reach
+    # the page boundary inside the drain bound
+    eng = DecodeEngine(a, max_slots=2, max_t=4096, max_prompt=16,
+                       prompt_align=8, max_new_tokens=10_000)
+    eng.start()
+    try:
+        import time
+        rng = np.random.default_rng(12)
+        futs = [eng.submit(rng.integers(0, 12, size=5))
+                for _ in range(2)]
+        deadline = time.monotonic() + 10
+        while (eng._pending or not eng._live) \
+                and time.monotonic() < deadline:
+            time.sleep(0.001)
+        res = eng.swap_weights(b, drain_ms=30, timeout=120)
+        assert res["evicted"] >= 1, (
+            "the drain bound never evicted the unbounded generations",
+            res)
+        for fut in futs:  # partial tokens delivered, no hang
+            toks = np.asarray(fut.result(timeout=60))
+            assert toks.ndim == 1 and len(toks) >= 1
+        # the engine keeps serving on the new weights afterwards
+        out = eng.generate(np.arange(4) % 12, max_new_tokens=8,
+                           timeout=120)
+        assert len(out) >= 1
+        assert eng.model_version == 1
+    finally:
+        eng.shutdown()
+
+
+# ----------------------------------------------------------------------
+# snapshotter satellites
+# ----------------------------------------------------------------------
+def test_prune_keeps_newest_good_skips_corrupt(tmp_path):
+    from znicz_tpu.utils.snapshotter import Snapshotter
+    d = str(tmp_path / "snaps")
+    paths = []
+    for i in range(5):
+        paths.append(Snapshotter.write({"i": i}, d, "race", f"e{i}"))
+        os.utime(paths[-1], (1000 + i, 1000 + i))
+    # corrupt the two NEWEST (sidecar now lies about them)
+    for p in paths[3:]:
+        with open(p, "r+b") as f:
+            f.write(b"\x00garbage\x00")
+    deleted = Snapshotter.prune(d, "race", keep_last=2)
+    remaining = {p for p in paths if os.path.exists(p)}
+    # corrupt files are gone AND did not consume retention slots:
+    # the two newest GOOD snapshots survive
+    assert remaining == set(paths[1:3]), (remaining, deleted)
+    assert set(deleted) == {paths[0], paths[3], paths[4]}
+    # a reader falling back from a corrupt path still lands on the
+    # newest good state
+    state = Snapshotter.load(paths[2])
+    assert state["i"] == 2
+
+
+def test_prune_unverifiable_sidecarless_counts_as_good(tmp_path):
+    """A snapshot whose sidecar never landed (crash window) is
+    loadable, so it must keep counting toward keep_last."""
+    from znicz_tpu.utils.snapshotter import Snapshotter
+    d = str(tmp_path / "snaps")
+    paths = []
+    for i in range(3):
+        paths.append(Snapshotter.write({"i": i}, d, "bare", f"e{i}"))
+        os.utime(paths[-1], (1000 + i, 1000 + i))
+    os.unlink(paths[2] + ".sha256")
+    Snapshotter.prune(d, "bare", keep_last=2)
+    assert not os.path.exists(paths[0])
+    assert os.path.exists(paths[1]) and os.path.exists(paths[2])
+
+
+def test_snapshot_age_gauge_feeds_readyz(tmp_path):
+    from znicz_tpu.resilience import publisher as pub
+    from znicz_tpu.utils.snapshotter import Snapshotter
+    from znicz_tpu.web_status import WebStatusServer
+    wf = _build_wf("age_wf", 2,
+                   snapshotter_config={"prefix": "age",
+                                       "directory": str(tmp_path)})
+    wf.run()
+    assert wf.snapshotter.destination is not None
+    gauge = obs_metrics.snapshot_age_seconds("snapshot:age")
+    assert 0.0 <= gauge.value < 120.0
+    server = WebStatusServer(port=0)
+    try:
+        report = server.readiness()
+        assert "snapshot:age" in report["artifacts"]
+        assert report["ready"]
+        # stale artifact + threshold → not ready
+        root.common.engine.ready_max_snapshot_age_s = 50
+        pub._last_written["snapshot:age"] -= 100
+        report = server.readiness()
+        assert not report["ready"]
+        assert any("snapshot:age" in r for r in report["reasons"])
+    finally:
+        server.stop()

@@ -31,6 +31,7 @@ from __future__ import annotations
 import io
 import json
 import os
+import threading
 from collections import Counter, OrderedDict
 
 import numpy as np
@@ -45,6 +46,24 @@ from znicz_tpu.serving.buckets import bucket_for, ladder
 
 FORMAT_NAME = "znicz-tpu-forward"
 FORMAT_VERSION = 1
+
+
+class SwapIncompatible(RuntimeError):
+    """A candidate weight set does not fit the serving chain (layer
+    table, parameter shapes or dtypes disagree with the manifest the
+    programs were compiled against).  Raised BEFORE anything is
+    staged or flipped — the incumbent weights are untouched and the
+    engine keeps serving them."""
+
+
+def read_bundle(path: str) -> tuple[dict, dict]:
+    """Load an exported ``.npz`` bundle's ``(manifest, params)``
+    without building a model — the publication watcher and the swap
+    path read candidates through this."""
+    with np.load(path) as bundle:
+        manifest = json.loads(bytes(bundle["manifest"]).decode())
+        params = {k: bundle[k] for k in bundle.files if k != "manifest"}
+    return manifest, params
 
 #: default ladder cap for direct ``ExportedModel`` use (the engine
 #: passes its own, typically much smaller, ``max_batch``)
@@ -210,15 +229,21 @@ class ExportedModel(Logger):
         self.program_hits: Counter = Counter()  # size → cache hits
         self.compile_count = 0
         self._cur_batch: int | None = None
+        # hot-swap state (round 13): trained parameters are CALL-TIME
+        # operands of every AOT program, published as one immutable
+        # tuple a dispatch reads exactly once — swapping replaces the
+        # tuple between dispatches, never a buffer under a running
+        # program
+        self._param_vecs: "list[tuple[str, Vector]] | None" = None
+        self._live_params: tuple = ()
+        self._swap_lock = threading.RLock()
+        self.weights_version = 0
         self._build_chain()
 
     @classmethod
     def load(cls, path: str, device: Device | None = None,
              **kwargs) -> "ExportedModel":
-        with np.load(path) as bundle:
-            manifest = json.loads(bytes(bundle["manifest"]).decode())
-            params = {k: bundle[k] for k in bundle.files
-                      if k != "manifest"}
+        manifest, params = read_bundle(path)
         return cls(manifest, params, device=device, **kwargs)
 
     # ------------------------------------------------------------------
@@ -364,26 +389,67 @@ class ExportedModel(Logger):
             return bool(cfg)
         return bool(getattr(self.device, "supports_donation", False))
 
+    def _ensure_param_vecs(self) -> "list[tuple[str, Vector]]":
+        """The trained-parameter vectors in canonical (layer, attr)
+        order, deduped by identity (tied autoencoder weights appear
+        once).  These are the leaves :meth:`swap_weights` replaces and
+        every AOT program takes as call-time operands."""
+        if self._param_vecs is None:
+            if self._cur_batch is None:
+                # swap before any request: build + load the chain at
+                # the smallest bucket so the vectors exist
+                self._initialize(self._align)
+            seen: set[int] = set()
+            out: list[tuple[str, Vector]] = []
+            for i, unit in enumerate(self.forwards):
+                for attr in unit.EXPORT_PARAMS:
+                    vec = getattr(unit, attr)
+                    if vec and id(vec) not in seen:
+                        seen.add(id(vec))
+                        out.append((f"layer{i}_{attr}", vec))
+            self._param_vecs = out
+        return self._param_vecs
+
+    @property
+    def live_params(self) -> tuple:
+        """The currently-published weight tuple.  Immutable; a
+        dispatcher reads it ONCE per batch and passes it to the
+        program, so an in-flight dispatch finishes on the weights it
+        started with no matter when a swap lands."""
+        return self._live_params
+
     def _aot_compile(self):
         """AOT-compile the chain at the CURRENT batch size (the caller
         just ran :meth:`_initialize`): ``jit(...).lower(...).compile()``
         — the compile happens HERE, not on first call, so warmup really
-        front-loads every trace."""
+        front-loads every trace.
+
+        Trained parameters are passed as one tuple operand (round 13):
+        the program's weight leaves come from :attr:`live_params` at
+        call time instead of being captured at compile time, which is
+        what makes :meth:`swap_weights` recompile-free — same shapes,
+        same shardings, different buffers."""
         import jax
 
+        param_pairs = self._ensure_param_vecs()
+        pvecs = [vec for _k, vec in param_pairs]
+        param_ids = {id(v) for v in pvecs}
         vectors: list[Vector] = []
-        seen = {id(self._input_vec)}
+        seen = {id(self._input_vec)} | param_ids
         for unit in self.forwards:
             for vec in unit.region_vectors():
                 if id(vec) not in seen:
                     seen.add(id(vec))
                     vectors.append(vec)
-        for vec in vectors:
+        for vec in pvecs + vectors:
             vec.unmap()
         units = self.forwards
         input_vec = self._input_vec
 
-        def fn(x, *leaves):
+        def fn(x, params, *leaves):
+            for vec, leaf in zip(pvecs, params):
+                vec._tracing = True
+                vec._devmem = leaf
             for vec, leaf in zip(vectors, leaves):
                 vec._tracing = True
                 vec._devmem = leaf
@@ -395,11 +461,12 @@ class ExportedModel(Logger):
                 return units[-1].output._devmem
             finally:
                 input_vec._tracing = False
-                for vec in vectors:
+                for vec in pvecs + vectors:
                     vec._tracing = False
 
         donate = self._donate_choice()
         jitted = jax.jit(fn, donate_argnums=(0,) if donate else ())
+        param_leaves = tuple(vec._devmem for vec in pvecs)
         leaves = [vec._devmem for vec in vectors]
         input_leaf = input_vec._devmem
 
@@ -411,7 +478,9 @@ class ExportedModel(Logger):
         with _tracing.TRACER.span(
                 f"aot_compile:b{self._cur_batch}", cat="compile"):
             compiled = jitted.lower(
-                struct(input_leaf), *[struct(leaf) for leaf in leaves]
+                struct(input_leaf),
+                tuple(struct(p) for p in param_leaves),
+                *[struct(leaf) for leaf in leaves]
             ).compile()
         # the same series the jit regions count on — the serving side
         # of the steady-state retrace guard watches this site
@@ -419,15 +488,22 @@ class ExportedModel(Logger):
         # lowering traced fn, which wrote tracers into vec._devmem;
         # restore the real arrays so later _initialize rounds (other
         # bucket sizes) never snapshot a dead tracer
+        for vec, leaf in zip(pvecs, param_leaves):
+            vec._devmem = leaf
         for vec, leaf in zip(vectors, leaves):
             vec._devmem = leaf
         input_vec._devmem = input_leaf
+        self._live_params = tuple(vec._devmem for vec in pvecs)
         self.compile_count += 1
 
-        def call(x):
+        def call(x, _params=None):
             # x: host array or committed jax.Array of the padded
-            # bucket shape; donated to the program when enabled
-            return compiled(x, *leaves)
+            # bucket shape; donated to the program when enabled.
+            # _params lets a dispatcher pin the weight tuple it read
+            # at dispatch start (the mid-swap atomicity contract);
+            # default is whatever is published right now.
+            p = self._live_params if _params is None else _params
+            return compiled(x, p, *leaves)
 
         return call
 
@@ -441,15 +517,113 @@ class ExportedModel(Logger):
             self._programs.move_to_end(size)
             self.program_hits[size] += 1
             return fn
-        self._initialize(size)
-        fn = self._aot_compile()
-        self._programs[size] = fn
-        if self.bucketing:
-            while len(self._programs) > self._program_capacity:
-                evicted, _ = self._programs.popitem(last=False)
-                self.debug("evicted program for batch %d (LRU, cap %d)",
-                           evicted, self._program_capacity)
+        with self._swap_lock:  # compile never races a weight flip
+            fn = self._programs.get(size)
+            if fn is not None:
+                return fn
+            self._initialize(size)
+            fn = self._aot_compile()
+            self._programs[size] = fn
+            if self.bucketing:
+                while len(self._programs) > self._program_capacity:
+                    evicted, _ = self._programs.popitem(last=False)
+                    self.debug(
+                        "evicted program for batch %d (LRU, cap %d)",
+                        evicted, self._program_capacity)
         return fn
+
+    # ------------------------------------------------------------------
+    # weight hot-swap (round 13)
+    # ------------------------------------------------------------------
+    def check_compatible(self, manifest: dict | None,
+                         params: dict) -> "list[tuple[str, Vector]]":
+        """Validate a candidate against the chain the programs were
+        compiled for; raises :class:`SwapIncompatible` (incumbent
+        untouched) on any mismatch.  Returns the canonical param-vec
+        pairs the swap will replace."""
+        if manifest is not None:
+            mine = [layer["type"] for layer in self.manifest["layers"]]
+            theirs = [layer["type"] for layer in
+                      manifest.get("layers", [])]
+            if mine != theirs:
+                raise SwapIncompatible(
+                    f"candidate layer table {theirs} != serving chain "
+                    f"{mine}")
+            if tuple(manifest.get("input_shape", self.input_shape)) \
+                    != self.input_shape:
+                raise SwapIncompatible(
+                    f"candidate input shape "
+                    f"{tuple(manifest['input_shape'])} != exported "
+                    f"{self.input_shape}")
+            cand_dtype = np.dtype(manifest.get("dtype", "float32"))
+            if cand_dtype != self.dtype:
+                raise SwapIncompatible(
+                    f"candidate dtype {cand_dtype} != trained "
+                    f"{self.dtype} — the compiled programs are pinned "
+                    f"to the trained precision mode")
+        pairs = self._ensure_param_vecs()
+        for key, vec in pairs:
+            arr = params.get(key)
+            if arr is None:
+                raise SwapIncompatible(
+                    f"candidate is missing parameter '{key}'")
+            if tuple(np.shape(arr)) != tuple(vec.shape):
+                raise SwapIncompatible(
+                    f"{key}: candidate shape {tuple(np.shape(arr))} != "
+                    f"compiled {tuple(vec.shape)}")
+        return pairs
+
+    def swap_weights(self, params: dict,
+                     manifest: dict | None = None) -> int:
+        """Replace the trained parameters of a LIVE model without
+        recompiling anything.
+
+        ``params`` maps the export keys (``layer<i>_<attr>``) to host
+        arrays (a published bundle's array dict, or a training
+        snapshot's exported view).  The three phases of the contract:
+
+        1. **validate** — shapes/dtypes against the manifest/chain;
+           any mismatch raises :class:`SwapIncompatible` with the old
+           weights untouched;
+        2. **stage** — new buffers are uploaded onto the serving
+           device/mesh (re-sharded to each parameter's existing
+           placement) and fenced, entirely off the dispatch path;
+        3. **publish** — the immutable :attr:`live_params` tuple is
+           replaced in one assignment.  A dispatch reads the tuple
+           once, so in-flight requests finish on the old weights and
+           no request ever sees a torn mix.
+
+        Returns the new :attr:`weights_version`."""
+        pairs = self.check_compatible(manifest, params)
+        if isinstance(self.device, NumpyDevice):
+            with self._swap_lock:
+                for key, vec in pairs:
+                    new = np.asarray(params[key]).astype(vec.dtype)
+                    vec.map_write()
+                    vec.mem[...] = new
+                    self._params[key] = np.array(new, copy=True)
+                self.weights_version += 1
+                return self.weights_version
+        import jax
+
+        staged = []
+        for key, vec in pairs:
+            new = np.asarray(params[key]).astype(vec.dtype)
+            old = vec.devmem
+            sharding = getattr(old, "sharding", None)
+            arr = (jax.device_put(new, sharding)
+                   if sharding is not None else jax.device_put(new))
+            staged.append((key, vec, new, arr))
+        for _k, _v, _h, arr in staged:  # fence off the dispatch path
+            arr.block_until_ready()
+        with self._swap_lock:
+            for key, vec, host, arr in staged:
+                vec.accept_device(arr)
+                self._params[key] = host
+            self._live_params = tuple(
+                vec._devmem for _k, vec in pairs)
+            self.weights_version += 1
+            return self.weights_version
 
     def warmup(self, max_batch: int | None = None) -> int:
         """Eagerly compile every ladder bucket up to ``max_batch``

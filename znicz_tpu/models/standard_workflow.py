@@ -457,6 +457,19 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.shell = s
         return s
 
+    def link_weight_publisher(self, **config):
+        """Publish the trained forward chain every N epochs into a
+        serving handoff directory (round 13 — the training half of the
+        continuous train-to-serve loop; a serving process's
+        :class:`~znicz_tpu.resilience.publisher.PublicationWatcher`
+        picks the bundles up for canary-gated hot swaps).  Config:
+        ``directory``, ``prefix``, ``every_n_epochs``."""
+        from znicz_tpu.resilience.publisher import WeightPublisher
+        p = WeightPublisher(self, name="weight_publisher", **config)
+        self._epoch_side_unit(p)
+        self.weight_publisher = p
+        return p
+
     def link_publisher(self, **config):
         """Post-training report generation (reference: ``Publisher``
         from ``veles/publishing/``): fires once, when the decision

@@ -663,3 +663,61 @@ def last_step_timestamp(workflow: str) -> Gauge:
         "znicz_last_step_timestamp_seconds",
         "Unix timestamp of the workflow's last completed step",
         labels=("workflow",)).labels(workflow=workflow)
+
+
+# ----------------------------------------------------------------------
+# continuous-learning series (round 13): the train-to-serve handoff —
+# every publish, swap verdict and live model version is a scrapeable
+# series so the soak harness and the chaos dryrun attest the
+# publish→verify→canary→promote→rollback pipeline from /metrics
+# ----------------------------------------------------------------------
+def swaps_total(engine: str, outcome: str) -> Counter:
+    """Weight hot-swap verdicts per serving engine: ``promoted`` (the
+    candidate went live), ``rejected`` (the canary gate refused it —
+    the incumbent kept serving), ``rolled_back`` (a promoted model
+    tripped probation and the prior version was restored)."""
+    return REGISTRY.counter(
+        "znicz_swaps_total",
+        "Weight hot-swap outcomes (promoted/rejected/rolled_back)",
+        labels=("engine", "outcome")).labels(engine=engine,
+                                             outcome=outcome)
+
+
+def model_version(engine: str) -> Gauge:
+    """The monotonic published-model version an engine is currently
+    serving (0 = the bundle it started from, before any promote)."""
+    return REGISTRY.gauge(
+        "znicz_model_version",
+        "Published model version currently live on the engine",
+        labels=("engine",)).labels(engine=engine)
+
+
+def swap_duration_seconds(engine: str) -> Histogram:
+    """End-to-end hot-swap duration: stage (host→device upload of the
+    candidate weights, off the dispatch path) + drain (decode engines
+    let old-model generations finish) + the atomic publish flip."""
+    return REGISTRY.histogram(
+        "znicz_swap_duration_seconds",
+        "Weight hot-swap duration (stage + drain + atomic flip)",
+        labels=("engine",)).labels(engine=engine)
+
+
+def snapshot_age_seconds(source: str) -> Gauge:
+    """Seconds since ``source`` (a Snapshotter prefix or a publisher
+    directory) last wrote a GOOD artifact — a live callback gauge, so
+    /readyz sees a stalled trainer as staleness without any writer
+    heartbeat code (threshold: ``engine.ready_max_snapshot_age_s``)."""
+    return REGISTRY.gauge(
+        "znicz_snapshot_age_seconds",
+        "Time since the last good snapshot/publish by source",
+        labels=("source",)).labels(source=source)
+
+
+def publishes_total(source: str) -> Counter:
+    """Snapshot bundles published for serving pickup (the training
+    side of the handoff; the watcher's digest verdicts ride
+    ``znicz_snapshot_failures_total{op=publish}``)."""
+    return REGISTRY.counter(
+        "znicz_publishes_total",
+        "Model bundles published to the serving handoff directory",
+        labels=("source",)).labels(source=source)

@@ -20,7 +20,14 @@ equivalent substrate, wired through every layer of the modern stack:
 - the serving deadline/retry/circuit-breaker path lives in
   :mod:`znicz_tpu.serving`;
 - snapshot retention + digest-verified load lives in
-  :mod:`znicz_tpu.utils.snapshotter`.
+  :mod:`znicz_tpu.utils.snapshotter`;
+- :mod:`znicz_tpu.resilience.publisher` — round 13: the train-to-serve
+  handoff control plane: digest-sidecar bundle publication, the
+  serving-side :class:`~znicz_tpu.resilience.publisher.PublicationWatcher`
+  (loads only digest-verified bundles, falls back on corruption), and
+  the :class:`~znicz_tpu.resilience.publisher.SwapController`
+  canary-gate → promote → probation → automatic-rollback state machine
+  over the engines' recompile-free ``swap_weights``.
 
 Every fault, retry, skip, quarantine, rollback and breaker transition
 is a canonical :mod:`znicz_tpu.observe` registry series scraped by
@@ -34,4 +41,11 @@ from znicz_tpu.resilience.faults import (  # noqa: F401
     FaultPlan,
     SITES,
     fire,
+)
+from znicz_tpu.resilience.publisher import (  # noqa: F401
+    PublicationWatcher,
+    SwapController,
+    WeightPublisher,
+    classifier_score,
+    publish_bundle,
 )

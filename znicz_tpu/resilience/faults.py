@@ -83,6 +83,18 @@ SITES = {
     "snapshot.write_fail":
         "Snapshotter.write raises OSError mid-write (exercises "
         "tolerate-and-continue + retention of the last good snapshot)",
+    "publish.corrupt":
+        "publish_bundle corrupts the bundle bytes AFTER computing the "
+        "sidecar digest — the serving-side watcher must reject the "
+        "file on digest verification and keep the incumbent serving",
+    "swap.canary_regress":
+        "the candidate's canary score is penalized by payload "
+        "'penalty' (default 1.0) so the swap gate must reject the "
+        "publish (exercises guard-margin rejection)",
+    "swap.probation_fail":
+        "the post-promotion probation check reports the freshly "
+        "promoted model unhealthy, forcing an automatic rollback to "
+        "the prior version",
 }
 
 #: spec keys that steer firing rather than ride the payload

@@ -40,4 +40,16 @@ from znicz_tpu.serving.decode import (  # noqa: F401
     DecodeModel,
     KVCache,
 )
-from znicz_tpu.serving.engine import ServingEngine  # noqa: F401
+from znicz_tpu.serving.engine import (  # noqa: F401
+    ServingEngine,
+    resolve_swap_state,
+)
+
+
+def __getattr__(name):
+    # lazy: export.py itself imports serving.buckets at module load,
+    # so a direct top-level re-export here would be a circular import
+    if name == "SwapIncompatible":
+        from znicz_tpu.export import SwapIncompatible
+        return SwapIncompatible
+    raise AttributeError(name)

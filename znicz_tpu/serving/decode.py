@@ -92,17 +92,21 @@ _HEAD_KINDS = ("all2all", "all2all_tanh", "all2all_relu",
 
 
 class _Op:
-    """One planned chain step: the unit (config carrier), its weight
-    leaves, and — for stateful layers — its cache array indices."""
+    """One planned chain step: the unit (config carrier), the export
+    KEYS of its weight leaves, and — for stateful layers — its cache
+    array indices.  Weights themselves are NOT baked into the op: the
+    traced programs take them as a call-time operand pytree, which is
+    what lets :meth:`DecodeModel.swap_weights` replace them without a
+    single recompile."""
 
-    __slots__ = ("kind", "unit", "w", "aux", "table")
+    __slots__ = ("kind", "unit", "wkeys", "aux", "table")
 
-    def __init__(self, kind, unit, w=(), aux=None, table=None):
+    def __init__(self, kind, unit, wkeys=(), aux=None, table=None):
         self.kind = kind
         self.unit = unit
-        self.w = tuple(w)      # device weight arrays, layer-specific
-        self.aux = aux or {}   # cache indices etc.
-        self.table = table     # pos_encoding: baked (maxT, D) table
+        self.wkeys = tuple(wkeys)  # export keys (layer<i>_<attr>)
+        self.aux = aux or {}       # cache indices etc.
+        self.table = table         # pos_encoding: baked (maxT, D) table
 
 
 class KVCache:
@@ -209,15 +213,28 @@ class DecodeModel(Logger):
         self._decode_programs: dict[int, "callable"] = {}
         self.compile_count = 0
         self.donating = model._donate_choice()
+        # the published weight pytree: one immutable tuple-of-tuples
+        # (one entry per plan op, None for absent leaves) every
+        # prefill/decode dispatch reads exactly once — hot-swap
+        # replaces the tuple between dispatches
+        self._weights = self._gather_weights(self.model._params)
+        self.weights_version = 0
 
     # ------------------------------------------------------------------
     # chain planning
     # ------------------------------------------------------------------
-    def _weight(self, i: int, attr: str):
+    def _gather_weights(self, params: dict) -> tuple:
+        """Build the weight operand pytree from a bundle's param dict
+        (absent leaves — e.g. a bias the export never carried — stay
+        ``None``, a legal empty pytree node)."""
         import jax.numpy as jnp
-        key = f"layer{i}_{attr}"
-        arr = self.model._params.get(key)
-        return None if arr is None else jnp.asarray(arr, jnp.float32)
+        out = []
+        for op in self._plan:
+            out.append(tuple(
+                None if key not in params
+                else jnp.asarray(params[key], jnp.float32)
+                for key in op.wkeys))
+        return tuple(out)
 
     def _build_plan(self) -> tuple[list[_Op], list]:
         """Walk the manifest layers into decode ops + cache specs.
@@ -244,8 +261,7 @@ class DecodeModel(Logger):
                     f"bridge — only head layers {_HEAD_KINDS} may "
                     f"follow")
             if kind == "embedding":
-                plan.append(_Op(kind, unit,
-                                (self._weight(i, "weights"),)))
+                plan.append(_Op(kind, unit, (f"layer{i}_weights",)))
             elif kind == "pos_encoding":
                 import jax.numpy as jnp
                 table = jnp.asarray(
@@ -265,18 +281,15 @@ class DecodeModel(Logger):
                 cache_specs.append(
                     (f"l{i}.v", (self.max_t, heads, dh)))
                 plan.append(_Op(kind, unit, (
-                    self._weight(i, "weights"),
-                    self._weight(i, "bias"),
-                    self._weight(i, "weights_out"),
-                    self._weight(i, "bias_out")),
+                    f"layer{i}_weights", f"layer{i}_bias",
+                    f"layer{i}_weights_out", f"layer{i}_bias_out"),
                     aux={"k": k_idx, "v": k_idx + 1}))
             elif kind == "lstm":
                 h_idx = len(cache_specs)
                 cache_specs.append((f"l{i}.h", (unit.units,)))
                 cache_specs.append((f"l{i}.c", (unit.units,)))
                 plan.append(_Op(kind, unit, (
-                    self._weight(i, "weights"),
-                    self._weight(i, "bias")),
+                    f"layer{i}_weights", f"layer{i}_bias"),
                     aux={"h": h_idx, "c": h_idx + 1}))
                 d = unit.units
                 if not unit.return_sequence:
@@ -292,8 +305,7 @@ class DecodeModel(Logger):
                         f"and cannot decode; bridge with last_token "
                         f"first")
                 plan.append(_Op(kind, unit, (
-                    self._weight(i, "weights"),
-                    self._weight(i, "bias"))))
+                    f"layer{i}_weights", f"layer{i}_bias")))
             else:
                 raise ValueError(
                     f"layer {i} ({kind}): no incremental decode step "
@@ -314,35 +326,38 @@ class DecodeModel(Logger):
     # ------------------------------------------------------------------
     # traced bodies
     # ------------------------------------------------------------------
-    def _head(self, op: _Op, x, final: bool):
+    def _head(self, op: _Op, w, x, final: bool):
         """One head layer on (B, D) features; the final softmax layer
         returns raw logits (softmax is monotone — greedy unchanged,
         and sampling normalizes on the host)."""
         import jax.numpy as jnp
-        w, b = op.w
+        weights, b = w
         if final:
-            return op.unit._logits(jnp, x, w, b)
-        return op.unit._forward(jnp, x, w, b)
+            return op.unit._logits(jnp, x, weights, b)
+        return op.unit._forward(jnp, x, weights, b)
 
     def _prefill_fn(self, t_bucket: int):
-        """The traced prefill body for one prompt-length bucket."""
+        """The traced prefill body for one prompt-length bucket.
+        ``weights`` is the per-op operand pytree — an argument, not a
+        baked constant, so a hot-swap never invalidates the program."""
         import jax
         import jax.numpy as jnp
         plan = self._plan
 
-        def fn(caches, tokens, slot, length):
+        def fn(caches, weights, tokens, slot, length):
             # tokens (1, t_bucket) int32; slot, length () int32
             caches = list(caches)
             feat = None
             logits = None
-            for op in plan:
+            for j, op in enumerate(plan):
+                w = weights[j]
                 if op.kind == "embedding":
-                    feat = op.unit.xla_embed(op.w[0], tokens)
+                    feat = op.unit.xla_embed(w[0], tokens)
                 elif op.kind == "pos_encoding":
                     feat = (feat.astype(jnp.float32)
                             + op.table[:t_bucket][None])
                 elif op.kind == "attention":
-                    feat, k, v = op.unit.xla_prefill(feat, *op.w)
+                    feat, k, v = op.unit.xla_prefill(feat, *w)
                     zero = jnp.int32(0)
                     caches[op.aux["k"]] = jax.lax.dynamic_update_slice(
                         caches[op.aux["k"]], k, (slot, zero, zero, zero))
@@ -350,7 +365,7 @@ class DecodeModel(Logger):
                         caches[op.aux["v"]], v, (slot, zero, zero, zero))
                 elif op.kind == "lstm":
                     feat, h, c = op.unit.xla_prefill(
-                        feat, *op.w, length=jnp.reshape(length, (1,)))
+                        feat, *w, length=jnp.reshape(length, (1,)))
                     caches[op.aux["h"]] = \
                         caches[op.aux["h"]].at[slot].set(h[0])
                     caches[op.aux["c"]] = \
@@ -360,7 +375,7 @@ class DecodeModel(Logger):
                     feat = jax.lax.dynamic_index_in_dim(
                         feat, length - 1, axis=1, keepdims=False)
                 else:  # head layer
-                    logits = self._head(op, feat, op is plan[-1])
+                    logits = self._head(op, w, feat, op is plan[-1])
                     feat = logits
             return tuple(caches), logits
         return fn
@@ -369,17 +384,17 @@ class DecodeModel(Logger):
         """The traced single-token body for one live-batch bucket."""
         plan = self._plan
 
-        def fn(caches, tokens, slots, positions):
+        def fn(caches, weights, tokens, slots, positions):
             # tokens/slots/positions: (b_bucket,) int32
             import jax.numpy as jnp
             caches = list(caches)
             rows = jnp.arange(b_bucket)
             feat = None
             logits = None
-            for op in plan:
+            for j, op in enumerate(plan):
+                w = weights[j]
                 if op.kind == "embedding":
-                    feat = op.unit.xla_embed(op.w[0],
-                                             tokens)[:, None, :]
+                    feat = op.unit.xla_embed(w[0], tokens)[:, None, :]
                 elif op.kind == "pos_encoding":
                     feat = op.unit.xla_decode_step(feat, positions,
                                                    op.table)
@@ -387,7 +402,7 @@ class DecodeModel(Logger):
                     k_rows = caches[op.aux["k"]][slots]
                     v_rows = caches[op.aux["v"]][slots]
                     feat, k_rows, v_rows = op.unit.xla_decode_step(
-                        feat, k_rows, v_rows, positions, *op.w)
+                        feat, k_rows, v_rows, positions, *w)
                     # only position `pos` changed per lane: scatter the
                     # new row back, padded lanes land in the scratch
                     # slot (duplicate-index writes there are garbage
@@ -400,7 +415,7 @@ class DecodeModel(Logger):
                     h = caches[op.aux["h"]][slots]
                     c = caches[op.aux["c"]][slots]
                     feat, h, c = op.unit.xla_decode_step(
-                        feat, h, c, *op.w)
+                        feat, h, c, *w)
                     caches[op.aux["h"]] = \
                         caches[op.aux["h"]].at[slots].set(h)
                     caches[op.aux["c"]] = \
@@ -412,7 +427,7 @@ class DecodeModel(Logger):
                 else:
                     if feat.ndim == 3:  # head after a seq-phase bridge
                         feat = feat[:, 0]
-                    logits = self._head(op, feat, op is plan[-1])
+                    logits = self._head(op, w, feat, op is plan[-1])
                     feat = logits
             return tuple(caches), logits
         return fn
@@ -436,6 +451,15 @@ class DecodeModel(Logger):
         return tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
                      for a in self.cache.arrays)
 
+    def _weight_structs(self) -> tuple:
+        import jax
+        return tuple(tuple(
+            None if a is None
+            else jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                      sharding=getattr(a, "sharding",
+                                                       None))
+            for a in ws) for ws in self._weights)
+
     def prefill_program(self, t_bucket: int):
         """The AOT prefill program for one prompt-length bucket
         (compiled on first use; :meth:`warmup` front-loads the whole
@@ -446,7 +470,7 @@ class DecodeModel(Logger):
             i32 = np.dtype(np.int32)
             prog = self._compile(
                 self._prefill_fn(t_bucket),
-                (self._cache_structs(),
+                (self._cache_structs(), self._weight_structs(),
                  jax.ShapeDtypeStruct((1, t_bucket), i32),
                  jax.ShapeDtypeStruct((), i32),
                  jax.ShapeDtypeStruct((), i32)),
@@ -459,11 +483,11 @@ class DecodeModel(Logger):
         prog = self._decode_programs.get(b_bucket)
         if prog is None:
             import jax
-            i32 = np.dtype(np.int32)
             vec = jax.ShapeDtypeStruct((b_bucket,), np.dtype(np.int32))
             prog = self._compile(
                 self._decode_fn(b_bucket),
-                (self._cache_structs(), vec, vec, vec),
+                (self._cache_structs(), self._weight_structs(),
+                 vec, vec, vec),
                 "serving-decode")
             self._decode_programs[b_bucket] = prog
         return prog
@@ -504,7 +528,7 @@ class DecodeModel(Logger):
         padded = np.zeros((1, t_b), np.int32)
         padded[0, :n] = tokens
         prog = self.prefill_program(t_b)
-        caches, logits = prog(self.cache.arrays, padded,
+        caches, logits = prog(self.cache.arrays, self._weights, padded,
                               np.asarray(slot, np.int32),
                               np.asarray(n, np.int32))
         self.cache.arrays = caches
@@ -526,10 +550,79 @@ class DecodeModel(Logger):
 
         prog = self.decode_program(b_b)
         caches, logits = prog(
-            self.cache.arrays, padded(tokens, 0),
+            self.cache.arrays, self._weights, padded(tokens, 0),
             padded(slots, self.cache.trash_slot), padded(positions, 0))
         self.cache.arrays = caches
         return np.asarray(logits, np.float32)[:n]
+
+    # ------------------------------------------------------------------
+    # weight hot-swap (round 13)
+    # ------------------------------------------------------------------
+    def check_compatible(self, manifest: dict | None,
+                         params: dict) -> None:
+        """Validate a candidate against the planned chain; raises
+        :class:`~znicz_tpu.export.SwapIncompatible` with the incumbent
+        untouched on any mismatch."""
+        from znicz_tpu.export import SwapIncompatible
+        if manifest is not None:
+            mine = [layer["type"] for layer
+                    in self.model.manifest["layers"]]
+            theirs = [layer["type"] for layer
+                      in manifest.get("layers", [])]
+            if mine != theirs:
+                raise SwapIncompatible(
+                    f"candidate layer table {theirs} != decode chain "
+                    f"{mine}")
+        for op, ws in zip(self._plan, self._weights):
+            for key, cur in zip(op.wkeys, ws):
+                new = params.get(key)
+                if cur is None:
+                    if new is not None:
+                        raise SwapIncompatible(
+                            f"{key}: candidate carries a parameter "
+                            f"the compiled programs have no operand "
+                            f"for")
+                    continue
+                if new is None:
+                    raise SwapIncompatible(
+                        f"candidate is missing parameter '{key}'")
+                if tuple(np.shape(new)) != tuple(cur.shape):
+                    raise SwapIncompatible(
+                        f"{key}: candidate shape "
+                        f"{tuple(np.shape(new))} != compiled "
+                        f"{tuple(cur.shape)}")
+
+    def swap_weights(self, params: dict,
+                     manifest: dict | None = None) -> int:
+        """Replace the weight operand pytree without recompiling:
+        validate → stage (device_put onto each leaf's existing
+        placement, fenced) → publish the new immutable tuple in one
+        assignment.  The caller (:meth:`DecodeEngine.swap_weights`)
+        guarantees no decode step is mid-flight when the flip lands —
+        slots carrying old-model generations drain first."""
+        import jax
+        self.check_compatible(manifest, params)
+        staged = []
+        for op, ws in zip(self._plan, self._weights):
+            new_ws = []
+            for key, cur in zip(op.wkeys, ws):
+                if cur is None:
+                    new_ws.append(None)
+                    continue
+                new = np.asarray(params[key], np.float32)
+                sharding = getattr(cur, "sharding", None)
+                arr = (jax.device_put(new, sharding)
+                       if sharding is not None else jax.device_put(new))
+                new_ws.append(arr)
+                self.model._params[key] = new
+            staged.append(tuple(new_ws))
+        for ws in staged:  # fence before publishing
+            for a in ws:
+                if a is not None:
+                    a.block_until_ready()
+        self._weights = tuple(staged)
+        self.weights_version += 1
+        return self.weights_version
 
 
 class _PromptReq:
@@ -664,6 +757,17 @@ class DecodeEngine(Logger):
         self.warmup_seconds = 0.0
         self._thread: threading.Thread | None = None
         self._started = False
+        # hot-swap bookkeeping (round 13): a pending swap request the
+        # scheduler applies between token steps once old-model lanes
+        # drained (or the engine.swap_drain_ms bound expires)
+        self._swap_req: dict | None = None
+        self.model_version = 0
+        self._m_version = _metrics.model_version(self._obs_id)
+        self._m_version.set(0)
+        self._m_swap_dur = _metrics.swap_duration_seconds(self._obs_id)
+        self.swap_counts = {"promoted": 0, "rejected": 0,
+                            "rolled_back": 0}
+        self._swap_pauses: list[float] = []
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -768,6 +872,125 @@ class DecodeEngine(Logger):
                  **kwargs) -> np.ndarray:
         """Synchronous convenience: submit + wait."""
         return self.submit(prompt, **kwargs).result(timeout=timeout)
+
+    # ------------------------------------------------------------------
+    # weight hot-swap (round 13)
+    # ------------------------------------------------------------------
+    def current_bundle(self) -> tuple:
+        """The live ``(manifest, params)`` — the rollback target a
+        SwapController snapshots before promoting."""
+        return (self.model.model.manifest,
+                dict(self.model.model._params))
+
+    def swap_weights(self, state, *, version: int | None = None,
+                     drain_ms: float | None = None,
+                     timeout: float | None = None,
+                     outcome: str = "promoted") -> dict:
+        """Hot-swap the decode weights without recompiling.
+
+        In-flight generations belong to the OLD model: the scheduler
+        stops admitting new prompts, lets live KV-cache slots decode
+        to completion, and only then publishes the new weight pytree —
+        so no sequence ever mixes two models' logits.  Lanes still
+        live after ``drain_ms`` (default ``engine.swap_drain_ms``) are
+        evicted with their tokens-so-far rather than holding the swap
+        hostage.  Queued prompts are admitted AFTER the flip and
+        prefill against the new model.
+
+        Raises :class:`~znicz_tpu.export.SwapIncompatible` (validated
+        before any drain starts — the incumbent keeps serving)."""
+        from znicz_tpu.serving.engine import resolve_swap_state
+        from znicz_tpu.utils.config import root
+        manifest, params = resolve_swap_state(state)
+        # fail BEFORE draining anything: an incompatible candidate
+        # must not pause admission for even a millisecond
+        self.model.check_compatible(manifest, params)
+        if drain_ms is None:
+            drain_ms = float(root.common.engine.get(
+                "swap_drain_ms", 2000.0))
+        t0 = time.monotonic()
+        if not self._started:
+            self.model.swap_weights(params, manifest=manifest)
+            drain = {"drained": 0, "evicted": 0, "drain_ms": 0.0}
+        else:
+            fut: Future = Future()
+            with self._cond:
+                if self._swap_req is not None:
+                    raise RuntimeError(
+                        "a weight swap is already in progress")
+                self._swap_req = {
+                    "manifest": manifest, "params": params,
+                    "deadline": t0 + float(drain_ms) / 1e3,
+                    "future": fut, "t0": t0,
+                    "live0": len(self._live)}
+                self._cond.notify_all()
+            drain = fut.result(
+                timeout if timeout is not None
+                else max(60.0, float(drain_ms) / 1e3 + 60.0))
+        pause = time.monotonic() - t0
+        if version is None:
+            version = self.model_version + 1
+        self.model_version = int(version)
+        self._m_version.set(self.model_version)
+        self._m_swap_dur.observe(pause)
+        self._swap_pauses.append(pause)
+        self.record_swap_outcome(outcome)
+        self.info(
+            "decode weights hot-swapped → version %d (%s, %.1f ms "
+            "pause, %d lanes drained, %d evicted at the drain bound)",
+            self.model_version, outcome, 1e3 * pause,
+            drain.get("drained", 0), drain.get("evicted", 0))
+        return {"version": self.model_version, "outcome": outcome,
+                "pause_ms": round(1e3 * pause, 3),
+                "weights_version": self.model.weights_version,
+                **drain}
+
+    def record_swap_outcome(self, outcome: str) -> None:
+        self.swap_counts[outcome] = self.swap_counts.get(outcome, 0) + 1
+        _metrics.swaps_total(self._obs_id, outcome).inc()
+
+    def set_model_version(self, version: int) -> None:
+        """Label the CURRENTLY loaded bundle's published version."""
+        self.model_version = int(version)
+        self._m_version.set(self.model_version)
+
+    def swap_pauses_ms(self) -> list[float]:
+        return [1e3 * p for p in self._swap_pauses]
+
+    def _maybe_apply_swap(self, force: bool = False) -> None:
+        """Scheduler-thread half of the swap: once no old-model lane
+        is live (or the drain deadline / shutdown forces it), evict
+        stragglers with their tokens-so-far, flip the weight pytree,
+        and resume admission."""
+        req = self._swap_req
+        if req is None:
+            return
+        now = time.monotonic()
+        if self._live and not force and now < req["deadline"]:
+            return  # still draining old-model generations
+        evicted = 0
+        for s in self._live:  # drain bound hit: return tokens-so-far
+            self.model.cache.release(s.slot)
+            self._m_served.inc()
+            if not s.req.future.done():
+                s.req.future.set_result(
+                    np.asarray(s.generated, np.int32))
+            evicted += 1
+        self._live = []
+        self._m_slots.set(0)
+        try:
+            self.model.swap_weights(req["params"],
+                                    manifest=req["manifest"])
+        except Exception as exc:  # noqa: BLE001 — report to the caller
+            req["future"].set_exception(exc)
+        else:
+            req["future"].set_result({
+                "drained": req.get("live0", 0) - evicted,
+                "evicted": evicted,
+                "drain_ms": round(1e3 * (now - req["t0"]), 3)})
+        with self._cond:
+            self._swap_req = None
+            self._cond.notify_all()
 
     # ------------------------------------------------------------------
     # breaker (under _cond)
@@ -954,16 +1177,24 @@ class DecodeEngine(Logger):
             admit: list[_PromptReq] = []
             with self._cond:
                 while (not self._pending and not self._live
-                       and not self._stop):
+                       and not self._stop and self._swap_req is None):
                     self._cond.wait(timeout=0.25)
                     self._sweep_expired(time.monotonic())
                 if self._stop and not self._pending and not self._live:
+                    # a swap still pending at shutdown applies now —
+                    # its caller is blocked on the future
+                    self._maybe_apply_swap(force=True)
                     return
                 now = time.monotonic()
                 self._sweep_expired(now)
                 self._breaker_tick(now)
-                may_admit = (self.admission == "continuous"
-                             or not self._live)
+                # during a swap drain NOTHING is admitted: queued
+                # prompts wait for the flip and prefill against the
+                # NEW model — a slot freed by an old-model eviction
+                # never admits a new-model prompt early
+                may_admit = (self._swap_req is None
+                             and (self.admission == "continuous"
+                                  or not self._live))
                 # bound by the free-slot count HERE — slots are only
                 # acquired inside _admit, so the live count cannot
                 # gate this loop
@@ -975,6 +1206,7 @@ class DecodeEngine(Logger):
                 self._admit(req)
             if self._live:
                 self._step()
+            self._maybe_apply_swap()
 
     # ------------------------------------------------------------------
     # telemetry
@@ -1006,6 +1238,9 @@ class DecodeEngine(Logger):
             "submitted": int(self._m_submitted.value),
             "served": int(self._m_served.value),
             "rejected": int(self._m_rejected.value),
+            "model_version": self.model_version,
+            "weights_version": self.model.weights_version,
+            "swaps": dict(self.swap_counts),
             "tokens_prompt": int(self._m_tok_prompt.value),
             "tokens_generated": int(self._m_tok_gen.value),
             "live_slots": len(self._live),

@@ -57,7 +57,30 @@ from znicz_tpu.serving.buckets import bucket_for, ladder
 from znicz_tpu.utils.logger import Logger
 
 __all__ = ["ServingEngine", "QueueFull", "Overloaded",
-           "DeadlineExceeded"]
+           "DeadlineExceeded", "resolve_swap_state"]
+
+
+def resolve_swap_state(state) -> tuple:
+    """Normalize a swap source into ``(manifest, params)``.
+
+    Accepts a bundle path (digest-side verification is the
+    publication watcher's job — this just reads), an
+    :class:`~znicz_tpu.export.ExportedModel`, an already-read
+    ``(manifest, params)`` pair (what the watcher hands the
+    controller), or a plain ``{layer<i>_<attr>: array}`` dict (then
+    manifest is ``None`` and only shape validation applies)."""
+    from znicz_tpu.export import ExportedModel, read_bundle
+    if isinstance(state, ExportedModel):
+        return state.manifest, dict(state._params)
+    if isinstance(state, (str, bytes)) or hasattr(state, "__fspath__"):
+        return read_bundle(state)
+    if isinstance(state, tuple) and len(state) == 2 \
+            and isinstance(state[1], dict):
+        return state
+    if isinstance(state, dict):
+        return None, state
+    raise TypeError(f"cannot swap from {type(state).__name__}: pass a "
+                    f"bundle path, an ExportedModel or a params dict")
 
 #: distinguishes same-named engines in the registry's labels
 _ENGINE_SEQ = itertools.count()
@@ -156,6 +179,14 @@ class ServingEngine(Logger):
         self.warmup_compiles = 0
         self.warmup_seconds = 0.0
         self._started = False
+        # hot-swap bookkeeping (round 13)
+        self.model_version = 0
+        self._m_version = _metrics.model_version(self._obs_id)
+        self._m_version.set(0)
+        self._m_swap_dur = _metrics.swap_duration_seconds(self._obs_id)
+        self.swap_counts = {"promoted": 0, "rejected": 0,
+                            "rolled_back": 0}
+        self._swap_pauses: list[float] = []  # seconds, per swap
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -274,6 +305,68 @@ class ServingEngine(Logger):
             self._batcher.flush()
 
     # ------------------------------------------------------------------
+    # weight hot-swap (round 13)
+    # ------------------------------------------------------------------
+    def current_bundle(self) -> tuple:
+        """The live ``(manifest, params)`` — what a SwapController
+        snapshots as the rollback target before promoting a
+        candidate."""
+        return self.model.manifest, dict(self.model._params)
+
+    def swap_weights(self, state, *, version: int | None = None,
+                     outcome: str = "promoted") -> dict:
+        """Hot-swap the running replica set to a new weight set
+        without recompiling.
+
+        ``state`` is a bundle path, an ``ExportedModel`` or a params
+        dict (see :func:`resolve_swap_state`).  Shapes/dtypes are
+        validated against the export manifest first —
+        :class:`~znicz_tpu.export.SwapIncompatible` leaves the old
+        weights untouched.  New buffers stage onto the serving mesh
+        off the dispatch path, then publish atomically between batch
+        dispatches: in-flight requests finish on the old weights.
+
+        ``outcome`` labels the ``znicz_swaps_total`` event (the
+        controller passes ``rolled_back`` when this swap restores the
+        prior version).  Returns a summary dict."""
+        manifest, params = resolve_swap_state(state)
+        t0 = time.monotonic()
+        self.model.swap_weights(params, manifest=manifest)
+        pause = time.monotonic() - t0
+        if version is None:
+            version = self.model_version + 1
+        self.model_version = int(version)
+        self._m_version.set(self.model_version)
+        self._m_swap_dur.observe(pause)
+        self._swap_pauses.append(pause)
+        self.record_swap_outcome(outcome)
+        self.info("weights hot-swapped → version %d (%s, %.1f ms, "
+                  "zero recompiles by construction)",
+                  self.model_version, outcome, 1e3 * pause)
+        return {"version": self.model_version, "outcome": outcome,
+                "pause_ms": round(1e3 * pause, 3),
+                "weights_version": self.model.weights_version}
+
+    def record_swap_outcome(self, outcome: str) -> None:
+        """Count one swap verdict for this engine (the canary gate
+        calls this with ``rejected`` without ever touching the
+        weights)."""
+        self.swap_counts[outcome] = self.swap_counts.get(outcome, 0) + 1
+        _metrics.swaps_total(self._obs_id, outcome).inc()
+
+    def set_model_version(self, version: int) -> None:
+        """Label the CURRENTLY loaded bundle's published version (an
+        engine started straight from a published file was never
+        swapped, so the gauge would otherwise read 0)."""
+        self.model_version = int(version)
+        self._m_version.set(self.model_version)
+
+    def swap_pauses_ms(self) -> list[float]:
+        """Per-swap publish pauses (ms) — the soak bench reports their
+        percentiles."""
+        return [1e3 * p for p in self._swap_pauses]
+
+    # ------------------------------------------------------------------
     def _run_batch(self, batch) -> None:
         """Scheduler-thread dispatch: coalesce → pad → one AOT program
         → split replies.  Sole caller of the compiled programs, so the
@@ -300,7 +393,12 @@ class ServingEngine(Logger):
             row += req.n
         if row < size:
             buf[row:] = 0  # padded tail: never leaks, but keep it clean
-        out = np.asarray(self.model.program_for(size)(buf))
+        # pin the published weight tuple ONCE for this dispatch: a
+        # swap landing mid-batch flips live_params for the NEXT
+        # dispatch; this one completes on the weights it started with
+        params = self.model.live_params or None
+        out = np.asarray(self.model.program_for(size)(
+            buf, _params=params))
         now = time.monotonic()
         row = 0
         for req in batch:
@@ -365,6 +463,9 @@ class ServingEngine(Logger):
                 "submitted": self.requests_submitted,
                 "served": self.requests_served,
                 "rejected": self.requests_rejected,
+                "model_version": self.model_version,
+                "weights_version": self.model.weights_version,
+                "swaps": dict(self.swap_counts),
                 "queue_rows": (self._batcher.queue_rows
                                if self._batcher else 0),
                 "buckets": buckets,

@@ -183,6 +183,11 @@ class Snapshotter(Unit):
                 "snapshot remains %s", write_exc, self.destination)
             return
         self.destination = path
+        # round 13: feed the znicz_snapshot_age_seconds callback gauge
+        # — /readyz turns "no good snapshot lately" into staleness so
+        # a supervisor sees a stalled trainer as not-ready
+        from znicz_tpu.resilience.publisher import mark_artifact_written
+        mark_artifact_written(f"snapshot:{self.prefix}")
         if jax.process_index() == 0 and self.keep_last:
             self.prune(self.directory, self.prefix, self.keep_last,
                        keep=path)
@@ -291,15 +296,39 @@ class Snapshotter(Unit):
     @staticmethod
     def prune(directory: str, prefix: str, keep_last: int,
               keep: str | None = None) -> list[str]:
-        """Keep the ``keep_last`` newest ``<prefix>_*.pickle.gz``
+        """Keep the ``keep_last`` newest GOOD ``<prefix>_*.pickle.gz``
         snapshots (plus ``keep``, the one just written), delete the
-        rest with their sidecars; returns the deleted paths."""
+        rest with their sidecars; returns the deleted paths.
+
+        Round-13 race fix: keep-last accounting runs over files whose
+        sidecar digest VERIFIES (a file with no sidecar — the
+        crash-between-replace-and-sidecar window — counts as good,
+        matching :meth:`_load_verified`'s acceptance).  A corrupt file
+        must neither occupy a retention slot nor survive, because a
+        concurrent :meth:`load` falling back from it must always find
+        the newest good snapshot still on disk — previously ``keep_last``
+        mtime slots could all be consumed by corrupt files, deleting
+        the very snapshot a reader was about to fall back to."""
         files = glob.glob(os.path.join(directory,
                                        f"{prefix}_*.pickle.gz"))
         files.sort(key=os.path.getmtime, reverse=True)
+        good, bad = [], []
+        for path in files:
+            sidecar = f"{path}.sha256"
+            ok = True
+            try:
+                if os.path.exists(sidecar):
+                    with open(sidecar) as f:
+                        ok = _sha256_file(path) == f.read().strip()
+            except OSError:  # racing reader/pruner — leave it alone
+                continue
+            (good if ok else bad).append(path)
+        protected = {os.path.abspath(p) for p in good[:keep_last]}
+        if keep:
+            protected.add(os.path.abspath(keep))
         deleted = []
-        for path in files[keep_last:]:
-            if keep and os.path.abspath(path) == os.path.abspath(keep):
+        for path in bad + good[keep_last:]:
+            if os.path.abspath(path) in protected:
                 continue
             try:
                 os.unlink(path)

@@ -181,7 +181,16 @@ class WebStatusServer(Logger):
           staleness; not-ready when older than
           ``engine.ready_max_staleness_s`` (default unset =
           report-only, so a finished training run does not flip a
-          serving process to 503).
+          serving process to 503);
+        - ``znicz_model_version`` (round 13) — the live published
+          model version per serving engine, reported so a supervisor
+          can confirm which weights a replica is actually running;
+        - ``znicz_snapshot_age_seconds`` (round 13) — time since each
+          source (snapshotter prefix / publish directory) last wrote a
+          GOOD artifact; not-ready when it exceeds
+          ``engine.ready_max_snapshot_age_s`` (default unset =
+          report-only), so a stalled trainer that stopped publishing
+          shows up on the serving probe.
         """
         from znicz_tpu.observe import metrics
         from znicz_tpu.utils.config import root
@@ -222,6 +231,24 @@ class WebStatusServer(Logger):
                 if max_stale is not None and stale > float(max_stale):
                     not_ready(f"workflow {workflow} last step "
                               f"{stale:.0f}s ago")
+        fam = metrics.REGISTRY.get("znicz_model_version")
+        if fam is not None:
+            for key, child in fam.items():
+                (engine,) = key
+                out["engines"].setdefault(engine, {})[
+                    "model_version"] = int(child.value)
+        fam = metrics.REGISTRY.get("znicz_snapshot_age_seconds")
+        max_snap = root.common.engine.get("ready_max_snapshot_age_s",
+                                          None)
+        if fam is not None:
+            out["artifacts"] = {}
+            for key, child in fam.items():
+                (source,) = key
+                age = round(float(child.value), 3)
+                out["artifacts"][source] = {"age_s": age}
+                if max_snap is not None and age > float(max_snap):
+                    not_ready(f"no good artifact from {source} for "
+                              f"{age:.0f}s")
         return out
 
     # ------------------------------------------------------------------
