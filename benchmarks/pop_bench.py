@@ -1,0 +1,260 @@
+"""Population-vs-baseline A/B (ROADMAP item 5 done bar) → POP_BENCH.json.
+
+Equal-wall-clock comparison on real UCI Wine, hard split (48-sample
+training budget, 130-sample validation — the regime where the sample's
+tuned learning rate saturates above the attainable floor and rate
+choice moves it):
+
+- **baseline arm** — ONE model at the wine sample's tuned learning
+  rate (0.3), trained for the full wall-clock budget (it converges and
+  plateaus long before the budget runs out; the budget is generous to
+  the baseline, not a handicap);
+- **population arm** — K=16 replicas of the same architecture training
+  SIMULTANEOUSLY in one vmapped jit region on the 8-device mesh
+  (member axis sharded over the data axis: 2 members/chip), initial
+  learning rates log-uniform over the search range, PBT
+  exploit/explore truncation every 3 epochs.  Same wall-clock budget,
+  measured over initialize + compile + training + evolution.
+
+The row also attests the two population-engine invariants the
+acceptance bar names:
+
+- ``bitwise_oracle_ok`` — a K=3 no-evolution population re-run is
+  compared leaf-by-leaf against 3 independent sequential runs
+  (weights bitwise after 2 epochs);
+- ``warmed_step_compiles`` — one extra population step after the run
+  must add ZERO entries to ``znicz_xla_compiles_total``.
+
+Usage: ``python benchmarks/pop_bench.py [budget_seconds]``
+Writes POP_BENCH.json (override with POP_BENCH_OUT=<path>; empty
+disables) and exits 1 unless the population's best validation error
+is strictly below the baseline's.  ``POP_TPU=1`` keeps the ambient
+platform for a chip row (queued — no chip in this container).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+if os.environ.get("POP_TPU") != "1":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
+    except (RuntimeError, AttributeError):
+        pass
+
+import numpy as np  # noqa: E402
+
+N_TRAIN = 48          # hard split: tuned baseline saturates at ~3.9%
+MINIBATCH = 8
+K = 16
+BASELINE_LR = 0.3     # the wine sample's tuned default
+LR_RANGE = (0.05, 1.5)
+SEED = 1234
+
+
+def _wine():
+    from znicz_tpu import datasets
+    return datasets.load_wine()
+
+
+def make_build(data, labels):
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+
+    def build(learning_rate=BASELINE_LR, max_epochs=10 ** 6, **kw):
+        from znicz_tpu.loader.fullbatch import ArrayLoader
+        return StandardWorkflow(
+            name="pop_bench_wine",
+            loader_factory=lambda w: ArrayLoader(
+                w, train_data=data[:N_TRAIN],
+                train_labels=labels[:N_TRAIN],
+                valid_data=data[N_TRAIN:],
+                valid_labels=labels[N_TRAIN:],
+                minibatch_size=MINIBATCH),
+            layers=[{"type": "all2all_tanh",
+                     "->": {"output_sample_shape": 8},
+                     "<-": {"learning_rate": learning_rate}},
+                    {"type": "softmax",
+                     "->": {"output_sample_shape": 3},
+                     "<-": {"learning_rate": learning_rate}}],
+            decision_config={"max_epochs": max_epochs,
+                             "fail_iterations": 10 ** 6})
+
+    return build
+
+
+def run_baseline(build, budget_s: float) -> dict:
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.utils import prng
+    prng.seed_all(SEED)
+    wf = build(learning_rate=BASELINE_LR)
+    wf._max_fires = 10 ** 7
+    t0 = time.perf_counter()
+    wf.initialize(device=XLADevice())
+    epochs = 0
+    while time.perf_counter() - t0 < budget_s:
+        start = wf.loader.epoch_number
+        while wf.loader.epoch_number == start:
+            wf.loader.run()
+            wf._region_unit.run()
+            wf.decision.run()
+        epochs += 1
+    wall = time.perf_counter() - t0
+    return {
+        "learning_rate": BASELINE_LR,
+        "epochs": epochs,
+        "wall_s": round(wall, 3),
+        "min_val_err_pt": round(
+            float(wf.decision.min_validation_n_err_pt), 4),
+        "min_val_errs": int(wf.decision.min_validation_n_err),
+    }
+
+
+def run_population(build, budget_s: float) -> dict:
+    from znicz_tpu.observe import metrics as obs_metrics
+    from znicz_tpu.parallel import make_mesh
+    from znicz_tpu.population import PopulationTrainer
+    mesh = make_mesh(n_data=8, n_model=1)
+    rng = np.random.default_rng(5)
+    lrs = np.exp(rng.uniform(np.log(LR_RANGE[0]), np.log(LR_RANGE[1]),
+                             size=K))
+    t0 = time.perf_counter()
+    trainer = PopulationTrainer(
+        build, K, base_seed=SEED, mesh=mesh, member_lrs=list(lrs),
+        lr_bounds=(0.02, 2.0), evolve="pbt", evolve_every=3,
+        truncation=0.25, seed=3, name="pop_bench")
+    trainer.initialize()
+    epochs = 0
+    while time.perf_counter() - t0 < budget_s:
+        fitness = trainer.run_epoch()
+        epochs += 1
+        if epochs % 3 == 0:
+            trainer.evolve_generation(fitness)
+    wall = time.perf_counter() - t0
+    compiles = obs_metrics.xla_compiles("population:pop_bench")
+    warmed = compiles.value
+    trainer.region.step()
+    warmed_delta = int(compiles.value - warmed)
+    best_member = int(np.argmax(trainer.member_best_fitness))
+    final_lrs = trainer.region.member_lrs()
+    w_sv = trainer.region.svec(trainer.template.forwards[0].weights)
+    shards = len(w_sv.devmem.sharding.device_set)
+    return {
+        "members": K,
+        "mesh": {"data": 8, "model": 1},
+        "member_axis_devices": shards,
+        "epochs": epochs,
+        "generations": trainer.generations,
+        "wall_s": round(wall, 3),
+        "best_val_err_pt": round(
+            float(-np.max(trainer.member_best_fitness)), 4),
+        "best_member": best_member,
+        "best_member_final_lr": round(float(final_lrs[best_member]), 4),
+        "lr_span_final": [round(float(np.min(final_lrs)), 4),
+                          round(float(np.max(final_lrs)), 4)],
+        "warmed_step_compiles": warmed_delta,
+    }
+
+
+def check_bitwise_oracle(build) -> bool:
+    """K=3, 2 epochs, no evolution: the vmapped population step must
+    reproduce 3 independent sequential runs' weights BITWISE."""
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.population import PopulationTrainer
+    from znicz_tpu.utils import prng
+    oracle = []
+    for i in range(3):
+        prng.seed_all(SEED + i)
+        wf = build(learning_rate=0.2, max_epochs=2)
+        wf._max_fires = 10 ** 7
+        wf.initialize(device=XLADevice())
+        wf.run()
+        oracle.append([np.array(np.asarray(f.weights), copy=True)
+                       for f in wf.forwards if f.weights])
+    trainer = PopulationTrainer(
+        build, 3, base_seed=SEED,
+        build_kwargs={"learning_rate": 0.2}, evolve=None,
+        name="pop_bench_oracle")
+    trainer.initialize()
+    trainer.run(2)
+    for i in range(3):
+        for li, fwd in enumerate(
+                f for f in trainer.template.forwards if f.weights):
+            got = np.asarray(trainer.region.read_leaf(fwd.weights)[i])
+            if not np.array_equal(got, oracle[i][li]):
+                return False
+    return True
+
+
+def main() -> int:
+    budget = float(sys.argv[1]) if len(sys.argv) > 1 else 3.0
+    data, labels = _wine()
+    build = make_build(data, labels)
+    print(f"pop_bench: UCI Wine hard split (train={N_TRAIN}, "
+          f"valid={len(data) - N_TRAIN}), budget {budget:.1f}s/arm")
+    baseline = run_baseline(build, budget)
+    print(f"  baseline  lr={BASELINE_LR}: "
+          f"{baseline['min_val_err_pt']:.2f}% "
+          f"({baseline['min_val_errs']} errs) over "
+          f"{baseline['epochs']} epochs in {baseline['wall_s']}s")
+    population = run_population(build, budget)
+    print(f"  population K={K}: {population['best_val_err_pt']:.2f}% "
+          f"over {population['epochs']} epochs / "
+          f"{population['generations']} generations in "
+          f"{population['wall_s']}s "
+          f"(best lr {population['best_member_final_lr']}, "
+          f"warmed_step_compiles={population['warmed_step_compiles']})")
+    bitwise_ok = check_bitwise_oracle(build)
+    print(f"  bitwise oracle (K=3, 2 epochs vs sequential): "
+          f"{'OK' if bitwise_ok else 'FAIL'}")
+
+    platform = jax.devices()[0].platform
+    row = {
+        "bench": "population_vs_tuned_baseline",
+        "date": time.strftime("%Y-%m-%d"),
+        "platform": platform,
+        "task": {"dataset": "uci_wine", "n_train": N_TRAIN,
+                 "n_valid": int(len(data) - N_TRAIN),
+                 "minibatch": MINIBATCH,
+                 "layers": "tanh8-softmax3"},
+        "budget_s": budget,
+        "baseline": baseline,
+        "population": population,
+        "bitwise_oracle_ok": bitwise_ok,
+        "population_beats_baseline": bool(
+            population["best_val_err_pt"]
+            < baseline["min_val_err_pt"]),
+        "notes": (
+            "equal wall-clock per arm incl. compile; population = one "
+            "vmapped jit region, member axis sharded over the 8-dev "
+            "virtual CPU mesh; chip row queued (POP_TPU=1) — no chip "
+            "in this container"),
+    }
+    out = os.environ.get("POP_BENCH_OUT", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "POP_BENCH.json"))
+    if out:
+        with open(out, "w") as fh:
+            json.dump(row, fh, indent=2)
+        print(f"  wrote {out}")
+    ok = (row["population_beats_baseline"] and bitwise_ok
+          and population["warmed_step_compiles"] == 0)
+    if not ok:
+        print("pop_bench: ACCEPTANCE FAILED", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

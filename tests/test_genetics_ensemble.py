@@ -69,6 +69,55 @@ def test_ga_caches_fitness_calls():
     assert calls["n"] < 6 * 4
 
 
+def test_train_fitness_restores_config_leaves():
+    """Regression (round 14): a candidate's dotted-key config writes
+    must not outlive its evaluation — the Tune leaf the space was
+    collected from comes back after each ``_train_fitness`` call."""
+    from znicz_tpu.backends import NumpyDevice
+    from znicz_tpu.models.samples.wine import build
+
+    root.wine.learning_rate = Tune(0.3, 0.05, 0.8)
+    opt = GeneticsOptimizer(
+        build_fn=build,
+        space={"wine.learning_rate": Tune(0.3, 0.05, 0.8)},
+        population_size=2, generations=1, seed=7,
+        device_factory=NumpyDevice,
+        train_kwargs={"max_epochs": 1})
+    opt._train_fitness({"wine.learning_rate": 0.11})
+    leaf = root.wine.learning_rate
+    assert isinstance(leaf, Tune), (
+        f"candidate lr 0.11 leaked into root after evaluation: {leaf}")
+
+
+def test_ga_run_leaves_best_genome_in_root():
+    """After ``run()`` the config tree holds the BEST genome's values
+    (callers build the final model straight off root), not whatever
+    candidate happened to be evaluated last."""
+    from znicz_tpu.backends import NumpyDevice
+    from znicz_tpu.models.samples.wine import build
+
+    opt = GeneticsOptimizer(
+        build_fn=build,
+        space={"wine.learning_rate": Tune(0.3, 0.05, 0.8)},
+        population_size=3, generations=2, seed=7,
+        device_factory=NumpyDevice,
+        train_kwargs={"max_epochs": 2})
+    best = opt.run()
+    assert root.wine.learning_rate == best["wine.learning_rate"]
+
+
+def test_snapshot_restore_handles_missing_leaves():
+    from znicz_tpu.genetics import (restore_genome_leaves,
+                                    snapshot_genome_leaves)
+
+    genome = {"gen_leak.fresh.leaf": 3.5, "plain_kwarg": 1}
+    snap = snapshot_genome_leaves(genome)
+    apply_genome(genome)
+    assert root.gen_leak.fresh.leaf == 3.5
+    restore_genome_leaves(snap)
+    assert "leaf" not in root.gen_leak.fresh.__dict__
+
+
 def test_ga_trains_wine():
     """End-to-end: a 2-generation GA over the Wine sample (numpy
     backend so it stays fast)."""

@@ -166,6 +166,29 @@ class XLADevice(Device):
             return replicated_sharding(self.mesh)
         model_dim = getattr(vector, "model_shard_dim", None)
         data_dim = getattr(vector, "data_shard_dim", None)
+        member = getattr(vector, "member_axis", False)
+        if member:
+            # population-stacked buffer: dim 0 is the member axis and
+            # rides the mesh's data axis — in population mode the K
+            # model replicas ARE the data parallelism.  A member count
+            # that does not divide the axis stays replicated (XLA
+            # time-slices the members instead of sharding them).
+            if vector.batch_major or data_dim is not None:
+                raise ValueError(
+                    f"Vector '{vector.name}': member_axis buffers "
+                    f"cannot also be batch_major / ZeRO-1 data-sharded"
+                    f" — the member axis owns the data axis")
+            if model_dim == 0:
+                raise ValueError(
+                    f"Vector '{vector.name}': dim 0 is the member "
+                    f"axis — it cannot also carry the model axis")
+            ndim = len(vector.shape)
+            spec = [None] * ndim
+            if ndim and vector.shape[0] % self.n_data_shards == 0:
+                spec[0] = DATA_AXIS
+            if model_dim is not None:
+                spec[model_dim] = MODEL_AXIS
+            return NamedSharding(self.mesh, PartitionSpec(*spec))
         if not vector.batch_major and model_dim is None \
                 and data_dim is None:
             return replicated_sharding(self.mesh)

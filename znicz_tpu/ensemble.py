@@ -79,27 +79,64 @@ class Ensemble(Logger):
     n_models / base_seed:
         member *i* trains with PRNG seed ``base_seed + i`` — different
         weight init and shuffle streams, same dataset split.
+    backend:
+        ``"process"`` (default — members train sequentially, or
+        process-sharded under ``jax.distributed``) or ``"mesh"`` — all
+        N members train SIMULTANEOUSLY as one stacked population in a
+        single vmapped jit region (member axis sharded over ``mesh``'s
+        data axis), each member bitwise-identical to the sequential
+        run its seed would produce; the aggregate pass reads all N
+        members' class probabilities from one stacked forward.
     """
 
     def __init__(self, build_fn: Callable, n_models: int = 3,
                  base_seed: int = 1234,
                  device_factory: Callable | None = None,
-                 train_kwargs: dict | None = None) -> None:
+                 train_kwargs: dict | None = None,
+                 backend: str = "process",
+                 mesh=None) -> None:
         super().__init__()
         if n_models < 1:
             raise ValueError("n_models must be >= 1")
+        if backend not in ("process", "mesh"):
+            raise ValueError(f"unknown ensemble backend '{backend}'")
         self.build_fn = build_fn
         self.n_models = int(n_models)
         self.base_seed = int(base_seed)
         self.device_factory = device_factory
         self.train_kwargs = dict(train_kwargs or {})
+        self.backend = backend
+        self.mesh = mesh
+        self.trainer = None                 # mesh backend's population
         self.workflows: list = []           # members trained locally
         self.member_ids: list[int] = []     # their GLOBAL member indices
         self.member_stats: list[dict] = []  # ALL members (gathered)
 
     # ------------------------------------------------------------------
+    def _train_stacked(self) -> "Ensemble":
+        """Mesh backend: one population run trains every member."""
+        from znicz_tpu.population import PopulationTrainer
+        trainer = PopulationTrainer(
+            self.build_fn, self.n_models,
+            member_seeds=[self.base_seed + i
+                          for i in range(self.n_models)],
+            build_kwargs=dict(self.train_kwargs),
+            mesh=self.mesh, evolve=None, name="ensemble")
+        trainer.initialize()
+        trainer.run()
+        self.trainer = trainer
+        self.member_ids = list(range(self.n_models))
+        self.member_stats = [
+            {"seed": self.base_seed + i,
+             "validation_err_pt": float(-trainer.member_best_fitness[i])}
+            for i in range(self.n_models)]
+        self.info("stacked ensemble trained: %s", self.member_stats)
+        return self
+
     def train(self) -> "Ensemble":
         from znicz_tpu.utils import prng
+        if self.backend == "mesh":
+            return self._train_stacked()
         pidx, pcount = process_info()
         self.workflows = []
         self.member_ids = []
@@ -169,6 +206,55 @@ class Ensemble(Logger):
         "its own prng_name) so every member sees the same sample at "
         "the same global index")
 
+    def _evaluate_stacked(self, klass: int) -> dict:
+        """Mesh backend aggregate pass: every ``klass`` minibatch runs
+        ONCE through the stacked eval-variant region and all N
+        members' probabilities come back as one (N, batch, classes)
+        read — the aggregate pass costs one schedule sweep, not one
+        per member.  Non-train segments ride natural order identically
+        across members, so labels agree by construction."""
+        if self.trainer is None:
+            raise RuntimeError("train() first")
+        region = self.trainer.region
+        wf = self.trainer.template
+        loader = wf.loader
+        out_vec = wf.forwards[-1].output
+        sum_probs: dict[int, np.ndarray] = {}
+        labels: dict[int, int] = {}
+        member_err_counts = np.zeros(self.n_models, dtype=np.int64)
+        for pos, (cls, lo, hi) in enumerate(loader._schedule):
+            if cls != klass:
+                continue
+            region.run_schedule_entry(pos)
+            probs = np.array(region.read_leaf(out_vec),
+                             dtype=np.float64)        # (N, B, C)
+            idx = np.asarray(
+                region.read_leaf(loader.minibatch_indices)[0])
+            labs = np.asarray(
+                region.read_leaf(loader.minibatch_labels)[0])
+            count = hi - lo
+            pm = probs[:, :count, :]
+            pred = pm.argmax(axis=2)
+            member_err_counts += (
+                pred != labs[None, :count]).sum(axis=1)
+            for row in range(count):
+                gi = int(idx[row])
+                labels[gi] = int(labs[row])
+                sum_probs[gi] = pm[:, row, :].sum(axis=0)
+        if not sum_probs:
+            raise ValueError(f"loader has no class-{klass} samples")
+        ens_errs = sum(
+            1 for gi, probs in sum_probs.items()
+            if int(np.argmax(probs)) != labels[gi])
+        result = {
+            "n_samples": len(sum_probs),
+            "member_err_pt": [100.0 * int(c) / len(sum_probs)
+                              for c in member_err_counts],
+            "ensemble_err_pt": 100.0 * ens_errs / len(sum_probs),
+        }
+        self.info("stacked ensemble eval: %s", result)
+        return result
+
     def evaluate(self, klass: int = VALID) -> dict:
         """Aggregate evaluation on ``klass`` minibatches.
 
@@ -176,12 +262,14 @@ class Ensemble(Logger):
         (averaged class probabilities → argmax).  Multi-process: every
         process contributes its local members' probability sums and
         receives the identical merged result."""
+        if klass == TRAIN:
+            raise ValueError("evaluate on VALID or TEST, not TRAIN")
+        if self.backend == "mesh":
+            return self._evaluate_stacked(klass)
         pidx, pcount = process_info()
         trained = self.workflows if pcount == 1 else self.member_stats
         if not trained:
             raise RuntimeError("train() first")
-        if klass == TRAIN:
-            raise ValueError("evaluate on VALID or TEST, not TRAIN")
         sum_probs: dict[int, np.ndarray] = {}
         labels: dict[int, int] = {}
         member_errs: list[float] = []

@@ -80,7 +80,14 @@ def collect_tunes(node, prefix: str = "") -> dict[str, Tune]:
 
 def apply_genome(genome: dict[str, Any]) -> dict[str, Any]:
     """Split a genome into build-kwargs (plain keys) and config-tree
-    writes (dotted keys, applied to ``root`` immediately)."""
+    writes (dotted keys, applied to ``root`` immediately).
+
+    The writes are global state: callers that evaluate MANY genomes
+    (the GA's fitness loop) must bracket each evaluation with
+    :func:`snapshot_genome_leaves` / :func:`restore_genome_leaves`, or
+    the tree keeps whatever candidate ran last —
+    :meth:`GeneticsOptimizer.run` restores around every evaluation and
+    re-applies the BEST genome on exit."""
     from znicz_tpu.utils.config import root
     kwargs = {}
     for key, value in genome.items():
@@ -93,6 +100,42 @@ def apply_genome(genome: dict[str, Any]) -> dict[str, Any]:
         else:
             kwargs[key] = value
     return kwargs
+
+
+#: sentinel for "this leaf did not exist before apply_genome"
+_MISSING = object()
+
+
+def snapshot_genome_leaves(genome: dict[str, Any]) -> dict[str, Any]:
+    """Current values of the genome's dotted config leaves (the state
+    :func:`apply_genome` is about to clobber — typically the ``Tune``
+    objects the search space was collected from)."""
+    from znicz_tpu.utils.config import root
+    snap: dict[str, Any] = {}
+    for key in genome:
+        if "." not in key:
+            continue
+        node = root
+        parts = key.split(".")
+        for part in parts[:-1]:
+            node = getattr(node, part)
+        snap[key] = node.__dict__.get(parts[-1], _MISSING)
+    return snap
+
+
+def restore_genome_leaves(snapshot: dict[str, Any]) -> None:
+    """Undo :func:`apply_genome`'s config-tree writes: put back the
+    snapshotted values, deleting leaves that did not exist."""
+    from znicz_tpu.utils.config import root
+    for key, value in snapshot.items():
+        node = root
+        parts = key.split(".")
+        for part in parts[:-1]:
+            node = getattr(node, part)
+        if value is _MISSING:
+            node.__dict__.pop(parts[-1], None)
+        else:
+            setattr(node, parts[-1], value)
 
 
 def workflow_fitness(workflow) -> float:
@@ -120,6 +163,18 @@ class GeneticsOptimizer(Logger):
         ``callable(genome) -> float`` (higher is better).  Default:
         build + train the workflow and return
         ``-min_validation_n_err_pt`` (or ``-min_validation_mse``).
+    backend:
+        ``"process"`` (default — one sequential training per fresh
+        genome; scales out process-sharded under ``jax.distributed``,
+        the multi-host path) or ``"mesh"`` — score a WHOLE generation
+        in one population run: K stacked replicas of the architecture
+        train simultaneously in one vmapped jit region (member axis
+        sharded over ``mesh``'s data axis), each member carrying its
+        genome's learning rate as a device leaf.  The mesh backend
+        requires ``build_fn`` and a single-key search space named
+        ``learning_rate`` (or any dotted path ending in it) — the one
+        hyperparameter that is per-member device state; anything that
+        changes the architecture still needs the process backend.
     """
 
     def __init__(self, build_fn: Callable | None = None,
@@ -132,10 +187,32 @@ class GeneticsOptimizer(Logger):
                  seed: int = 1234,
                  fitness_fn: Callable[[dict], float] | None = None,
                  device_factory: Callable | None = None,
-                 train_kwargs: dict | None = None) -> None:
+                 train_kwargs: dict | None = None,
+                 backend: str = "process",
+                 mesh=None) -> None:
         super().__init__()
         if space is None or not space:
             raise ValueError("empty search space")
+        if backend not in ("process", "mesh"):
+            raise ValueError(f"unknown genetics backend '{backend}'")
+        if backend == "mesh":
+            if build_fn is None:
+                raise ValueError("mesh backend needs build_fn")
+            if fitness_fn is not None:
+                raise ValueError(
+                    "mesh backend scores through the population "
+                    "engine — it cannot take a custom fitness_fn")
+            bad = [k for k in space
+                   if k != "learning_rate"
+                   and not k.endswith(".learning_rate")]
+            if bad or len(space) != 1:
+                raise ValueError(
+                    f"mesh backend tunes exactly one learning_rate "
+                    f"key (per-member device state); got "
+                    f"{sorted(space)} — use backend='process' for "
+                    f"architecture-changing genomes")
+        self.backend = backend
+        self.mesh = mesh
         self.build_fn = build_fn
         self.space = dict(space)
         self.population_size = int(population_size)
@@ -157,7 +234,13 @@ class GeneticsOptimizer(Logger):
 
     # ------------------------------------------------------------------
     def _train_fitness(self, genome: dict) -> float:
-        """Default fitness: train a fresh workflow, score validation."""
+        """Default fitness: train a fresh workflow, score validation.
+
+        The genome's dotted config writes are scoped to THIS
+        evaluation: the touched leaves are snapshotted before
+        ``apply_genome`` and restored after — the next candidate (and
+        the caller) sees the tree it started from, not whatever genome
+        happened to run last."""
         from znicz_tpu.utils import prng
         from znicz_tpu.utils.config import root
         if self.build_fn is None:
@@ -165,15 +248,50 @@ class GeneticsOptimizer(Logger):
         # same init/shuffle stream per candidate, from the documented
         # config seed (matches the CLI --optimize path)
         prng.seed_all(root.common.seed)
-        kwargs = apply_genome(genome)
-        kwargs.update(self.train_kwargs)
-        wf = self.build_fn(**kwargs)
-        # multi-process: evaluates on LOCAL devices only — each genome
-        # is an independent run, no cross-process collectives
-        device = pick_eval_device(self.device_factory)
-        wf.initialize(device=device)
-        wf.run()
-        return workflow_fitness(wf)
+        snapshot = snapshot_genome_leaves(genome)
+        try:
+            kwargs = apply_genome(genome)
+            kwargs.update(self.train_kwargs)
+            wf = self.build_fn(**kwargs)
+            # multi-process: evaluates on LOCAL devices only — each
+            # genome is an independent run, no cross-process
+            # collectives
+            device = pick_eval_device(self.device_factory)
+            wf.initialize(device=device)
+            wf.run()
+            return workflow_fitness(wf)
+        finally:
+            restore_genome_leaves(snapshot)
+
+    # ------------------------------------------------------------------
+    def _genome_lr(self, genome: dict) -> float:
+        """The single learning-rate value a mesh-backend genome
+        carries (validated at construction)."""
+        return float(next(iter(genome.values())))
+
+    def _score_population_mesh(self, pending: list[tuple]) -> None:
+        """Mesh backend: score the generation's fresh genomes in ONE
+        population run — K stacked members, identical init/shuffle
+        stream (``prng.seed_all(root.common.seed)``, the same contract
+        ``_train_fitness`` gives every candidate), one learning rate
+        per member.  Fitness per member is ``-min`` validation error
+        over the run, exactly :func:`workflow_fitness`'s number."""
+        from znicz_tpu.population import PopulationTrainer
+        from znicz_tpu.utils.config import root
+        lrs = [self._genome_lr(genome) for _, genome in pending]
+        seed = int(root.common.seed)
+        trainer = PopulationTrainer(
+            self.build_fn, len(pending),
+            member_seeds=[seed] * len(pending),
+            build_kwargs=dict(self.train_kwargs),
+            mesh=self.mesh, member_lrs=lrs, evolve=None,
+            name="genetics-mesh")
+        trainer.initialize()
+        trainer.run()
+        for (key, _), fit in zip(pending,
+                                 trainer.member_best_fitness):
+            self.local_evaluated.append(key)
+            self._cache[key] = float(fit)
 
     # ------------------------------------------------------------------
     # GA machinery
@@ -224,6 +342,10 @@ class GeneticsOptimizer(Logger):
                 seen.add(key)
                 pending.append((key, genome))
         pidx, pcount = process_info()
+        if self.backend == "mesh":
+            if pending:
+                self._score_population_mesh(pending)
+            return [self._cache[k] for k in keys]
         if pcount > 1 and pending:
             # a local fitness exception must not raise before the
             # collectives (a lone raise would leave peers blocked in
@@ -289,4 +411,8 @@ class GeneticsOptimizer(Logger):
                 next_pop.append(self._mutate(child))
             population = next_pop
         assert self.best_genome is not None
+        # per-candidate writes were restored after each evaluation;
+        # leave the tree holding the WINNER's values (callers build
+        # the final model straight off root)
+        apply_genome(self.best_genome)
         return self.best_genome

@@ -66,12 +66,13 @@ class Vector:
 
     __slots__ = ("_mem", "_devmem", "_state", "_device", "_tracing", "name",
                  "batch_major", "model_shard_dim", "data_shard_dim",
-                 "data_shard_pad")
+                 "data_shard_pad", "member_axis")
 
     def __init__(self, mem: np.ndarray | None = None,
                  name: str = "", batch_major: bool = False,
                  model_shard_dim: int | None = None,
-                 data_shard_dim: int | None = None) -> None:
+                 data_shard_dim: int | None = None,
+                 member_axis: bool = False) -> None:
         self._mem: np.ndarray | None = None
         self._devmem = None
         self._state = _State.EMPTY
@@ -92,6 +93,18 @@ class Vector:
         #: ``model_shard_dim`` (a different dim) so bf16 optimizer
         #: state + TP weights + data-sharded momentum all stack.
         self.data_shard_dim = data_shard_dim
+        #: True when dim 0 is a POPULATION axis (K stacked model
+        #: replicas — the population engine's member-major buffers,
+        #: one slice per member of a K-replica training run).  Member
+        #: buffers shard dim 0 over the mesh's DATA axis, the same
+        #: axis batch-major buffers ride in ordinary data-parallel
+        #: training: in population mode the members *are* the data
+        #: parallelism (small nets train K-per-chip; a K that does not
+        #: divide the axis stays replicated and XLA time-slices).
+        #: ``model_shard_dim`` composes (a member's TP dim, already
+        #: shifted by the leading member axis).  Mutually exclusive
+        #: with ``batch_major``/``data_shard_dim``.
+        self.member_axis = member_axis
         #: rows of zero padding appended along ``data_shard_dim`` when
         #: the logical dim does not divide the data-axis size (jax
         #: shardings must divide evenly).  Snapshots slice the padding

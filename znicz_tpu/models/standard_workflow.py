@@ -28,6 +28,8 @@ from __future__ import annotations
 
 from typing import Any, Callable, Sequence
 
+import numpy as np
+
 from znicz_tpu.accelerated_units import AcceleratedWorkflow, RegionUnit
 from znicz_tpu.backends import NumpyDevice
 from znicz_tpu.loader.base import TRAIN, Loader
@@ -558,6 +560,37 @@ class StandardWorkflow(AcceleratedWorkflow):
         from znicz_tpu.export import export_forward
         return export_forward(self, path)
 
+    def hot_chain_units(self) -> list:
+        """The per-minibatch hot chain in trace order — the unit list
+        a :class:`~znicz_tpu.accelerated_units.JitRegion` compiles and
+        the population engine vmaps (loader gather → forwards →
+        evaluator → backwards, anomaly guard last)."""
+        members = [self.loader, *self.forwards, self.evaluator,
+                   *reversed(self.gds)]
+        if self.anomaly_guard is not None:
+            members.append(self.anomaly_guard)
+        return members
+
+    def promote_lr_leaves(self) -> None:
+        """Turn every weighted GD unit's learning rate into a device
+        leaf (its ``lr_state`` Vector, the same slot a
+        :class:`LearningRateAdjust` schedule uses) holding the
+        configured ``[lr, lr_bias]``.  The population engine calls
+        this so learning rates become *member-stacked* state — each of
+        the K replicas trains (and mutates) its own rate without a
+        recompile.  Idempotent; call after ``initialize``.  Finite
+        steps are bitwise identical to the baked-constant path (same
+        f32 value, same multiply)."""
+        for gd_unit in self.gds:
+            if gd_unit.weights is None or not gd_unit.weights:
+                continue
+            if gd_unit.lr_state:
+                continue  # already scheduled / promoted
+            gd_unit.lr_state.reset(np.asarray(
+                [gd_unit.learning_rate, gd_unit.learning_rate_bias],
+                dtype=np.float32))
+            gd_unit.init_vectors(gd_unit.lr_state)
+
     # ------------------------------------------------------------------
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
@@ -567,11 +600,8 @@ class StandardWorkflow(AcceleratedWorkflow):
 
     def _compile_region(self) -> None:
         """Swap the eager hot chain for one jit region (xla backend)."""
-        members = [self.loader, *self.forwards, self.evaluator,
-                   *reversed(self.gds)]
+        members = self.hot_chain_units()
         guard = self.anomaly_guard
-        if guard is not None:
-            members.append(guard)  # traced last: commits the verdict
         region = RegionUnit(self, members, name="train_region")
         region.initialize(device=self.device)
         region._initialized = True

@@ -713,6 +713,57 @@ def snapshot_age_seconds(source: str) -> Gauge:
         labels=("source",)).labels(source=source)
 
 
+# ----------------------------------------------------------------------
+# population series (round 14): K-replica evolution as a mesh workload —
+# per-member fitness, generation and exploit/explore progress are
+# scrapeable so the population dryrun and pop_bench attest the engine
+# from the same /metrics feed as everything else
+# ----------------------------------------------------------------------
+def population_members(engine: str) -> Gauge:
+    """Members (stacked model replicas) in the population run."""
+    return REGISTRY.gauge(
+        "znicz_population_members",
+        "Model replicas trained by the population engine",
+        labels=("engine",)).labels(engine=engine)
+
+
+def population_fitness(engine: str, member: int) -> Gauge:
+    """Per-member fitness (higher is better; classification runs
+    report ``-validation_err_pt``), updated at every epoch boundary."""
+    return REGISTRY.gauge(
+        "znicz_population_fitness",
+        "Per-member population fitness (latest epoch; higher=better)",
+        labels=("engine", "member")).labels(engine=engine,
+                                            member=member)
+
+
+def population_best_fitness(engine: str) -> Gauge:
+    """Best fitness any member has reached so far in the run — the
+    single number the dryrun tail and dashboards read."""
+    return REGISTRY.gauge(
+        "znicz_population_best_fitness",
+        "Best member fitness seen so far in the population run",
+        labels=("engine",)).labels(engine=engine)
+
+
+def population_generations(engine: str) -> Counter:
+    return REGISTRY.counter(
+        "znicz_population_generations_total",
+        "Evolution generations applied to the stacked population",
+        labels=("engine",)).labels(engine=engine)
+
+
+def population_evolution(engine: str, op: str) -> Counter:
+    """Evolution-op counters: ``exploit`` (a truncated member copied a
+    winner's weights+hypers), ``explore`` (its hypers were perturbed),
+    ``crossover`` (a slot was refilled by arithmetic weight blending),
+    ``mutate`` (its hypers were mutated)."""
+    return REGISTRY.counter(
+        "znicz_population_evolution_total",
+        "Population evolution ops (exploit/explore/crossover/mutate)",
+        labels=("engine", "op")).labels(engine=engine, op=op)
+
+
 def publishes_total(source: str) -> Counter:
     """Snapshot bundles published for serving pickup (the training
     side of the handoff; the watcher's digest verdicts ride

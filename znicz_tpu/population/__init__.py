@@ -1,0 +1,20 @@
+"""Population engine: K-replica evolution as a first-class mesh
+workload (ROADMAP item 5).
+
+- :class:`~znicz_tpu.population.engine.PopulationTrainer` — build K
+  members of one sample architecture, train them simultaneously in one
+  vmapped jit region (member axis sharded over the mesh's data axis),
+  evolve at epoch boundaries;
+- :class:`~znicz_tpu.population.engine.PopulationRegion` — the
+  stacked-leaf step engine itself;
+- :mod:`~znicz_tpu.population.evolution` — the deterministic on-device
+  selection/crossover/mutation/truncation operators.
+"""
+
+from znicz_tpu.population.engine import (PopulationRegion,  # noqa: F401
+                                         PopulationTrainer,
+                                         harvest_state, leaf_keys)
+from znicz_tpu.population import evolution  # noqa: F401
+
+__all__ = ["PopulationRegion", "PopulationTrainer", "evolution",
+           "harvest_state", "leaf_keys"]

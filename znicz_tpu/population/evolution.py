@@ -1,0 +1,157 @@
+"""Deterministic on-device evolution ops over the stacked population.
+
+Every operator here is a pure function ``(fitness, key, *stacked_leaves)
+-> stacked_leaves`` built once per population geometry and jitted by the
+engine: selection, crossover and truncation are expressed as gathers and
+blends along the leading member axis of the stacked parameter/optimizer
+tree — NOT host loops over member checkpoints (the shape Veles's
+genetics plugin had, one genome per cluster node).  When the member axis
+is sharded over the mesh's data axis, a ``jnp.take`` along it lowers to
+the cross-chip collective that moves a winner's weights to a loser's
+shard — selection and crossover literally run on the interconnect.
+
+Determinism: ``jnp.argsort`` is stable (ties resolve by member index)
+and all randomness flows from one explicit PRNG key the engine derives
+as ``fold_in(base_key, generation)``, so a rerun with the same seed
+replays the identical evolutionary trajectory.
+
+Two strategies:
+
+- :func:`build_pbt_step` — PBT-style truncation (Jaderberg et al.,
+  2017): the bottom ``truncation`` fraction *exploits* (copies a
+  uniformly-drawn top member's weights, optimizer state and
+  hyperparameters, bitwise) then *explores* (perturbs its learning
+  rate by a factor drawn from ``factors``);
+- :func:`build_ga_step` — GA-style refill (the reference's
+  ``veles/genetics/`` shape, moved on device): non-elite slots are
+  refilled by size-2 tournament parents, float leaves arithmetically
+  blended (``β·a + (1−β)·b``), int leaves inherited from parent A,
+  learning rates log-normally mutated.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+def tournament(key, fitness, n_draws: int):
+    """``(n_draws,)`` member indices via size-2 tournament selection:
+    draw two members uniformly, keep the fitter (ties → the first)."""
+    k = fitness.shape[0]
+    pairs = jax.random.randint(key, (2, n_draws), 0, k)
+    a, b = pairs[0], pairs[1]
+    return jnp.where(fitness[a] >= fitness[b], a, b)
+
+
+def truncation_count(n_members: int, truncation: float) -> int:
+    """Members replaced per PBT generation: ``round(K·truncation)``,
+    at least 1, never more than half the population (winners and
+    losers must not overlap)."""
+    if n_members < 2:
+        return 0
+    n_cut = int(round(n_members * truncation)) or 1
+    return max(1, min(n_members // 2, n_cut))
+
+
+def _bshape(leaf, k: int) -> tuple:
+    return (k,) + (1,) * (leaf.ndim - 1)
+
+
+def build_pbt_step(n_members: int, lr_slots: Sequence[int],
+                   truncation: float = 0.25,
+                   factors: tuple[float, float] = (0.8, 1.25),
+                   lr_bounds: tuple[float, float] | None = None):
+    """PBT truncation step over the stacked tree.
+
+    Returns ``(fn, n_cut)`` where ``fn(fitness, key, *leaves)``
+    replaces the ``n_cut`` worst members' leaves with a uniformly
+    chosen top-``n_cut`` member's (exploit — an exact on-device copy:
+    weights, momentum, hyperparameters all move together, so the
+    copied member resumes the winner's trajectory bitwise) and then
+    multiplies each replaced member's leaves at ``lr_slots`` (the
+    stacked ``lr_state`` hyperparameters) by a coin-flip factor from
+    ``factors`` (explore), clipped to ``lr_bounds`` when given.
+    """
+    k = n_members
+    n_cut = truncation_count(k, truncation)
+    lr_slots = frozenset(lr_slots)
+
+    def fn(fitness, key, *leaves):
+        order = jnp.argsort(-fitness)          # best first, stable
+        winners = order[:n_cut]
+        losers = order[k - n_cut:]
+        kd, kf = jax.random.split(key)
+        donors = winners[jax.random.randint(kd, (n_cut,), 0, n_cut)]
+        src = jnp.arange(k).at[losers].set(donors)
+        explored = jnp.zeros((k,), bool).at[losers].set(True)
+        fac = jnp.where(jax.random.bernoulli(kf, 0.5, (k,)),
+                        jnp.float32(factors[1]), jnp.float32(factors[0]))
+        out = []
+        for i, leaf in enumerate(leaves):
+            new = jnp.take(leaf, src, axis=0)
+            if i in lr_slots:
+                mutated = (new.astype(jnp.float32)
+                           * fac.reshape(_bshape(new, k)))
+                if lr_bounds is not None:
+                    mutated = jnp.clip(mutated, lr_bounds[0],
+                                       lr_bounds[1])
+                new = jnp.where(explored.reshape(_bshape(new, k)),
+                                mutated.astype(leaf.dtype), new)
+            out.append(new)
+        return tuple(out)
+
+    return fn, n_cut
+
+
+def build_ga_step(n_members: int, blendable: Sequence[bool],
+                  lr_slots: Sequence[int], elite: int = 1,
+                  mutation_sigma: float = 0.2,
+                  lr_bounds: tuple[float, float] | None = None):
+    """GA refill step: every non-elite slot is replaced by a child of
+    two tournament-selected parents — float leaves (``blendable[i]``)
+    arithmetically blended per member (``β·a + (1−β)·b``, one β per
+    child shared across its whole tree so weights and their momentum
+    blend consistently), non-float leaves inherited from parent A —
+    and the child's learning rate is log-normally mutated.  Elite
+    slots (the current top ``elite`` members) pass through untouched.
+
+    Returns ``(fn, n_elite)``.
+    """
+    k = n_members
+    n_elite = max(0, min(int(elite), k - 1))
+    blendable = tuple(bool(b) for b in blendable)
+    lr_slots = frozenset(lr_slots)
+
+    def fn(fitness, key, *leaves):
+        order = jnp.argsort(-fitness)
+        keep = jnp.zeros((k,), bool)
+        if n_elite:
+            keep = keep.at[order[:n_elite]].set(True)
+        ka, kb, kw, km = jax.random.split(key, 4)
+        src_a = tournament(ka, fitness, k)
+        src_b = tournament(kb, fitness, k)
+        beta = jax.random.uniform(kw, (k,), dtype=jnp.float32)
+        noise = jnp.exp(mutation_sigma
+                        * jax.random.normal(km, (k,), dtype=jnp.float32))
+        out = []
+        for i, leaf in enumerate(leaves):
+            bshape = _bshape(leaf, k)
+            child = jnp.take(leaf, src_a, axis=0)
+            if blendable[i]:
+                pb = jnp.take(leaf, src_b, axis=0)
+                b = beta.reshape(bshape)
+                child = (b * child.astype(jnp.float32)
+                         + (1.0 - b) * pb.astype(jnp.float32)
+                         ).astype(leaf.dtype)
+            if i in lr_slots:
+                child = child.astype(jnp.float32) * noise.reshape(bshape)
+                if lr_bounds is not None:
+                    child = jnp.clip(child, lr_bounds[0], lr_bounds[1])
+                child = child.astype(leaf.dtype)
+            out.append(jnp.where(keep.reshape(bshape), leaf, child))
+        return tuple(out)
+
+    return fn, n_elite
