@@ -330,8 +330,10 @@ def test_warmed_decode_loop_zero_compiles(lm_bundle):
     decode_c = obs_metrics.xla_compiles("serving-decode")
     with DecodeEngine(lm_bundle, max_slots=4, max_t=32, max_prompt=16,
                       prompt_align=4, max_new_tokens=9) as eng:
-        assert eng.warmup_compiles == len(eng.model.prompt_ladder()) \
-            + len(eng.model.batch_ladder())
+        # warmup compiled the WHOLE grid (prompt × block buckets for
+        # prefill, batch × block buckets for paged decode) and nothing
+        # else has: the live-program census IS the warmup count
+        assert eng.warmup_compiles == eng.model.programs_live
         before = prefill_c.value + decode_c.value
         rng = np.random.default_rng(4)
         futs = [eng.submit(rng.integers(0, VOCAB, size=int(n)))
@@ -355,9 +357,9 @@ def test_ttft_deadline_evicts_queued_prompt(lm_bundle):
                       prompt_align=4, max_new_tokens=4) as eng:
         real_prefill = eng.model.run_prefill
 
-        def slow_prefill(tokens, slot):
+        def slow_prefill(tokens, slot, start=0):
             gate.wait(timeout=30)
-            return real_prefill(tokens, slot)
+            return real_prefill(tokens, slot, start)
 
         eng.model.run_prefill = slow_prefill
         blocker = eng.submit(np.array([1]))      # holds the scheduler
@@ -403,10 +405,10 @@ def test_breaker_opens_on_consecutive_prefill_failures(lm_bundle):
         real_prefill = eng.model.run_prefill
         boom = {"on": True}
 
-        def flaky_prefill(tokens, slot):
+        def flaky_prefill(tokens, slot, start=0):
             if boom["on"]:
                 raise RuntimeError("injected prefill failure")
-            return real_prefill(tokens, slot)
+            return real_prefill(tokens, slot, start)
 
         eng.model.run_prefill = flaky_prefill
         futs = [eng.submit(np.array([i + 1])) for i in range(4)]
@@ -435,15 +437,18 @@ def test_breaker_opens_on_consecutive_prefill_failures(lm_bundle):
 def test_prefill_failure_isolated_to_its_prompt(lm_bundle):
     """One poisoned prompt fails alone — neighbors are served."""
     man, P = _params(lm_bundle)
+    # prefix_cache off: every admission takes the single-prefill path
+    # the poison hook patches (coalesced admissions have their own
+    # wave-isolation contract)
     with DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=8,
                       prompt_align=4, max_new_tokens=4,
-                      retry_budget=0) as eng:
+                      prefix_cache=False, retry_budget=0) as eng:
         real_prefill = eng.model.run_prefill
 
-        def poison_prefill(tokens, slot):
+        def poison_prefill(tokens, slot, start=0):
             if tokens[0] == 9:
                 raise RuntimeError("poisoned prompt")
-            return real_prefill(tokens, slot)
+            return real_prefill(tokens, slot, start)
 
         eng.model.run_prefill = poison_prefill
         good1 = eng.submit(np.array([1, 2]))
@@ -481,9 +486,9 @@ def test_queue_backpressure(lm_bundle):
                       max_queue=1) as eng:
         real_prefill = eng.model.run_prefill
 
-        def gated_prefill(tokens, slot):
+        def gated_prefill(tokens, slot, start=0):
             gate.wait(timeout=30)
-            return real_prefill(tokens, slot)
+            return real_prefill(tokens, slot, start)
 
         eng.model.run_prefill = gated_prefill
         first = eng.submit(np.array([1]))      # popped by scheduler

@@ -1,0 +1,500 @@
+"""Paged KV-cache + prefix sharing + speculative decoding (round 15).
+
+Ground truth is the same step-by-step full-forward numpy oracle as
+tests/test_decode.py: token ids must match BITWISE (integers) across
+the flat cache, the paged cache, prefix-shared admissions and the
+speculative draft/verify loop — the data plane may only move bytes
+around, never change a token.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from znicz_tpu.export import ExportedModel, attach_decode_meta
+from znicz_tpu.observe import metrics as obs_metrics
+from znicz_tpu.ops.pos_encoding import sinusoid_table
+from znicz_tpu.serving import (DecodeEngine, Overloaded, PoolExhausted,
+                               QueueFull, TokenBudget)
+
+VOCAB = 12
+
+
+@pytest.fixture(scope="module")
+def lm_bundle(tmp_path_factory):
+    from benchmarks.serve_bench import train_and_export_lm
+    path = str(tmp_path_factory.mktemp("paged") / "lm.npz")
+    return train_and_export_lm(path, vocab=VOCAB, epochs=3)
+
+
+@pytest.fixture(scope="module")
+def drafter_bundle(tmp_path_factory):
+    """A deliberately DIFFERENT (smaller, other seed) LM — the spec
+    loop must stay token-identical no matter how bad the drafter is."""
+    from benchmarks.serve_bench import train_and_export_lm
+    path = str(tmp_path_factory.mktemp("paged") / "drafter.npz")
+    return train_and_export_lm(path, vocab=VOCAB, dim=8, n_heads=1,
+                               epochs=2, seed=5)
+
+
+def _params(bundle):
+    import json
+    with np.load(bundle) as b:
+        manifest = json.loads(bytes(b["manifest"]).decode())
+        params = {k: np.array(b[k]) for k in b.files if k != "manifest"}
+    return manifest, params
+
+
+def attn_oracle_logits(man, P, seq):
+    ids = np.asarray(seq, np.int32)
+    x = P["layer0_weights"][ids][None].astype(np.float32)
+    t, d = x.shape[1], x.shape[2]
+    x = x + sinusoid_table(t, d)
+    qkv = x.reshape(t, d) @ P["layer2_weights"] + P["layer2_bias"]
+    h = man["layers"][2]["config"]["n_heads"]
+    dh = d // h
+    qkv = qkv.reshape(1, t, 3 * d)
+    q = qkv[..., :d].reshape(1, t, h, dh)
+    k = qkv[..., d:2 * d].reshape(1, t, h, dh)
+    v = qkv[..., 2 * d:].reshape(1, t, h, dh)
+    s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(dh)
+    mask = np.arange(t)[:, None] >= np.arange(t)[None, :]
+    s = np.where(mask[None, None], s, -1e30)
+    s = s - s.max(-1, keepdims=True)
+    p = np.exp(s)
+    p /= p.sum(-1, keepdims=True)
+    o = np.einsum("bhqk,bkhd->bqhd", p, v)
+    y = o.reshape(t, d) @ P["layer2_weights_out"] + P["layer2_bias_out"]
+    return y.reshape(t, d)[-1] @ P["layer4_weights"] + P["layer4_bias"]
+
+
+def oracle_greedy(man, P, prompt, n):
+    seq = [int(t) for t in prompt]
+    out = []
+    for _ in range(n):
+        tok = int(np.argmax(attn_oracle_logits(man, P, seq)))
+        out.append(tok)
+        seq.append(tok)
+    return out
+
+
+# ----------------------------------------------------------------------
+# paged ≡ flat ≡ oracle, bitwise on token ids
+# ----------------------------------------------------------------------
+def test_paged_equals_flat_equals_oracle(lm_bundle):
+    """The acceptance-bar identity: across ragged prompt lengths the
+    paged data plane reproduces the flat cache AND the step-by-step
+    numpy oracle exactly (integer token ids — bitwise)."""
+    man, P = _params(lm_bundle)
+    lens = (1, 3, 5, 11, 14)
+    outs = {}
+    for paged in (False, True):
+        with DecodeEngine(lm_bundle, max_slots=4, max_t=32,
+                          max_prompt=16, prompt_align=4,
+                          max_new_tokens=8, paged=paged,
+                          page_tokens=8) as eng:
+            outs[paged] = {
+                n: list(eng.generate((np.arange(n) * 3) % VOCAB,
+                                     timeout=240))
+                for n in lens}
+        assert eng.stats()["paged"] is paged
+    for n in lens:
+        want = oracle_greedy(man, P, (np.arange(n) * 3) % VOCAB, 8)
+        assert outs[True][n] == want, f"paged diverged at len {n}"
+        assert outs[False][n] == want, f"flat diverged at len {n}"
+
+
+def test_paged_lstm_chain(tmp_path):
+    """Paged mode with an LSTM in the chain: carries stay
+    slot-indexed, prefix sharing auto-disables, tokens match the flat
+    arm."""
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils import prng
+
+    path = str(tmp_path / "rnn.npz")
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, VOCAB, size=(128, 6)).astype(np.float32)
+    labels = (data[:, -1].astype(np.int32) + 1) % VOCAB
+    prng.seed_all(7)
+    wf = StandardWorkflow(
+        name="paged_rnn",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:96], train_labels=labels[:96],
+            valid_data=data[96:], valid_labels=labels[96:],
+            minibatch_size=32),
+        layers=[{"type": "embedding",
+                 "->": {"vocab_size": VOCAB, "dim": 12},
+                 "<-": {"learning_rate": 0.1}},
+                {"type": "lstm", "->": {"units": 16},
+                 "<-": {"learning_rate": 0.1}},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": VOCAB},
+                 "<-": {"learning_rate": 0.1}}],
+        decision_config={"max_epochs": 1})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    wf.export_forward(path)
+    outs = {}
+    for paged in (False, True):
+        with DecodeEngine(path, max_slots=2, max_t=32, max_prompt=8,
+                          prompt_align=4, max_new_tokens=6,
+                          paged=paged, page_tokens=8) as eng:
+            outs[paged] = [list(eng.generate(
+                (np.arange(n) * 2 + 1) % VOCAB, timeout=240))
+                for n in (1, 4, 7)]
+            if paged:
+                assert eng.prefix is None  # LSTM: nothing to share
+    assert outs[True] == outs[False]
+
+
+def test_continuous_admission_paged_matches_oracle(lm_bundle):
+    """More prompts than slots under the paged plane: mid-decode
+    admission, ragged depths, block-bucket switching — every result
+    equals the one-at-a-time oracle."""
+    man, P = _params(lm_bundle)
+    rng = np.random.default_rng(17)
+    prompts = [rng.integers(0, VOCAB, size=int(n)).astype(np.int32)
+               for n in rng.integers(1, 13, size=10)]
+    budgets = [int(b) for b in rng.integers(3, 12, size=10)]
+    with DecodeEngine(lm_bundle, max_slots=3, max_t=32, max_prompt=16,
+                      prompt_align=4, page_tokens=8) as eng:
+        futs = [eng.submit(p, max_new_tokens=b)
+                for p, b in zip(prompts, budgets)]
+        results = [list(f.result(timeout=240)) for f in futs]
+    for i, (p, b, got) in enumerate(zip(prompts, budgets, results)):
+        assert got == oracle_greedy(man, P, p, b), f"prompt {i}"
+
+
+# ----------------------------------------------------------------------
+# prefix sharing + copy-on-write
+# ----------------------------------------------------------------------
+def test_prefix_sharing_matches_unshared_oracle(lm_bundle):
+    """System-prompt traffic: requests sharing a long prefix must
+    produce the same tokens as fresh, unshared decodes — including a
+    third request that diverges MID-block (the copy-on-write path)."""
+    man, P = _params(lm_bundle)
+    shared = (np.arange(12) * 5 + 2) % VOCAB          # 3 full 4-blocks
+    reqs = [np.concatenate([shared, [3, 1]]),          # miss, inserts
+            np.concatenate([shared, [3, 1]]),          # full-block hit
+            np.concatenate([shared[:10], [9, 9, 4]])]  # diverges @10
+    with DecodeEngine(lm_bundle, max_slots=4, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=6,
+                      page_tokens=4) as eng:
+        got = [list(eng.generate(r, timeout=240)) for r in reqs]
+        st = eng.stats()["prefix_cache"]
+    for r, g in zip(reqs, got):
+        assert g == oracle_greedy(man, P, r, 6), "sharing changed tokens"
+    assert st["hits"] == 2 and st["misses"] == 1, st
+    # request 2 shared 8 tokens (2 full blocks) + 2 via COW; request 1
+    # shared 12 (3 full blocks) + 1 partial (capped at n-1 = 13)
+    assert st["shared_tokens"] >= 18, st
+
+
+def test_cow_divergence_isolation(lm_bundle):
+    """The COW contract: request B sharing A's prefix (and diverging
+    inside a block) must never mutate A's pages — A's identical
+    re-generation AFTER B is bitwise-unchanged, and the shared pages'
+    refcounts drop back once both finish."""
+    man, P = _params(lm_bundle)
+    prompt_a = (np.arange(8) * 5 + 1) % VOCAB     # 2 full 4-blocks
+    prompt_b = np.concatenate([prompt_a[:6], [7, 7, 2]])  # forks @6
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=8,
+                      page_tokens=4) as eng:
+        first = list(eng.generate(prompt_a, timeout=240))
+        forked = list(eng.generate(prompt_b, timeout=240))
+        again = list(eng.generate(prompt_a, timeout=240))
+        cache = eng.model.cache
+        # only the trie's pins remain — every per-request reference
+        # was dropped on eviction
+        assert cache.free_slots == 2
+        held = cache.pages_used()
+        assert held == eng.prefix.nodes, (held, eng.prefix.nodes)
+    assert first == oracle_greedy(man, P, prompt_a, 8)
+    assert forked == oracle_greedy(man, P, prompt_b, 8), \
+        "the forked request read someone else's K/V"
+    assert again == first, "B's divergence mutated A's shared pages"
+
+
+def test_trie_eviction_under_pool_pressure(lm_bundle):
+    """A pool too small to pin every prompt evicts LRU prefix blocks
+    instead of refusing admissions; tokens stay oracle-exact."""
+    man, P = _params(lm_bundle)
+    rng = np.random.default_rng(9)
+    prompts = [rng.integers(0, VOCAB, size=12).astype(np.int32)
+               for _ in range(6)]
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=4, page_tokens=8,
+                      pool_tokens=32) as eng:  # 4 pages only
+        for p in prompts:
+            assert list(eng.generate(p, timeout=240)) \
+                == oracle_greedy(man, P, p, 4)
+        evicted = obs_metrics.REGISTRY.get("znicz_prefix_cache_total")
+        events = {k[1]: int(c.value) for k, c in evicted.items()
+                  if k[0] == eng._obs_id}
+    assert events.get("evicted", 0) > 0, events
+
+
+# ----------------------------------------------------------------------
+# page-pool exhaustion → breaker shed while in-flight drains
+# ----------------------------------------------------------------------
+def test_pool_exhaustion_sheds_then_recovers(lm_bundle):
+    """When live lanes reserve every page, new prompts trip the
+    breaker (fast Overloaded replies — a token-capacity overload
+    sheds like a failure overload) while the in-flight decodes DRAIN
+    and release their pages; after the cooldown the queue clears and
+    every admitted request still matches the oracle — no truncated
+    neighbors, ever."""
+    man, P = _params(lm_bundle)
+    prompts = [np.asarray([i + 1, i + 2], np.int32) for i in range(3)]
+    with DecodeEngine(lm_bundle, max_slots=4, max_t=32, max_prompt=8,
+                      prompt_align=4, max_new_tokens=16, page_tokens=4,
+                      pool_tokens=20,  # 5 pages: ONE 18-token span
+                      prefix_cache=False, max_queue_age_ms=50.0,
+                      breaker_cooldown_ms=120.0) as eng:
+        real_decode = eng.model.run_decode
+
+        def slow_decode(tokens, slots, positions):
+            time.sleep(0.01)  # hold the lane live long enough
+            return real_decode(tokens, slots, positions)
+
+        eng.model.run_decode = slow_decode
+        futs = [eng.submit(prompts[0]),  # admitted: takes the pool
+                eng.submit(prompts[1])]  # queued: admission exhausts
+        deadline = time.monotonic() + 20
+        while eng.breaker_state != "open" \
+                and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert eng.breaker_state == "open", \
+            "page-pool exhaustion never tripped the breaker"
+        with pytest.raises(Overloaded):
+            eng.submit(prompts[2])      # shed with a fast reply
+        shed = eng.shed_total
+        # the drain frees the pool; retry until admitted again
+        while True:
+            try:
+                futs.append(eng.submit(prompts[2]))
+                break
+            except (Overloaded, QueueFull):
+                time.sleep(0.02)
+        results = [list(f.result(timeout=300)) for f in futs]
+        assert eng.page_truncations == 0
+    for p, got in zip(prompts, results):
+        assert got == oracle_greedy(man, P, p, 16)
+    assert shed > 0, "pool pressure never shed a prompt"
+
+
+def test_oversized_request_fails_cleanly(lm_bundle):
+    """A request whose worst-case span needs more pages than the
+    whole pool fails its own future with PoolExhausted — no hang, no
+    neighbor damage, slot and pages returned."""
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=4, page_tokens=4,
+                      pool_tokens=16, prefix_cache=False) as eng:
+        # span 8+4=12 → 3 pages of the 4-page pool: serves fine
+        assert len(eng.generate(np.arange(8) % VOCAB,
+                                timeout=240)) == 4
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=4, page_tokens=4,
+                      pool_tokens=8, prefix_cache=False) as eng:
+        fut = eng.submit(np.arange(12) % VOCAB)  # 4 pages > 2-page pool
+        with pytest.raises(PoolExhausted):
+            fut.result(timeout=240)
+        assert eng.model.cache.free_slots == 2
+        assert eng.model.cache.free_pages == 2
+
+
+# ----------------------------------------------------------------------
+# speculative decoding
+# ----------------------------------------------------------------------
+def test_spec_greedy_token_identical(lm_bundle, drafter_bundle):
+    """Leviathan's greedy rule: with ANY drafter — here a weak,
+    differently-seeded one — the speculative arm emits exactly the
+    non-speculative greedy tokens."""
+    man, P = _params(lm_bundle)
+    rng = np.random.default_rng(21)
+    prompts = [rng.integers(0, VOCAB, size=int(n)).astype(np.int32)
+               for n in rng.integers(1, 14, size=8)]
+    with DecodeEngine(lm_bundle, max_slots=3, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=12,
+                      spec_draft_k=3, drafter=drafter_bundle,
+                      page_tokens=8) as eng:
+        futs = [eng.submit(p) for p in prompts]
+        results = [list(f.result(timeout=300)) for f in futs]
+        spec = eng.stats()["speculative"]
+    for p, got in zip(prompts, results):
+        assert got == oracle_greedy(man, P, p, 12), \
+            "speculation changed the greedy tokens"
+    assert spec["accepted"] + spec["rejected"] > 0, spec
+
+
+def test_spec_self_draft_accepts_everything(lm_bundle):
+    """Drafter == verifier: every draft must be accepted (the
+    acceptance rule is exact, not probabilistic, under greedy)."""
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=8,
+                      prompt_align=4, max_new_tokens=13,
+                      spec_draft_k=3, drafter=lm_bundle,
+                      page_tokens=8) as eng:
+        out = eng.generate(np.array([1, 2, 3]), timeout=300)
+        spec = eng.stats()["speculative"]
+    assert len(out) == 13
+    assert spec["rejected"] == 0 and spec["accepted"] > 0, spec
+    assert spec["accept_rate"] == 1.0
+
+
+def test_spec_sampled_stays_in_vocab_and_reproducible(lm_bundle,
+                                                      drafter_bundle):
+    """Temperature > 0 under speculation: exact rejection sampling —
+    same seed → same continuation, tokens in vocab."""
+    prompt = np.array([4, 7, 1])
+
+    def gen(seed):
+        with DecodeEngine(lm_bundle, max_slots=1, max_t=32,
+                          max_prompt=8, prompt_align=4,
+                          max_new_tokens=10, temperature=1.0,
+                          seed=seed, spec_draft_k=2,
+                          page_tokens=8,
+                          drafter=drafter_bundle) as eng:
+            return list(eng.generate(prompt, timeout=300))
+
+    a, b = gen(5), gen(5)
+    assert a == b
+    assert all(0 <= t < VOCAB for t in a)
+
+
+def test_spec_requires_paged_and_drafter(lm_bundle):
+    with pytest.raises(ValueError, match="paged"):
+        DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=8,
+                     paged=False, spec_draft_k=2, drafter=lm_bundle)
+    with pytest.raises(ValueError, match="drafter"):
+        DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=8,
+                     spec_draft_k=2)
+
+
+# ----------------------------------------------------------------------
+# manifest decode metadata (export satellite)
+# ----------------------------------------------------------------------
+def test_attach_decode_meta_round_trip(lm_bundle, drafter_bundle,
+                                       tmp_path):
+    import shutil
+    path = str(tmp_path / "meta_lm.npz")
+    shutil.copyfile(lm_bundle, path)
+    meta = attach_decode_meta(path, page_tokens=8,
+                              drafter=drafter_bundle, spec_draft_k=2)
+    assert meta == {"kv_page_tokens": 8, "drafter": drafter_bundle,
+                    "spec_draft_k": 2}
+    man, _ = _params(path)
+    assert man["decode"] == meta
+    # the engine reads the bundle's data-plane defaults by itself
+    with DecodeEngine(path, max_slots=2, max_t=32, max_prompt=8,
+                      prompt_align=4, max_new_tokens=6) as eng:
+        assert eng.model.page_tokens == 8
+        assert eng.spec_k == 2 and eng.drafter is not None
+        out = eng.generate(np.array([2, 5]), timeout=300)
+        assert len(out) == 6
+    # scorer bundles refuse decode metadata
+    from benchmarks.serve_bench import train_and_export
+    fc = str(tmp_path / "fc.npz")
+    train_and_export(fc, epochs=1)
+    with pytest.raises(ValueError, match="scorer"):
+        attach_decode_meta(fc, page_tokens=8)
+
+
+# ----------------------------------------------------------------------
+# token-denominated admission (batcher satellite)
+# ----------------------------------------------------------------------
+def test_token_budget_unit():
+    b = TokenBudget(100)
+    assert b.try_acquire(60) and b.used == 60
+    assert not b.try_acquire(50)
+    b.release(60)
+    assert b.try_acquire(50)
+    # an oversized request is admissible on an EMPTY budget (the
+    # pool-fit check downstream decides its fate)
+    b2 = TokenBudget(10)
+    assert b2.try_acquire(40)
+    assert not b2.try_acquire(1)
+    b2.release(40)
+    with pytest.raises(ValueError):
+        TokenBudget(0)
+
+
+def test_token_budget_bounds_decode_queue(lm_bundle):
+    """The paged queue is bounded by the TOKENS it holds: a gated
+    scheduler + small token budget rejects the request whose charge
+    would not fit, while the prompt-count bound alone would admit."""
+    gate = threading.Event()
+    with DecodeEngine(lm_bundle, max_slots=1, max_t=32, max_prompt=8,
+                      prompt_align=4, max_new_tokens=20,
+                      max_queue=64, max_queue_tokens=60,
+                      prefix_cache=False) as eng:
+        real_prefill = eng.model.run_prefill
+
+        def gated_prefill(tokens, slot, start=0):
+            gate.wait(timeout=30)
+            return real_prefill(tokens, slot, start)
+
+        eng.model.run_prefill = gated_prefill
+        first = eng.submit(np.array([1, 2]))   # charge 2 + 20
+        time.sleep(0.05)
+        second = eng.submit(np.array([3]))     # charge 1 + 20
+        with pytest.raises(QueueFull, match="token budget"):
+            eng.submit(np.array([4]))          # would exceed 60
+        gate.set()
+        assert len(first.result(timeout=240)) == 20
+        assert len(second.result(timeout=240)) == 20
+        # charges returned: the budget drains back to zero
+        assert eng._token_budget.used == 0
+
+
+# ----------------------------------------------------------------------
+# admission-eligible TTFT under a swap drain (round-13 noise-band fix)
+# ----------------------------------------------------------------------
+def test_swap_drain_does_not_pollute_ttft_or_deadlines(lm_bundle):
+    """A prompt queued behind a swap drain must (1) survive a TTFT
+    deadline shorter than the drain — the clock stamps from
+    admission-ELIGIBLE time — and (2) record a TTFT observation that
+    excludes the pause, while the pause itself lands on
+    ``znicz_swap_pause_seconds_total``."""
+    man, params = _params(lm_bundle)
+    with DecodeEngine(lm_bundle, max_slots=1, max_t=128, max_prompt=8,
+                      prompt_align=4, max_new_tokens=500,
+                      prefix_cache=False) as eng:
+        real_decode = eng.model.run_decode
+
+        def slow_decode(tokens, slots, positions):
+            time.sleep(0.01)  # keep the lane draining past the bound
+            return real_decode(tokens, slots, positions)
+
+        eng.model.run_decode = slow_decode
+        runner = eng.submit(np.array([5, 6]))       # long-lived lane
+        time.sleep(0.05)                            # goes live
+        swap_done: list = []
+
+        def do_swap():
+            swap_done.append(eng.swap_weights(
+                (man, params), drain_ms=400.0))
+
+        t = threading.Thread(target=do_swap, daemon=True)
+        t.start()
+        time.sleep(0.05)  # the drain is pausing admission now
+        queued = eng.submit(np.array([3]), max_new_tokens=8,
+                            deadline_ms=250.0)
+        out = queued.result(timeout=300)            # served, not expired
+        t.join(timeout=60)
+        runner.result(timeout=60)
+        assert len(out) > 0
+        assert swap_done and swap_done[0]["evicted"] == 1
+        assert eng.expired_total == 0
+        pause = obs_metrics.swap_pause_seconds(eng._obs_id).value
+        assert pause > 0.2, pause
+        # the TTFT window saw the queued request WITHOUT the pause:
+        # every observation is far below the ~400 ms drain
+        assert max(eng._ttft_win) < 0.35, list(eng._ttft_win)

@@ -267,6 +267,55 @@ def test_warmed_decode_loop_zero_new_compiles_across_3_swaps(tmp_path):
         eng.shutdown()
 
 
+def test_warmed_paged_spec_loop_zero_new_compiles(tmp_path):
+    """Round 15: the paged + prefix-sharing + speculative loop is
+    compile-free once warmed — ragged prompts (prefix hits AND
+    misses, COW divergences), block-bucket switches, draft/verify
+    windows and a weight swap (which invalidates the prefix cache)
+    all ride the warmed grid
+    (``site=serving-prefill|serving-decode|serving-verify|
+    serving-page`` pinned flat)."""
+    from benchmarks.serve_bench import train_and_export_lm
+    from znicz_tpu.serving import DecodeEngine
+
+    big = train_and_export_lm(str(tmp_path / "retrace_paged.npz"),
+                              epochs=2)
+    small = train_and_export_lm(str(tmp_path / "retrace_draft.npz"),
+                                dim=8, n_heads=1, epochs=1, seed=5)
+    counters = [obs_metrics.xla_compiles(site) for site in
+                ("serving-prefill", "serving-decode",
+                 "serving-verify", "serving-page")]
+    rng = np.random.default_rng(23)
+    shared = rng.integers(0, 12, size=12).astype(np.int32)
+
+    def wave(eng, n):
+        futs = []
+        for ln in rng.integers(1, 5, size=n):
+            p = np.concatenate([shared[:rng.integers(0, 13)],
+                                rng.integers(0, 12, size=int(ln))])
+            futs.append(eng.submit(p[:16].astype(np.int32)))
+        return [f.result(timeout=240) for f in futs]
+
+    eng = DecodeEngine(big, max_slots=4, max_t=64, max_prompt=16,
+                       prompt_align=8, max_new_tokens=9,
+                       page_tokens=8, spec_draft_k=2, drafter=small)
+    eng.start()
+    try:
+        wave(eng, 6)  # traffic over hits, misses, COW, spec windows
+        warmed = sum(c.value for c in counters)
+        assert eng.warmup_compiles == sum(
+            m.programs_live for m in (eng.model, eng.drafter))
+        wave(eng, 9)
+        eng.swap_weights(big, drain_ms=10_000)  # clears prefix cache
+        wave(eng, 6)
+        delta = sum(c.value for c in counters) - warmed
+        assert delta == 0, (
+            f"warmed paged+spec loop compiled {delta} new XLA "
+            f"programs")
+    finally:
+        eng.shutdown()
+
+
 def test_warmed_serving_bucket_zero_new_compiles(served_bundle):
     """The engine's warmup covers the whole ladder; ragged traffic
     afterwards — partial, odd, full, repeated — must not compile."""

@@ -160,6 +160,44 @@ def _manifest_for(workflow) -> dict:
     return manifest
 
 
+def attach_decode_meta(path: str, *, page_tokens: int | None = None,
+                       pool_tokens: int | None = None,
+                       drafter: str | None = None,
+                       spec_draft_k: int | None = None) -> dict:
+    """Stamp decode-plane defaults into an existing LM bundle's
+    manifest (round 15): the paged-cache geometry
+    (``kv_page_tokens`` / ``pool_tokens``) and the speculative
+    drafter reference (a published bundle path + ``spec_draft_k``),
+    so a :class:`~znicz_tpu.serving.DecodeEngine` built from the
+    bundle alone serves with the intended data plane.  Merges into
+    any existing ``decode`` section; returns the section written.
+    The file is rewritten atomically (same temp+rename discipline as
+    :func:`export_forward`)."""
+    manifest, params = read_bundle(path)
+    if manifest.get("kind", "lm") != "lm":
+        raise ValueError(f"bundle '{path}' is a "
+                         f"'{manifest.get('kind')}' — decode metadata "
+                         f"belongs on LM bundles")
+    meta = dict(manifest.get("decode", {}))
+    for key, value in (("kv_page_tokens", page_tokens),
+                       ("pool_tokens", pool_tokens),
+                       ("drafter", drafter),
+                       ("spec_draft_k", spec_draft_k)):
+        if value is not None:
+            meta[key] = value
+    manifest["decode"] = meta
+    arrays = {k: np.asarray(v) for k, v in params.items()}
+    arrays["manifest"] = np.frombuffer(
+        json.dumps(manifest).encode(), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    os.replace(tmp, path)
+    return meta
+
+
 def export_forward(workflow, path: str) -> str:
     """Write the trained forward chain of a ``StandardWorkflow`` to
     ``path`` (``.npz`` bundle).  Returns the path written."""

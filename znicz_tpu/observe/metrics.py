@@ -552,6 +552,68 @@ def serving_decode_slots(engine: str) -> Gauge:
         labels=("engine",)).labels(engine=engine)
 
 
+def kv_pages_total(engine: str) -> Gauge:
+    """Pages in the decode engine's paged KV pool (fixed at start —
+    the token-capacity bound: ``pages × kv_page_tokens`` tokens)."""
+    return REGISTRY.gauge(
+        "znicz_kv_pages_total",
+        "KV-cache pages in the paged decode pool",
+        labels=("engine",)).labels(engine=engine)
+
+
+def kv_pages_used(engine: str) -> Gauge:
+    """Pages currently held by live sequences or the prefix cache —
+    the page-table occupancy series ROADMAP item 3 names; a live
+    callback gauge, so /metrics always reads the current pool state."""
+    return REGISTRY.gauge(
+        "znicz_kv_pages_used",
+        "KV-cache pages held by live sequences + the prefix cache",
+        labels=("engine",)).labels(engine=engine)
+
+
+def prefix_cache_events(engine: str, event: str) -> Counter:
+    """Prefix-sharing admissions: ``hit`` (≥1 full block of the
+    prompt reused from the radix cache), ``miss`` (prefilled from
+    scratch), ``evicted`` (a cached block released under pool
+    pressure).  Hit *tokens* ride ``znicz_prefix_tokens_total``."""
+    return REGISTRY.counter(
+        "znicz_prefix_cache_total",
+        "Prefix-cache admission events (hit/miss/evicted)",
+        labels=("engine", "event")).labels(engine=engine, event=event)
+
+
+def prefix_tokens(engine: str, kind: str) -> Counter:
+    """Prompt tokens by prefix-cache outcome: ``shared`` positions
+    skipped prefill entirely (their K/V pages were reused),
+    ``computed`` positions paid the prefill forward."""
+    return REGISTRY.counter(
+        "znicz_prefix_tokens_total",
+        "Prompt tokens by prefix-cache outcome (shared/computed)",
+        labels=("engine", "kind")).labels(engine=engine, kind=kind)
+
+
+def spec_tokens(engine: str, verdict: str) -> Counter:
+    """Speculative-decoding drafter proposals by verifier verdict
+    (``accepted`` / ``rejected``) — acceptance rate is
+    ``accepted / (accepted + rejected)``."""
+    return REGISTRY.counter(
+        "znicz_spec_tokens_total",
+        "Drafted tokens by verification verdict (accepted/rejected)",
+        labels=("engine", "verdict")).labels(engine=engine,
+                                             verdict=verdict)
+
+
+def swap_pause_seconds(engine: str) -> Counter:
+    """Cumulative wall time decode admission was paused for swap
+    drains.  TTFT deadline clocks stamp from admission-ELIGIBLE time
+    (submit time + any overlapping pause), so this series is the
+    audit trail for what the serving SLO histograms exclude."""
+    return REGISTRY.counter(
+        "znicz_swap_pause_seconds_total",
+        "Decode admission pause time accumulated by swap drains",
+        labels=("engine",)).labels(engine=engine)
+
+
 def serving_warmup_seconds(engine: str) -> Gauge:
     return REGISTRY.gauge(
         "znicz_serving_warmup_seconds",

@@ -429,6 +429,134 @@ class MultiHeadAttention(Forward):
             y = y + b_out
         return y.reshape(b, 1, d), k_cache, v_cache
 
+    # -- paged decode (round 15, serving.decode) ------------------------
+    # Same math as the flat steps above, but K/V live in a shared page
+    # POOL (P, ptok, H, Dh) addressed through a per-sequence block
+    # table instead of per-slot (maxT, H, Dh) strips.  Three wins the
+    # flat layout cannot express: (1) attention reads only the pages a
+    # sequence actually occupies (the nb block bucket), not the full
+    # maxT reservation; (2) full pages are SHARED between sequences
+    # with a common prompt prefix (refcounted, copy-on-write at
+    # divergence — host-side, serving/decode.py); (3) live capacity is
+    # bounded by tokens, not slots.  Tables carry nb+1 entries: the
+    # last is the trash page, where padded lanes/positions scatter
+    # their garbage writes.
+    def _project_qkv(self, x, w_qkv, b_qkv):
+        """(B, W, D) → q, k, v each (B, W, H, Dh)."""
+        b, w, d = x.shape
+        qkv = x.astype(jnp.float32).reshape(b * w, d) @ w_qkv
+        if b_qkv is not None:
+            qkv = qkv + b_qkv
+        return _split_heads(qkv.reshape(b, w, 3 * d), self.n_heads)
+
+    def _out_proj(self, o, w_out, b_out):
+        b, w, h, dh = o.shape
+        y = o.reshape(b * w, h * dh) @ w_out
+        if b_out is not None:
+            y = y + b_out
+        return y.reshape(b, w, h * dh)
+
+    def _paged_attend(self, q, k_pool, v_pool, tables, q_pos):
+        """Attend (B, W, H, Dh) queries at global positions ``q_pos``
+        (B, W) over the pages in ``tables`` (B, nb+1; last = trash).
+        Key position ``p`` is admitted iff ``p <= q_pos`` — stale rows
+        from a prior page tenant and this window's padded tail sit
+        beyond every real query's position by construction."""
+        nb = tables.shape[1] - 1
+        ptok = k_pool.shape[1]
+        dh = q.shape[-1]
+        # (B, nb, ptok, H, Dh) → (B, nb·ptok, H, Dh): the gather is
+        # bounded by the BLOCK BUCKET nb, not maxT — a short sequence
+        # attends over exactly the pages it occupies
+        k_rows = k_pool[tables[:, :nb]].reshape(
+            q.shape[0], nb * ptok, self.n_heads, dh)
+        v_rows = v_pool[tables[:, :nb]].reshape(
+            q.shape[0], nb * ptok, self.n_heads, dh)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k_rows) / jnp.sqrt(
+            jnp.float32(dh))
+        mask = jnp.arange(nb * ptok)[None, None, :] \
+            <= q_pos[:, :, None]
+        s = jnp.where(mask[:, None], s, -1e30)
+        s = s - s.max(axis=-1, keepdims=True)
+        p = jnp.exp(s)
+        p = p / p.sum(axis=-1, keepdims=True)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v_rows)
+
+    def _paged_write(self, pool, rows, tables, positions, live):
+        """Scatter (B, W, H, Dh) K or V rows through the block table at
+        global ``positions`` (B, W); lanes/positions with ``live``
+        False write into the trash page (last table entry)."""
+        ptok = pool.shape[1]
+        nb = tables.shape[1] - 1
+        block = jnp.minimum(positions // ptok, nb - 1)
+        block = jnp.where(live, block, nb)  # trash entry
+        page = jnp.take_along_axis(tables, block, axis=1)
+        off = jnp.where(live, positions % ptok, 0)
+        return pool.at[page, off].set(rows)
+
+    def xla_prefill_paged(self, x, k_pool, v_pool, table, start,
+                          length, w_qkv, b_qkv, w_out, b_out):
+        """Causal forward over a prompt WINDOW against the paged
+        cache: ``x`` (1, W, D) features of positions
+        ``start..start+W-1`` (right-padded past ``length`` real
+        tokens), ``table`` (nb+1,) the sequence's block row.  Writes
+        the window's K/V through the table, attends each window
+        position over the cached prefix PLUS the window itself
+        (``<= q_pos``), returns ``(y, k_pool, v_pool)``.
+
+        ``start=0`` is a fresh prefill; ``start>0`` is the tail
+        prefill after a prefix-cache hit — the shared pages below
+        ``start`` are read, never written (the window's writes begin
+        at ``start``, past every shared full block)."""
+        one, w, d = x.shape
+        q, k, v = self._project_qkv(x, w_qkv, b_qkv)
+        idx = jnp.arange(w)
+        positions = (start + idx)[None, :]
+        live = (idx < length)[None, :]
+        tables = table[None, :]
+        k_pool = self._paged_write(k_pool, k, tables, positions, live)
+        v_pool = self._paged_write(v_pool, v, tables, positions, live)
+        o = self._paged_attend(q, k_pool, v_pool, tables, positions)
+        return self._out_proj(o, w_out, b_out), k_pool, v_pool
+
+    def xla_decode_step_paged(self, x, k_pool, v_pool, tables, pos,
+                              w_qkv, b_qkv, w_out, b_out):
+        """One incremental token through the page table: ``x``
+        (B, 1, D), ``tables`` (B, nb+1), ``pos`` (B,) the position of
+        THIS token per lane (padded lanes carry the trash table and
+        write harmlessly there)."""
+        q, k, v = self._project_qkv(x, w_qkv, b_qkv)
+        positions = pos[:, None]
+        live = jnp.ones_like(positions, bool)
+        k_pool = self._paged_write(k_pool, k, tables, positions, live)
+        v_pool = self._paged_write(v_pool, v, tables, positions, live)
+        o = self._paged_attend(q, k_pool, v_pool, tables, positions)
+        return self._out_proj(o, w_out, b_out), k_pool, v_pool
+
+    def xla_window_paged(self, x, k_pool, v_pool, tables, pos,
+                         lengths, w_qkv, b_qkv, w_out, b_out):
+        """Batched multi-token WINDOW through the page table — the op
+        behind both speculative verification (window = last accepted
+        token + K drafts, ``lengths`` = K+1 everywhere) and batched
+        tail prefill (window = each lane's unshared prompt tail,
+        right-padded; admission coalescing for prefix-hit traffic).
+
+        ``x`` (B, W, D) window features starting at per-lane position
+        ``pos`` (B,); positions past ``lengths`` (B,) write into the
+        trash page.  Writes all live K/V, attends each window
+        position causally over prefix+window in ONE batched forward.
+        Stale/overflow rows beyond a lane's real positions sit past
+        the position mask exactly like a reused slot's rows."""
+        b, w, d = x.shape
+        q, k, v = self._project_qkv(x, w_qkv, b_qkv)
+        idx = jnp.arange(w)[None, :]
+        positions = pos[:, None] + idx
+        live = idx < lengths[:, None]
+        k_pool = self._paged_write(k_pool, k, tables, positions, live)
+        v_pool = self._paged_write(v_pool, v, tables, positions, live)
+        o = self._paged_attend(q, k_pool, v_pool, tables, positions)
+        return self._out_proj(o, w_out, b_out), k_pool, v_pool
+
     # -- numpy oracle ---------------------------------------------------
     def _forward_np(self, x):
         b, t, d = x.shape

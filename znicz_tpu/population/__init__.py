@@ -13,8 +13,9 @@ workload (ROADMAP item 5).
 
 from znicz_tpu.population.engine import (PopulationRegion,  # noqa: F401
                                          PopulationTrainer,
-                                         harvest_state, leaf_keys)
+                                         harvest_state, leaf_keys,
+                                         train_drafter)
 from znicz_tpu.population import evolution  # noqa: F401
 
 __all__ = ["PopulationRegion", "PopulationTrainer", "evolution",
-           "harvest_state", "leaf_keys"]
+           "harvest_state", "leaf_keys", "train_drafter"]

@@ -732,3 +732,39 @@ class PopulationTrainer(Logger):
         from znicz_tpu.resilience.publisher import publish_bundle
         self.install_best()
         return publish_bundle(self.template, directory, prefix=prefix)
+
+
+def train_drafter(build_fn: Callable, n_members: int = 4, *,
+                  publish_dir: str, prefix: str = "drafter",
+                  mesh=None, base_seed: int = 211,
+                  lr_bounds: tuple[float, float] = (0.01, 0.4),
+                  evolve: str = "pbt", evolve_every: int = 2,
+                  seed: int = 97, name: str = "drafter",
+                  **trainer_kwargs) -> tuple[int, str,
+                                             "PopulationTrainer"]:
+    """The speculative-decoding drafter hook (round 15): train a
+    SMALL causal-LM population with the round-14 engine, publish the
+    best member through the round-13 pipeline, and hand the bundle
+    path to the decode engine's draft/verify loop.
+
+    ``build_fn`` must produce the drafter architecture (a tiny
+    token-first chain — embedding → causal attention → last_token →
+    softmax); the population varies seeds and learning rates, trains
+    every member in ONE vmapped jit region, and the fittest member
+    becomes the drafter.  A drafter is pure throughput machinery —
+    the big model's verification forward decides every token, so a
+    mediocre drafter costs acceptance rate, never correctness.
+
+    Returns ``(version, bundle_path, trainer)`` — the bundle carries
+    the usual sha256 sidecar, so a
+    :class:`~znicz_tpu.resilience.publisher.PublicationWatcher` can
+    also hot-refresh drafters later."""
+    trainer = PopulationTrainer(
+        build_fn, n_members, base_seed=base_seed, mesh=mesh,
+        lr_bounds=lr_bounds, evolve=evolve,
+        evolve_every=evolve_every, seed=seed, name=name,
+        **trainer_kwargs)
+    trainer.initialize()
+    trainer.run()
+    version, path = trainer.publish_best(publish_dir, prefix=prefix)
+    return version, path, trainer

@@ -29,6 +29,7 @@ from znicz_tpu.serving.batcher import (  # noqa: F401
     DeadlineExceeded,
     Overloaded,
     QueueFull,
+    TokenBudget,
 )
 from znicz_tpu.serving.buckets import (  # noqa: F401
     bucket_for,
@@ -39,6 +40,9 @@ from znicz_tpu.serving.decode import (  # noqa: F401
     DecodeEngine,
     DecodeModel,
     KVCache,
+    PagedKVCache,
+    PoolExhausted,
+    PrefixCache,
 )
 from znicz_tpu.serving.engine import (  # noqa: F401
     ServingEngine,

@@ -82,6 +82,51 @@ _CLOSED, _HALF_OPEN, _OPEN = "closed", "half_open", "open"
 _STATE_CODE = {_CLOSED: 0, _HALF_OPEN: 1, _OPEN: 2}
 
 
+class TokenBudget:
+    """Token-denominated admission budget (round 15).
+
+    The row-bounded queue above fits one-shot scoring, where every
+    request costs one program dispatch; a *decode* queue holds work
+    proportional to ``prompt + max_new_tokens`` TOKENS per request,
+    and the paged KV pool's capacity is tokens too — so the decode
+    engine bounds admission in the same currency.  ``try_acquire`` is
+    non-blocking (admission control wants an immediate
+    :class:`QueueFull`, never a hidden wait); ``release`` returns a
+    request's charge when it completes, fails or expires."""
+
+    __slots__ = ("capacity", "_used", "_lock")
+
+    def __init__(self, capacity: int) -> None:
+        if capacity < 1:
+            raise ValueError(f"need capacity >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._used = 0
+        self._lock = threading.Lock()
+
+    @property
+    def used(self) -> int:
+        return self._used
+
+    @property
+    def available(self) -> int:
+        return self.capacity - self._used
+
+    def try_acquire(self, n: int) -> bool:
+        n = int(n)
+        with self._lock:
+            # a request bigger than the whole budget must still be
+            # admissible when the queue is empty, or it could never
+            # run at all — the pool-fit check downstream decides
+            if self._used + n > self.capacity and self._used > 0:
+                return False
+            self._used += n
+            return True
+
+    def release(self, n: int) -> None:
+        with self._lock:
+            self._used = max(0, self._used - int(n))
+
+
 class Request:
     """One submitted batch of rows riding the queue."""
 

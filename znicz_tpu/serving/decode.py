@@ -49,16 +49,56 @@ server, built from three pieces:
    (EOS, token budget, or the bucketed max-T page boundary) so a
    long straggler never holds the batch hostage.
 
+Round 15 rebuilds the decode *data plane* around a **paged KV-cache**
+(``engine.paged_kv``, default on; the flat per-slot layout above stays
+as the measured A/B arm), the vLLM PagedAttention idea (Kwon et al.
+2023) expressed in XLA terms:
+
+4. **Paged KV-cache** (:class:`PagedKVCache`) — K/V live in a shared
+   page *pool* of fixed ``kv_page_tokens``-token blocks addressed
+   through a per-sequence block table, so a sequence holds exactly the
+   pages its length needs instead of reserving ``max_t`` rows, live
+   capacity is bounded by **tokens** (``pool_tokens``), not slots, and
+   attention programs are bucketed on the **block count** — a short
+   sequence's decode step reads only the pages it occupies, not the
+   full ``max_t`` reservation the flat layout gathers every token.
+
+5. **Prefix sharing** (:class:`PrefixCache`) — prompts are hashed
+   block-by-block into a radix trie at admission; requests with a
+   common prompt prefix (the dominant system-prompt traffic shape)
+   *share* the prefix's full pages by reference (refcounted), a
+   partially-matched boundary block is **copied on write** before the
+   divergent tail lands, and the tail alone pays prefill.  Pages are
+   pinned by the trie, evicted LRU under pool pressure, and the whole
+   cache invalidates on a weight swap (cached K/V are a function of
+   the weights).
+
+6. **Speculative decoding** — a small *drafter* bundle (a population
+   member trained by the round-14 engine and published through the
+   round-13 pipeline) proposes ``spec_draft_k`` greedy tokens per
+   step; the big model verifies the whole window in ONE batched
+   forward (:meth:`DecodeModel.run_verify`) and accepts per
+   Leviathan's rule — greedy arms stay token-identical to
+   non-speculative decoding by construction, temperature arms use the
+   exact rejection-sampling correction.
+
 Telemetry splits decode latency into its two canonical halves —
 ``znicz_serving_ttft_seconds`` (queue + prefill + first sample) and
 ``znicz_serving_token_seconds`` (steady-state cadence) — because the
 two move independently: admission policy moves TTFT, cache residency
-moves per-token.  Resilience (round 11 carried forward):
-``deadline_ms`` applies to **TTFT** — a prompt still queued past its
-deadline is evicted before prefill and never occupies a slot — and
-the circuit breaker sheds *new prompts* with fast
-:class:`Overloaded` replies while in-flight decodes drain to
-completion.
+moves per-token.  TTFT clocks stamp from **admission-eligible** time:
+a swap drain's admission pause (accumulated in
+``znicz_swap_pause_seconds_total``) is excluded, so soak histograms
+measure serving, not the drain policy.  Paged state rides
+``znicz_kv_pages_{total,used}``, ``znicz_prefix_cache_total{hit|miss}``
+and ``znicz_spec_tokens_total{accepted|rejected}``.  Resilience
+(round 11 carried forward): ``deadline_ms`` applies to **TTFT** — a
+prompt still queued past its deadline is evicted before prefill and
+never occupies a slot — and the circuit breaker sheds *new prompts*
+with fast :class:`Overloaded` replies while in-flight decodes drain to
+completion; **page-pool exhaustion** trips the same breaker, so a
+token-capacity overload sheds exactly like a failure-rate overload
+while draining lanes release their pages.
 """
 
 from __future__ import annotations
@@ -80,7 +120,15 @@ from znicz_tpu.serving.batcher import (_CLOSED, _HALF_OPEN, _OPEN,
 from znicz_tpu.serving.buckets import bucket_for, ladder, next_pow2
 from znicz_tpu.utils.logger import Logger
 
-__all__ = ["DecodeModel", "DecodeEngine", "KVCache"]
+__all__ = ["DecodeModel", "DecodeEngine", "KVCache", "PagedKVCache",
+           "PrefixCache", "PoolExhausted"]
+
+
+class PoolExhausted(RuntimeError):
+    """The paged KV pool has no free page for a required block.  The
+    engine translates this into breaker load-shedding (queued prompts)
+    or a graceful force-finish (an in-flight lane crossing a block
+    boundary) — it never kills neighbors."""
 
 #: distinguishes same-named engines in the registry's labels
 _DECODE_SEQ = itertools.count()
@@ -148,6 +196,296 @@ class KVCache:
         return int(sum(a.size * a.dtype.itemsize for a in self.arrays))
 
 
+class PagedKVCache:
+    """Paged decode state: per-attention-layer K/V page POOLS plus the
+    host-side block tables, refcounts and free lists.
+
+    Geometry: each pool array is ``(pool_pages + 1, page_tokens, H,
+    Dh)`` — the last row is the **trash page** where padded lanes and
+    padded window positions scatter their garbage writes.  A sequence
+    in slot ``s`` owns ``tables[s]``: one page id per
+    ``page_tokens``-token block of its positions, ``trash_page`` where
+    no block is allocated.  LSTM carries (``kind="slot"`` specs) stay
+    slot-indexed exactly like the flat cache — they are O(H) per
+    sequence, not O(T), so paging buys them nothing.
+
+    Sharing: a page's ``ref`` counts every holder — each sequence
+    whose table maps a block to it, plus the prefix trie's pin.  Pages
+    free when the count hits zero.  Shared pages (``ref > 1`` or
+    trie-pinned) are never written: writes always land at a
+    sequence's *append* position, past every shared full block, and
+    the boundary block of a partial prefix match is copied
+    (:meth:`DecodeModel.copy_page`) before the divergent tail lands —
+    the copy-on-write contract tests/test_paged_decode.py pins.
+
+    All mutating calls happen on the scheduler thread (same
+    single-writer discipline as the flat cache); the gauges read
+    integers racily, which is fine for telemetry.
+    """
+
+    def __init__(self, specs: list[tuple[str, str, tuple]],
+                 max_slots: int, page_tokens: int, max_blocks: int,
+                 pool_pages: int, dtype=np.float32) -> None:
+        import jax.numpy as jnp
+        self.max_slots = int(max_slots)
+        self.trash_slot = self.max_slots
+        self.page_tokens = int(page_tokens)
+        self.max_blocks = int(max_blocks)
+        self.pool_pages = int(pool_pages)
+        self.trash_page = self.pool_pages
+        self.specs = list(specs)
+        arrays = []
+        for _name, kind, shape in specs:
+            if kind == "page":
+                arrays.append(jnp.zeros(
+                    (self.pool_pages + 1, self.page_tokens)
+                    + tuple(shape), dtype))
+            else:  # slot-indexed (LSTM carries)
+                arrays.append(jnp.zeros(
+                    (self.max_slots + 1,) + tuple(shape), dtype))
+        self.arrays: tuple = tuple(arrays)
+        #: indices (into ``arrays``) of the page pools — the leaves
+        #: :meth:`DecodeModel.copy_page` must copy on a COW
+        self.pool_indices = tuple(i for i, (_n, k, _s)
+                                  in enumerate(specs) if k == "page")
+        self.tables = np.full((self.max_slots + 1, self.max_blocks),
+                              self.trash_page, np.int32)
+        self.ref = np.zeros(self.pool_pages, np.int64)
+        self._free_pages = list(range(self.pool_pages - 1, -1, -1))
+        self._free = list(range(self.max_slots))
+
+    # -- slots (same protocol as the flat cache) -----------------------
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    def acquire(self) -> int:
+        return self._free.pop()
+
+    def release(self, slot: int) -> None:
+        self._free.append(slot)
+
+    # -- pages ---------------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_pages)
+
+    def pages_used(self) -> int:
+        return self.pool_pages - len(self._free_pages)
+
+    def alloc_page(self) -> int:
+        if not self._free_pages:
+            raise PoolExhausted(
+                f"KV page pool exhausted ({self.pool_pages} pages "
+                f"x {self.page_tokens} tokens all held)")
+        pid = self._free_pages.pop()
+        self.ref[pid] = 1
+        return pid
+
+    def free_page(self, pid: int) -> None:
+        self._free_pages.append(pid)
+
+    def ref_dec(self, pid: int) -> None:
+        self.ref[pid] -= 1
+        if self.ref[pid] == 0:
+            self.free_page(pid)
+
+    def share_block(self, slot: int, block: int, pid: int) -> None:
+        """Map ``block`` of ``slot`` to an EXISTING page by reference
+        (prefix sharing)."""
+        self.tables[slot, block] = pid
+        self.ref[pid] += 1
+
+    def new_block(self, slot: int, block: int) -> int:
+        """Allocate a fresh private page for ``block`` of ``slot``."""
+        pid = self.alloc_page()
+        self.tables[slot, block] = pid
+        return pid
+
+    def blocks_of(self, slot: int) -> list[int]:
+        return [int(p) for p in self.tables[slot]
+                if p != self.trash_page]
+
+    def writable(self, slot: int, block: int) -> bool:
+        """May ``slot`` write into ``block``'s page?  True iff the
+        page is private (ref exactly 1 — this sequence, no sharers,
+        no trie pin)."""
+        pid = int(self.tables[slot, block])
+        return pid != self.trash_page and int(self.ref[pid]) == 1
+
+    def release_slot_pages(self, slot: int) -> None:
+        """Drop every page reference ``slot`` holds (pages free when
+        their last holder lets go) and reset its table row."""
+        for block in range(self.max_blocks):
+            pid = int(self.tables[slot, block])
+            if pid != self.trash_page:
+                self.ref_dec(pid)
+        self.tables[slot] = self.trash_page
+
+    def table_operand(self, slot: int, nb: int) -> np.ndarray:
+        """The (nb+1,) int32 table row a program dispatch reads: the
+        first ``nb`` block entries plus the trash page as the padded
+        write sink."""
+        out = np.empty(nb + 1, np.int32)
+        out[:nb] = self.tables[slot, :nb]
+        out[nb] = self.trash_page
+        return out
+
+    def trash_operand(self, nb: int) -> np.ndarray:
+        return np.full(nb + 1, self.trash_page, np.int32)
+
+    def nbytes(self) -> int:
+        return int(sum(a.size * a.dtype.itemsize for a in self.arrays))
+
+
+class _TrieNode:
+    __slots__ = ("key", "page", "children", "parent", "last_use")
+
+    def __init__(self, key, page, parent) -> None:
+        self.key = key          # the block's token ids (bytes key)
+        self.page = page        # page id (one per attention pool row)
+        self.children: dict = {}
+        self.parent = parent
+        self.last_use = 0
+
+
+class PrefixCache:
+    """Radix trie over block-aligned prompt prefixes.
+
+    Keys are the raw token ids of one full ``page_tokens`` block
+    (hashed by dict machinery); a path root→node spells a block-aligned
+    prompt prefix and carries one page id per block.  Matching at
+    admission walks full blocks, then refines into the boundary block:
+    the longest token-level common prefix with any child selects a
+    copy-on-write donor, so divergence mid-block still reuses the
+    shared positions' K/V.  Matches are capped at ``len(prompt) - 1``
+    tokens — the last prompt position is always recomputed, because
+    the first sampled token needs its logits.
+
+    Every node pins its page with one refcount; :meth:`evict` walks
+    leaves in LRU order under pool pressure, and :meth:`clear` drops
+    everything (a weight swap invalidates all cached K/V)."""
+
+    def __init__(self, page_tokens: int) -> None:
+        self.page_tokens = int(page_tokens)
+        self.root = _TrieNode(None, None, None)
+        self.nodes = 0
+        self._clock = 0
+
+    def _tick(self, node: _TrieNode) -> None:
+        self._clock += 1
+        node.last_use = self._clock
+
+    @staticmethod
+    def _key(tokens: np.ndarray) -> bytes:
+        return np.ascontiguousarray(tokens, np.int32).tobytes()
+
+    def match(self, tokens: np.ndarray
+              ) -> tuple[list[int], int, tuple | None]:
+        """Longest cached prefix of ``tokens`` (capped at ``n-1``):
+        returns ``(full_block_pages, matched_tokens, cow)`` where
+        ``cow`` is ``(donor_page, extra_tokens)`` for a partial
+        boundary-block match (``matched_tokens`` already includes
+        ``extra_tokens``) or ``None``."""
+        n = int(tokens.shape[0])
+        ptok = self.page_tokens
+        node = self.root
+        pages: list[int] = []
+        matched = 0
+        while matched + ptok <= n - 1:
+            child = node.children.get(
+                self._key(tokens[matched:matched + ptok]))
+            if child is None:
+                break
+            node = child
+            self._tick(node)
+            pages.append(node.page)
+            matched += ptok
+        # boundary refinement: the longest token-level common prefix
+        # with any child of the last matched node
+        tail = tokens[matched:min(n - 1, matched + ptok)]
+        best, best_common = None, 0
+        if len(tail) > 0:
+            for child in node.children.values():
+                key = np.frombuffer(child.key, np.int32)
+                m = int(np.argmin(np.equal(
+                    key[:len(tail)], tail).astype(np.int8))) \
+                    if not np.array_equal(key[:len(tail)], tail) \
+                    else len(tail)
+                if m > best_common:
+                    best, best_common = child, m
+        if best is not None and best_common > 0:
+            self._tick(best)
+            return pages, matched + best_common, (best.page,
+                                                  best_common)
+        return pages, matched, None
+
+    def insert(self, tokens: np.ndarray, table_row: np.ndarray,
+               cache: PagedKVCache) -> int:
+        """Register every FULL prompt block of ``tokens`` (pages from
+        the sequence's ``table_row``); new nodes pin their page with
+        one extra refcount.  Returns nodes added."""
+        n = int(tokens.shape[0])
+        ptok = self.page_tokens
+        node = self.root
+        added = 0
+        for block in range(n // ptok):
+            key = self._key(tokens[block * ptok:(block + 1) * ptok])
+            child = node.children.get(key)
+            if child is None:
+                pid = int(table_row[block])
+                if pid == cache.trash_page:
+                    break  # not materialized (shouldn't happen)
+                child = _TrieNode(key, pid, node)
+                node.children[key] = child
+                cache.ref[pid] += 1  # the trie's pin
+                self.nodes += 1
+                added += 1
+            node = child
+            self._tick(node)
+        return added
+
+    def _leaves(self) -> list[_TrieNode]:
+        out, stack = [], [self.root]
+        while stack:
+            node = stack.pop()
+            if node is not self.root and not node.children:
+                out.append(node)
+            stack.extend(node.children.values())
+        return out
+
+    def evict(self, cache: PagedKVCache, pages_needed: int) -> int:
+        """Unpin LRU leaf blocks until ``pages_needed`` pages are
+        free (or the trie is empty).  An unpinned page frees
+        immediately when no live sequence still references it.
+        Returns nodes evicted."""
+        evicted = 0
+        while cache.free_pages < pages_needed:
+            leaves = self._leaves()
+            if not leaves:
+                break
+            victim = min(leaves, key=lambda n: n.last_use)
+            victim.parent.children.pop(victim.key)
+            cache.ref_dec(victim.page)
+            self.nodes -= 1
+            evicted += 1
+        return evicted
+
+    def clear(self, cache: PagedKVCache) -> int:
+        """Drop the whole trie (weight swap: cached K/V are functions
+        of the OLD weights).  Returns nodes dropped."""
+        dropped = 0
+        stack = list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            cache.ref_dec(node.page)
+            dropped += 1
+        self.root.children.clear()
+        self.nodes = 0
+        return dropped
+
+
 class DecodeModel(Logger):
     """Prefill/decode program families + KV cache over an exported LM.
 
@@ -164,17 +502,44 @@ class DecodeModel(Logger):
       (a sequence reaching it is force-finished);
     - ``max_prompt`` / ``prompt_align`` — the prompt-length ladder:
       prefill programs exist for ``prompt_align·2^k ≤ max_prompt``.
+
+    Paged knobs (round 15; every default reads the manifest's
+    ``decode`` section first, then ``root.common.engine``):
+
+    - ``paged`` — page the KV-cache (``engine.paged_kv``, default on;
+      ``False`` = the flat per-slot A/B arm, greedy token-identical);
+    - ``page_tokens`` — tokens per page (``engine.kv_page_tokens``,
+      default 16; power of two dividing ``max_t``);
+    - ``pool_tokens`` — the pool's token capacity
+      (default ``max_slots · max_t`` — the flat cache's exact byte
+      budget, so the paged arm never wins by spending more memory);
+    - ``spec_k`` — compile the speculative-verification family for
+      ``spec_k``-token draft windows (0 = off).
     """
 
     def __init__(self, model, *, max_slots: int = 4,
                  max_t: int = 64, max_prompt: int | None = None,
-                 prompt_align: int = 8, device=None) -> None:
+                 prompt_align: int = 8, device=None,
+                 paged: bool | None = None,
+                 page_tokens: int | None = None,
+                 pool_tokens: int | None = None,
+                 spec_k: int = 0) -> None:
         super().__init__()
         from znicz_tpu.export import ExportedModel
+        from znicz_tpu.utils.config import root
         if isinstance(model, (str, bytes)) or hasattr(model,
                                                       "__fspath__"):
             model = ExportedModel.load(model, device=device)
         self.model = model
+        decode_meta = dict(model.manifest.get("decode", {}))
+        if paged is None:
+            paged = bool(root.common.engine.get("paged_kv", True))
+        self.paged = bool(paged)
+        if page_tokens is None:
+            page_tokens = int(decode_meta.get(
+                "kv_page_tokens",
+                root.common.engine.get("kv_page_tokens", 16)))
+        self.spec_k = int(spec_k)
         if model.kind != "lm":
             raise ValueError(
                 f"bundle '{model.manifest.get('workflow', '?')}' is a "
@@ -208,9 +573,43 @@ class DecodeModel(Logger):
                 f"max_prompt")
         self.device = model.device
         self._plan, cache_specs = self._build_plan()
-        self.cache = KVCache(cache_specs, self.max_slots)
+        self.has_lstm = any(kind == "lstm" for _n, kind, _s
+                            in cache_specs)
+        if self.paged:
+            self.page_tokens = next_pow2(
+                min(int(page_tokens), self.max_t))
+            self.max_blocks = self.max_t // self.page_tokens
+            if pool_tokens is None:
+                pool_tokens = int(decode_meta.get(
+                    "pool_tokens", self.max_slots * self.max_t))
+            pool_pages = max(1, int(pool_tokens) // self.page_tokens)
+            self.pool_tokens = pool_pages * self.page_tokens
+            self.cache = PagedKVCache(
+                [(name, "page" if kind == "attention" else "slot",
+                  (shape[-2], shape[-1]) if kind == "attention"
+                  else shape)
+                 for name, kind, shape in cache_specs],
+                self.max_slots, self.page_tokens, self.max_blocks,
+                pool_pages)
+        else:
+            self.page_tokens = self.max_t
+            self.max_blocks = 1
+            self.pool_tokens = self.max_slots * self.max_t
+            self.cache = KVCache(
+                [(name, shape) for name, _kind, shape in cache_specs],
+                self.max_slots)
+        if self.spec_k and (not self.paged or self.has_lstm):
+            raise ValueError(
+                "speculative decoding needs the paged cache and an "
+                "attention-only sequence phase (LSTM carries cannot "
+                "roll back a rejected draft)")
         self._prefill_programs: dict[int, "callable"] = {}
         self._decode_programs: dict[int, "callable"] = {}
+        #: paged families, keyed (t_bucket, nb) / (b_bucket, nb)
+        self._paged_prefill_programs: dict[tuple, "callable"] = {}
+        self._paged_decode_programs: dict[tuple, "callable"] = {}
+        self._verify_programs: dict[tuple, "callable"] = {}
+        self._copy_program = None
         self.compile_count = 0
         self.donating = model._donate_choice()
         # the published weight pytree: one immutable tuple-of-tuples
@@ -264,8 +663,12 @@ class DecodeModel(Logger):
                 plan.append(_Op(kind, unit, (f"layer{i}_weights",)))
             elif kind == "pos_encoding":
                 import jax.numpy as jnp
+                # 2×max_t rows: paged tail-prefill windows slice at
+                # an arbitrary start and must never hit the
+                # dynamic_slice clamp (rows ≥ max_t feed only padded
+                # positions, whose outputs are discarded)
                 table = jnp.asarray(
-                    unit.table_to(self.max_t, d), jnp.float32)
+                    unit.table_to(2 * self.max_t, d), jnp.float32)
                 plan.append(_Op(kind, unit, table=table))
             elif kind == "attention":
                 if not spec.get("config", {}).get("causal"):
@@ -277,17 +680,17 @@ class DecodeModel(Logger):
                 dh = d // heads
                 k_idx = len(cache_specs)
                 cache_specs.append(
-                    (f"l{i}.k", (self.max_t, heads, dh)))
+                    (f"l{i}.k", "attention", (self.max_t, heads, dh)))
                 cache_specs.append(
-                    (f"l{i}.v", (self.max_t, heads, dh)))
+                    (f"l{i}.v", "attention", (self.max_t, heads, dh)))
                 plan.append(_Op(kind, unit, (
                     f"layer{i}_weights", f"layer{i}_bias",
                     f"layer{i}_weights_out", f"layer{i}_bias_out"),
                     aux={"k": k_idx, "v": k_idx + 1}))
             elif kind == "lstm":
                 h_idx = len(cache_specs)
-                cache_specs.append((f"l{i}.h", (unit.units,)))
-                cache_specs.append((f"l{i}.c", (unit.units,)))
+                cache_specs.append((f"l{i}.h", "lstm", (unit.units,)))
+                cache_specs.append((f"l{i}.c", "lstm", (unit.units,)))
                 plan.append(_Op(kind, unit, (
                     f"layer{i}_weights", f"layer{i}_bias"),
                     aux={"h": h_idx, "c": h_idx + 1}))
@@ -433,6 +836,157 @@ class DecodeModel(Logger):
         return fn
 
     # ------------------------------------------------------------------
+    # traced bodies — paged variants (round 15)
+    # ------------------------------------------------------------------
+    def _paged_prefill_fn(self, t_bucket: int, nb: int):
+        """One prompt WINDOW (fresh prefill at ``start=0``, or the
+        unshared tail after a prefix-cache hit at ``start>0``) written
+        and attended through the page table.  ``table`` carries nb+1
+        page ids (last = trash)."""
+        import jax
+        import jax.numpy as jnp
+        plan = self._plan
+
+        def fn(caches, weights, tokens, table, slot, start, length):
+            # tokens (1, t_bucket); table (nb+1,); slot/start/length ()
+            caches = list(caches)
+            feat = None
+            logits = None
+            for j, op in enumerate(plan):
+                w = weights[j]
+                if op.kind == "embedding":
+                    feat = op.unit.xla_embed(w[0], tokens)
+                elif op.kind == "pos_encoding":
+                    pe = jax.lax.dynamic_slice_in_dim(
+                        op.table, start, t_bucket, axis=0)
+                    feat = feat.astype(jnp.float32) + pe[None]
+                elif op.kind == "attention":
+                    feat, kp, vp = op.unit.xla_prefill_paged(
+                        feat, caches[op.aux["k"]], caches[op.aux["v"]],
+                        table, start, length, *w)
+                    caches[op.aux["k"]] = kp
+                    caches[op.aux["v"]] = vp
+                elif op.kind == "lstm":
+                    # LSTM chains never share prefixes (start is
+                    # always 0): the carry is the whole-prefix state
+                    feat, h, c = op.unit.xla_prefill(
+                        feat, *w, length=jnp.reshape(length, (1,)))
+                    caches[op.aux["h"]] = \
+                        caches[op.aux["h"]].at[slot].set(h[0])
+                    caches[op.aux["c"]] = \
+                        caches[op.aux["c"]].at[slot].set(c[0])
+                elif op.kind == "last_token":
+                    feat = jax.lax.dynamic_index_in_dim(
+                        feat, length - 1, axis=1, keepdims=False)
+                else:
+                    logits = self._head(op, w, feat, op is plan[-1])
+                    feat = logits
+            return tuple(caches), logits
+        return fn
+
+    def _paged_decode_fn(self, b_bucket: int, nb: int):
+        """Single-token step through the page table, bucketed on BOTH
+        the live-batch size and the deepest lane's block count — a
+        shallow batch reads exactly the pages it occupies, never the
+        flat layout's full ``max_t`` reservation."""
+        plan = self._plan
+
+        def fn(caches, weights, tokens, tables, slots, positions):
+            # tokens/slots/positions (b,); tables (b, nb+1)
+            import jax.numpy as jnp
+            caches = list(caches)
+            feat = None
+            logits = None
+            for j, op in enumerate(plan):
+                w = weights[j]
+                if op.kind == "embedding":
+                    feat = op.unit.xla_embed(w[0], tokens)[:, None, :]
+                elif op.kind == "pos_encoding":
+                    feat = op.unit.xla_decode_step(feat, positions,
+                                                   op.table)
+                elif op.kind == "attention":
+                    feat, kp, vp = op.unit.xla_decode_step_paged(
+                        feat, caches[op.aux["k"]], caches[op.aux["v"]],
+                        tables, positions, *w)
+                    caches[op.aux["k"]] = kp
+                    caches[op.aux["v"]] = vp
+                elif op.kind == "lstm":
+                    h = caches[op.aux["h"]][slots]
+                    c = caches[op.aux["c"]][slots]
+                    feat, h, c = op.unit.xla_decode_step(
+                        feat, h, c, *w)
+                    caches[op.aux["h"]] = \
+                        caches[op.aux["h"]].at[slots].set(h)
+                    caches[op.aux["c"]] = \
+                        caches[op.aux["c"]].at[slots].set(c)
+                    if op.unit.return_sequence:
+                        feat = feat[:, None, :]
+                elif op.kind == "last_token":
+                    feat = feat[:, 0]
+                else:
+                    if feat.ndim == 3:
+                        feat = feat[:, 0]
+                    logits = self._head(op, w, feat, op is plan[-1])
+                    feat = logits
+            return tuple(caches), logits
+        return fn
+
+    def _window_fn(self, b_bucket: int, w_len: int, nb: int):
+        """Batched multi-token window per lane, written and attended
+        through the page table in ONE forward, returning logits at
+        EVERY window position (b, W, V).  Two callers: speculative
+        verification (window = last accepted token + K drafts,
+        lengths ≡ K+1) and batched tail prefill (window = each lane's
+        unshared prompt tail, ragged ``lengths`` — admission
+        coalescing, so a burst of prefix-hit prompts pays ONE
+        dispatch instead of one each)."""
+        import jax.numpy as jnp
+        plan = self._plan
+
+        def fn(caches, weights, tokens, tables, positions, lengths):
+            # tokens (b, W); tables (b, nb+1); positions/lengths (b,)
+            caches = list(caches)
+            feat = None
+            logits = None
+            for j, op in enumerate(plan):
+                w = weights[j]
+                if op.kind == "embedding":
+                    feat = op.unit.xla_embed(w[0], tokens)
+                elif op.kind == "pos_encoding":
+                    idx = jnp.minimum(
+                        positions[:, None] + jnp.arange(w_len)[None],
+                        op.table.shape[0] - 1)
+                    feat = feat.astype(jnp.float32) + op.table[idx]
+                elif op.kind == "attention":
+                    feat, kp, vp = op.unit.xla_window_paged(
+                        feat, caches[op.aux["k"]], caches[op.aux["v"]],
+                        tables, positions, lengths, *w)
+                    caches[op.aux["k"]] = kp
+                    caches[op.aux["v"]] = vp
+                elif op.kind == "last_token":
+                    # every window position flows to the head: fold
+                    # the window into the batch for the head phase
+                    feat = feat.reshape(b_bucket * w_len, -1)
+                else:
+                    logits = self._head(op, w, feat, op is plan[-1])
+                    feat = logits
+            return tuple(caches), logits.reshape(b_bucket, w_len, -1)
+        return fn
+
+    def _copy_fn(self):
+        """Copy one page (every attention pool) — the copy-on-write
+        a partial prefix-cache match performs before the divergent
+        tail writes into the boundary block."""
+        pool_indices = self.cache.pool_indices
+
+        def fn(caches, src, dst):
+            caches = list(caches)
+            for i in pool_indices:
+                caches[i] = caches[i].at[dst].set(caches[i][src])
+            return tuple(caches)
+        return fn
+
+    # ------------------------------------------------------------------
     # AOT compilation
     # ------------------------------------------------------------------
     def _compile(self, fn, in_structs: tuple, site: str):
@@ -492,45 +1046,171 @@ class DecodeModel(Logger):
             self._decode_programs[b_bucket] = prog
         return prog
 
+    def paged_prefill_program(self, t_bucket: int, nb: int):
+        key = (t_bucket, nb)
+        prog = self._paged_prefill_programs.get(key)
+        if prog is None:
+            import jax
+            i32 = np.dtype(np.int32)
+            scalar = jax.ShapeDtypeStruct((), i32)
+            prog = self._compile(
+                self._paged_prefill_fn(t_bucket, nb),
+                (self._cache_structs(), self._weight_structs(),
+                 jax.ShapeDtypeStruct((1, t_bucket), i32),
+                 jax.ShapeDtypeStruct((nb + 1,), i32),
+                 scalar, scalar, scalar),
+                "serving-prefill")
+            self._paged_prefill_programs[key] = prog
+        return prog
+
+    def paged_decode_program(self, b_bucket: int, nb: int):
+        key = (b_bucket, nb)
+        prog = self._paged_decode_programs.get(key)
+        if prog is None:
+            import jax
+            i32 = np.dtype(np.int32)
+            vec = jax.ShapeDtypeStruct((b_bucket,), i32)
+            prog = self._compile(
+                self._paged_decode_fn(b_bucket, nb),
+                (self._cache_structs(), self._weight_structs(),
+                 vec, jax.ShapeDtypeStruct((b_bucket, nb + 1), i32),
+                 vec, vec),
+                "serving-decode")
+            self._paged_decode_programs[key] = prog
+        return prog
+
+    def window_program(self, b_bucket: int, w_len: int, nb: int,
+                       site: str = "serving-verify"):
+        key = (b_bucket, w_len, nb)
+        prog = self._verify_programs.get(key)
+        if prog is None:
+            import jax
+            i32 = np.dtype(np.int32)
+            vec = jax.ShapeDtypeStruct((b_bucket,), i32)
+            prog = self._compile(
+                self._window_fn(b_bucket, w_len, nb),
+                (self._cache_structs(), self._weight_structs(),
+                 jax.ShapeDtypeStruct((b_bucket, w_len), i32),
+                 jax.ShapeDtypeStruct((b_bucket, nb + 1), i32),
+                 vec, vec),
+                site)
+            self._verify_programs[key] = prog
+        return prog
+
+    def verify_program(self, b_bucket: int, nb: int):
+        if not self.spec_k:
+            raise RuntimeError("spec_k=0 — no verify family planned")
+        return self.window_program(b_bucket, self.spec_k + 1, nb)
+
+    def copy_program(self):
+        if self._copy_program is None:
+            import jax
+            i32 = np.dtype(np.int32)
+            self._copy_program = self._compile(
+                self._copy_fn(),
+                (self._cache_structs(),
+                 jax.ShapeDtypeStruct((), i32),
+                 jax.ShapeDtypeStruct((), i32)),
+                "serving-page")
+        return self._copy_program
+
     def prompt_ladder(self) -> list[int]:
         return ladder(self.max_prompt, self.prompt_align)
 
     def batch_ladder(self) -> list[int]:
         return ladder(self.max_slots)
 
-    def warmup(self) -> int:
-        """Compile BOTH program families up front — after this, a
-        decode loop at any live-batch size over any legal prompt mix
-        performs zero compiles.  Returns programs compiled."""
+    def block_ladder(self) -> list[int]:
+        """Power-of-two block-count buckets: a decode dispatch reads
+        only ``nb·page_tokens`` cache rows per lane."""
+        return ladder(self.max_blocks) if self.paged else [1]
+
+    def nb_for(self, top_position: int) -> int:
+        """The block bucket covering positions ``0..top_position``."""
+        blocks = -(-(int(top_position) + 1) // self.page_tokens)
+        return min(next_pow2(max(1, blocks)), self.max_blocks)
+
+    def fresh_nb(self, t_bucket: int) -> int:
+        return self.nb_for(t_bucket - 1)
+
+    def warmup(self, prefix_cache: bool = True) -> int:
+        """Compile EVERY program family up front — after this, a
+        decode loop at any live-batch size, block depth and prompt mix
+        performs zero compiles.  Returns programs compiled.
+
+        ``prefix_cache=False`` skips the tail-prefill (start>0)
+        variants and the COW copy program — engines without prefix
+        sharing never dispatch them."""
         before = self.compile_count
+        if not self.paged:
+            for t_b in self.prompt_ladder():
+                self.prefill_program(t_b)
+            for b_b in self.batch_ladder():
+                self.decode_program(b_b)
+            return self.compile_count - before
         for t_b in self.prompt_ladder():
-            self.prefill_program(t_b)
+            for nb in self.block_ladder():
+                if nb < self.fresh_nb(t_b):
+                    continue  # a window never shrinks its own blocks
+                if nb > self.fresh_nb(t_b) and not prefix_cache:
+                    continue  # start>0 exists only with prefix hits
+                self.paged_prefill_program(t_b, nb)
         for b_b in self.batch_ladder():
-            self.decode_program(b_b)
+            for nb in self.block_ladder():
+                self.paged_decode_program(b_b, nb)
+                if self.spec_k:
+                    self.verify_program(b_b, nb)
+                if prefix_cache and not self.has_lstm:
+                    # the admission-coalescing window family: a wave
+                    # of prefix-hit tails admits in ONE dispatch
+                    self.window_program(b_b, self.prompt_align, nb,
+                                        site="serving-prefill")
+        if prefix_cache:
+            self.copy_program()
         return self.compile_count - before
 
     @property
     def programs_live(self) -> int:
-        return len(self._prefill_programs) + len(self._decode_programs)
+        return (len(self._prefill_programs)
+                + len(self._decode_programs)
+                + len(self._paged_prefill_programs)
+                + len(self._paged_decode_programs)
+                + len(self._verify_programs)
+                + (1 if self._copy_program is not None else 0))
 
     # ------------------------------------------------------------------
     # dispatch (scheduler thread only — no locking needed on cache)
     # ------------------------------------------------------------------
-    def run_prefill(self, tokens: np.ndarray, slot: int
-                    ) -> np.ndarray:
-        """Prefill one prompt into ``slot``; returns the last real
-        position's logits (V,)."""
+    def run_prefill(self, tokens: np.ndarray, slot: int,
+                    start: int = 0) -> np.ndarray:
+        """Prefill one prompt window into ``slot``; returns the last
+        real position's logits (V,).  ``tokens`` are the positions
+        ``start..start+len-1`` — the whole prompt for a fresh
+        admission (``start=0``), the unshared tail after a
+        prefix-cache hit (paged only)."""
         n = int(tokens.shape[0])
-        if n > self.max_prompt:
-            raise ValueError(f"prompt of {n} tokens exceeds "
+        if start + n > self.max_prompt:
+            raise ValueError(f"prompt of {start + n} tokens exceeds "
                              f"max_prompt {self.max_prompt}")
         t_b = bucket_for(n, self.prompt_align)
         padded = np.zeros((1, t_b), np.int32)
         padded[0, :n] = tokens
-        prog = self.prefill_program(t_b)
-        caches, logits = prog(self.cache.arrays, self._weights, padded,
-                              np.asarray(slot, np.int32),
-                              np.asarray(n, np.int32))
+        if not self.paged:
+            if start:
+                raise ValueError("flat cache cannot tail-prefill")
+            prog = self.prefill_program(t_b)
+            caches, logits = prog(self.cache.arrays, self._weights,
+                                  padded, np.asarray(slot, np.int32),
+                                  np.asarray(n, np.int32))
+            self.cache.arrays = caches
+            return np.asarray(logits, np.float32)[0]
+        nb = self.nb_for(start + t_b - 1)
+        prog = self.paged_prefill_program(t_b, nb)
+        caches, logits = prog(
+            self.cache.arrays, self._weights, padded,
+            self.cache.table_operand(slot, nb),
+            np.asarray(slot, np.int32), np.asarray(start, np.int32),
+            np.asarray(n, np.int32))
         self.cache.arrays = caches
         return np.asarray(logits, np.float32)[0]
 
@@ -538,22 +1218,78 @@ class DecodeModel(Logger):
                    positions: np.ndarray) -> np.ndarray:
         """One token step for ``len(tokens)`` live lanes; pads to the
         covering live-batch bucket (padded lanes ride the scratch
-        slot).  Returns logits (n_live, V)."""
+        slot/trash table).  Returns logits (n_live, V)."""
         n = int(tokens.shape[0])
         b_b = bucket_for(n)
-        pad = b_b - n
 
         def padded(arr, fill):
             out = np.full((b_b,), fill, np.int32)
             out[:n] = arr
             return out
 
-        prog = self.decode_program(b_b)
+        if not self.paged:
+            prog = self.decode_program(b_b)
+            caches, logits = prog(
+                self.cache.arrays, self._weights, padded(tokens, 0),
+                padded(slots, self.cache.trash_slot),
+                padded(positions, 0))
+            self.cache.arrays = caches
+            return np.asarray(logits, np.float32)[:n]
+        nb = self.nb_for(int(positions.max()))
+        tables = np.full((b_b, nb + 1), self.cache.trash_page,
+                         np.int32)
+        tables[:n, :nb] = self.cache.tables[slots, :nb]
+        prog = self.paged_decode_program(b_b, nb)
         caches, logits = prog(
             self.cache.arrays, self._weights, padded(tokens, 0),
-            padded(slots, self.cache.trash_slot), padded(positions, 0))
+            tables, padded(slots, self.cache.trash_slot),
+            padded(positions, 0))
         self.cache.arrays = caches
         return np.asarray(logits, np.float32)[:n]
+
+    def run_window(self, windows: np.ndarray, slots: np.ndarray,
+                   positions: np.ndarray, lengths: np.ndarray,
+                   site: str = "serving-verify") -> np.ndarray:
+        """Batched window dispatch: ``windows`` (n, W) token windows
+        starting at per-lane ``positions`` with ``lengths`` real
+        tokens each; ONE forward writes all live K/V through the page
+        tables and returns logits (n, W, V)."""
+        n, w_len = windows.shape
+        b_b = bucket_for(n)
+        nb = self.nb_for(int(positions.max()) + w_len - 1)
+        win = np.zeros((b_b, w_len), np.int32)
+        win[:n] = windows
+        tables = np.full((b_b, nb + 1), self.cache.trash_page,
+                         np.int32)
+        tables[:n, :nb] = self.cache.tables[slots, :nb]
+        pos = np.zeros((b_b,), np.int32)
+        pos[:n] = positions
+        lens = np.zeros((b_b,), np.int32)
+        lens[:n] = lengths
+        prog = self.window_program(b_b, int(w_len), nb, site=site)
+        caches, logits = prog(self.cache.arrays, self._weights, win,
+                              tables, pos, lens)
+        self.cache.arrays = caches
+        return np.asarray(logits, np.float32)[:n]
+
+    def run_verify(self, windows: np.ndarray, slots: np.ndarray,
+                   positions: np.ndarray) -> np.ndarray:
+        """Speculative verification: ``windows`` (n, spec_k+1) token
+        windows starting at per-lane ``positions``; logits at every
+        window position (n, spec_k+1, V)."""
+        if not self.spec_k:
+            raise RuntimeError("spec_k=0 — no verify family planned")
+        lengths = np.full((windows.shape[0],), self.spec_k + 1,
+                          np.int32)
+        return self.run_window(windows, slots, positions, lengths)
+
+    def copy_page(self, src: int, dst: int) -> None:
+        """Device-copy one page across every attention pool — the COW
+        a partial prefix match pays before its divergent tail."""
+        prog = self.copy_program()
+        self.cache.arrays = prog(self.cache.arrays,
+                                 np.asarray(src, np.int32),
+                                 np.asarray(dst, np.int32))
 
     # ------------------------------------------------------------------
     # weight hot-swap (round 13)
@@ -626,10 +1362,17 @@ class DecodeModel(Logger):
 
 
 class _PromptReq:
-    """One queued generation request."""
+    """One queued generation request.
+
+    ``pause_s`` accumulates the admission-pause time (swap drains)
+    that overlapped this request's queue wait: TTFT observations and
+    the TTFT deadline both stamp from **admission-eligible** time
+    (``t_submit + pause_s``), so a drain neither pollutes the serving
+    SLO histograms nor expires a request the engine was forbidden to
+    admit (round-13 documented noise band, fixed in round 15)."""
 
     __slots__ = ("tokens", "n", "max_new", "future", "t_submit",
-                 "deadline")
+                 "deadline", "pause_s", "charged")
 
     def __init__(self, tokens: np.ndarray, max_new: int,
                  deadline_ms: float | None) -> None:
@@ -638,11 +1381,14 @@ class _PromptReq:
         self.max_new = int(max_new)
         self.future: Future = Future()
         self.t_submit = time.monotonic()
+        self.pause_s = 0.0
+        self.charged = 0  # tokens held against the admission budget
         self.deadline = (None if deadline_ms is None
                          else self.t_submit + float(deadline_ms) / 1e3)
 
     def expired(self, now: float) -> bool:
-        return self.deadline is not None and now >= self.deadline
+        return self.deadline is not None \
+            and now >= self.deadline + self.pause_s
 
 
 class _Live:
@@ -701,14 +1447,88 @@ class DecodeEngine(Logger):
                  breaker_window: int = 8,
                  breaker_min_samples: int = 4,
                  breaker_cooldown_ms: float = 1000.0,
+                 paged: bool | None = None,
+                 page_tokens: int | None = None,
+                 pool_tokens: int | None = None,
+                 prefix_cache: bool | None = None,
+                 spec_draft_k: int | None = None,
+                 drafter=None,
+                 max_queue_tokens: int | None = None,
+                 max_queue_age_ms: float = 10_000.0,
                  device=None) -> None:
         super().__init__()
+        from znicz_tpu.serving.batcher import TokenBudget
+        from znicz_tpu.utils.config import root
         if not isinstance(model, DecodeModel):
+            from znicz_tpu.export import ExportedModel
+            if isinstance(model, (str, bytes)) \
+                    or hasattr(model, "__fspath__"):
+                model = ExportedModel.load(model, device=device)
+            decode_meta = dict(model.manifest.get("decode", {}))
+            explicit_k = spec_draft_k is not None
+            if spec_draft_k is None:
+                spec_draft_k = int(decode_meta.get(
+                    "spec_draft_k",
+                    root.common.engine.get("spec_draft_k", 0)))
+            if drafter is None:
+                drafter = decode_meta.get("drafter")
+            if drafter is None:
+                if explicit_k and spec_draft_k:
+                    raise ValueError(
+                        "spec_draft_k > 0 needs a drafter bundle "
+                        "(path, ExportedModel or DecodeModel)")
+                spec_draft_k = 0  # default-config engines: spec off
             model = DecodeModel(model, max_slots=max_slots,
                                 max_t=max_t, max_prompt=max_prompt,
                                 prompt_align=prompt_align,
-                                device=device)
+                                device=device, paged=paged,
+                                page_tokens=page_tokens,
+                                pool_tokens=pool_tokens,
+                                spec_k=int(spec_draft_k or 0))
         self.model = model
+        self.spec_k = int(model.spec_k)
+        # the drafter: a SMALL published bundle (population-trained)
+        # decoding through its own flat cache at the same geometry —
+        # slot ids are shared with the big model, so the two caches
+        # track the same sequences
+        self.drafter: DecodeModel | None = None
+        if self.spec_k:
+            if drafter is None:
+                raise ValueError(
+                    "spec_draft_k > 0 needs a drafter bundle "
+                    "(path, ExportedModel or DecodeModel)")
+            if not isinstance(drafter, DecodeModel):
+                drafter = DecodeModel(
+                    drafter, max_slots=model.max_slots,
+                    max_t=model.max_t, max_prompt=model.max_prompt,
+                    prompt_align=model.prompt_align,
+                    device=device, paged=False, spec_k=0)
+            if drafter.vocab != model.vocab:
+                raise ValueError(
+                    f"drafter vocab {drafter.vocab} != model vocab "
+                    f"{model.vocab} — the draft/verify token spaces "
+                    f"must agree")
+            self.drafter = drafter
+        if prefix_cache is None:
+            prefix_cache = bool(root.common.engine.get(
+                "prefix_cache", True))
+        # prefix sharing needs the page table and position-indexed
+        # state only (LSTM carries summarize the WHOLE prefix in one
+        # vector — nothing block-shaped to share)
+        self.prefix_cache_enabled = bool(
+            prefix_cache and model.paged and not model.has_lstm)
+        self.prefix = (PrefixCache(model.page_tokens)
+                       if self.prefix_cache_enabled else None)
+        self._token_budget = None
+        if model.paged:
+            budget = (int(max_queue_tokens) if max_queue_tokens
+                      else 16 * model.pool_tokens)
+            self._token_budget = TokenBudget(budget)
+        #: pool-exhaustion shed threshold: a full pool with a YOUNG
+        #: queue is normal continuous-batching backlog (requeue and
+        #: wait for a lane to drain); only a STALLED queue sheds —
+        #: the same age semantics as the batcher's stall trip
+        self.max_queue_age = float(max_queue_age_ms) / 1e3
         if admission not in ("continuous", "static"):
             raise ValueError(f"admission must be 'continuous' or "
                              f"'static', got {admission!r}")
@@ -740,6 +1560,30 @@ class DecodeEngine(Logger):
         self._m_slots = _metrics.serving_decode_slots(self._obs_id)
         self._m_state = _metrics.serving_breaker_state(self._obs_id)
         self._m_state.set(_STATE_CODE[_CLOSED])
+        # round 15: paged/prefix/speculation canonical series
+        self._m_swap_pause = _metrics.swap_pause_seconds(self._obs_id)
+        if model.paged:
+            _metrics.kv_pages_total(self._obs_id).set(
+                model.cache.pool_pages)
+            _metrics.kv_pages_used(self._obs_id).set_function(
+                model.cache.pages_used)
+        self._m_prefix_hit = _metrics.prefix_cache_events(
+            self._obs_id, "hit")
+        self._m_prefix_miss = _metrics.prefix_cache_events(
+            self._obs_id, "miss")
+        self._m_tok_shared = _metrics.prefix_tokens(self._obs_id,
+                                                    "shared")
+        self._m_tok_computed = _metrics.prefix_tokens(self._obs_id,
+                                                      "computed")
+        self._m_spec_acc = _metrics.spec_tokens(self._obs_id,
+                                                "accepted")
+        self._m_spec_rej = _metrics.spec_tokens(self._obs_id,
+                                                "rejected")
+        self.page_truncations = 0
+        #: breaker opened by pool pressure (not failures): it closes
+        #: again the moment a requeued prompt admits — capacity
+        #: recovery needs no cooldown, unlike a failing backend
+        self._pool_tripped = False
         # exact-value windows for dashboard percentiles
         self._ttft_win: deque = deque(maxlen=4096)
         self._token_win: deque = deque(maxlen=4096)
@@ -776,7 +1620,10 @@ class DecodeEngine(Logger):
         if self._started:
             return self
         t0 = time.monotonic()
-        self.warmup_compiles = self.model.warmup()
+        self.warmup_compiles = self.model.warmup(
+            prefix_cache=self.prefix_cache_enabled)
+        if self.drafter is not None:
+            self.warmup_compiles += self.drafter.warmup()
         self.warmup_seconds = time.monotonic() - t0
         self._thread = threading.Thread(target=self._loop,
                                         name="decode-scheduler",
@@ -785,12 +1632,15 @@ class DecodeEngine(Logger):
         self._thread.start()
         self.info(
             "decode '%s': %d AOT programs warmed in %.2fs (prompt "
-            "buckets %s, batch buckets %s, slots=%d, max_t=%d, "
-            "cache=%.1f MB, donate=%s)",
+            "buckets %s, batch buckets %s, block buckets %s, "
+            "slots=%d, max_t=%d, paged=%s, prefix_cache=%s, "
+            "spec_k=%d, cache=%.1f MB, donate=%s)",
             self.model.model.manifest.get("workflow", "?"),
             self.warmup_compiles, self.warmup_seconds,
             self.model.prompt_ladder(), self.model.batch_ladder(),
-            self.model.max_slots, self.model.max_t,
+            self.model.block_ladder(), self.model.max_slots,
+            self.model.max_t, self.model.paged,
+            self.prefix_cache_enabled, self.spec_k,
             self.model.cache.nbytes() / 1e6, self.model.donating)
         return self
 
@@ -863,10 +1713,29 @@ class DecodeEngine(Logger):
                 raise QueueFull(
                     f"decode queue full ({len(self._pending)} prompts "
                     f"pending, limit {self.max_queue})")
+            if self._token_budget is not None:
+                # token-denominated admission: the queue is bounded by
+                # the WORK it holds (prompt + budget tokens), not the
+                # request count — the bound that matches a pool whose
+                # capacity is tokens
+                want = req.n + req.max_new
+                if not self._token_budget.try_acquire(want):
+                    self._m_rejected.inc()
+                    raise QueueFull(
+                        f"decode token budget full "
+                        f"({self._token_budget.used} of "
+                        f"{self._token_budget.capacity} tokens held; "
+                        f"request wants {want})")
+                req.charged = want
             self._pending.append(req)
             self._cond.notify_all()
         self._m_submitted.inc()
         return req.future
+
+    def _refund(self, req: _PromptReq) -> None:
+        if req.charged and self._token_budget is not None:
+            self._token_budget.release(req.charged)
+            req.charged = 0
 
     def generate(self, prompt, timeout: float | None = None,
                  **kwargs) -> np.ndarray:
@@ -970,11 +1839,7 @@ class DecodeEngine(Logger):
             return  # still draining old-model generations
         evicted = 0
         for s in self._live:  # drain bound hit: return tokens-so-far
-            self.model.cache.release(s.slot)
-            self._m_served.inc()
-            if not s.req.future.done():
-                s.req.future.set_result(
-                    np.asarray(s.generated, np.int32))
+            self._finish(s)
             evicted += 1
         self._live = []
         self._m_slots.set(0)
@@ -988,7 +1853,23 @@ class DecodeEngine(Logger):
                 "drained": req.get("live0", 0) - evicted,
                 "evicted": evicted,
                 "drain_ms": round(1e3 * (now - req["t0"]), 3)})
+        if self.prefix is not None:
+            # cached K/V are functions of the OLD weights: every
+            # shared prefix page is stale the instant the flip lands
+            dropped = self.prefix.clear(self.model.cache)
+            if dropped:
+                self.info("prefix cache invalidated by weight swap "
+                          "(%d cached blocks dropped)", dropped)
         with self._cond:
+            # admission-eligible TTFT (round 15): the drain pause is
+            # a swap-policy cost, not serving latency — queued
+            # requests' TTFT/deadline clocks shift past it, and the
+            # pause itself lands on its own canonical counter
+            pause_end = time.monotonic()
+            self._m_swap_pause.inc(max(0.0, pause_end - req["t0"]))
+            for r in self._pending:
+                r.pause_s += max(0.0, pause_end
+                                 - max(r.t_submit, req["t0"]))
             self._swap_req = None
             self._cond.notify_all()
 
@@ -1043,7 +1924,12 @@ class DecodeEngine(Logger):
     def _sweep_expired(self, now: float) -> None:
         """TTFT deadline: fail-fast queued prompts whose deadline
         passed — they never reach prefill or occupy a slot.  Call
-        under ``_cond``."""
+        under ``_cond``.  Deadlines stamp from admission-ELIGIBLE
+        time: while a swap drain pauses admission the clock is
+        stopped (the pause lands on each queued request's ``pause_s``
+        when the flip completes)."""
+        if self._swap_req is not None:
+            return  # admission paused: nobody's clock is running
         if not any(r.deadline is not None for r in self._pending):
             return
         keep: deque[_PromptReq] = deque()
@@ -1052,9 +1938,11 @@ class DecodeEngine(Logger):
                 self.expired_total += 1
                 _metrics.serving_requests(self._obs_id,
                                           "expired").inc()
+                self._refund(req)
                 req.future.set_exception(DeadlineExceeded(
                     f"TTFT deadline passed after "
-                    f"{(now - req.t_submit) * 1e3:.0f}ms in queue"))
+                    f"{(now - req.t_submit - req.pause_s) * 1e3:.0f}ms "
+                    f"admission-eligible in queue"))
             else:
                 keep.append(req)
         self._pending = keep
@@ -1092,30 +1980,100 @@ class DecodeEngine(Logger):
                 _metrics.recoveries("serving_retry").inc()
             return out
 
-    def _finish(self, live: _Live) -> None:
+    def _release_lane(self, live: _Live) -> None:
+        if self.model.paged:
+            self.model.cache.release_slot_pages(live.slot)
         self.model.cache.release(live.slot)
+        self._refund(live.req)
+
+    def _finish(self, live: _Live) -> None:
+        self._release_lane(live)
         self._m_served.inc()
         if not live.req.future.done():
             live.req.future.set_result(
                 np.asarray(live.generated, np.int32))
 
-    def _admit(self, req: _PromptReq) -> None:
-        """Prefill one prompt into a free slot; samples (and times)
-        the first token."""
-        slot = self.model.cache.acquire()
+    def _fail_lane(self, live: _Live, exc: Exception) -> None:
+        self._release_lane(live)
+        if not live.req.future.done():
+            live.req.future.set_exception(exc)
+
+    # ------------------------------------------------------------------
+    # paged admission: prefix match → share/COW/alloc → tail prefill
+    # ------------------------------------------------------------------
+    def _setup_pages(self, slot: int, tokens: np.ndarray,
+                     max_new: int) -> int:
+        """Map the request's blocks into ``slot``'s table: shared full
+        blocks by reference, a partially-matched boundary block via
+        copy-on-write, fresh pages for the rest — RESERVING the whole
+        worst-case span (prompt + token budget, capped at max_t) up
+        front, so an admitted request can never be page-starved
+        mid-generation and pool pressure degrades as deterministic
+        admission shedding, never as a truncated neighbor.  Returns
+        the matched token count (the tail prefill starts there).
+        Raises :class:`PoolExhausted` with the slot's table cleaned."""
+        model = self.model
+        cache = model.cache
+        n = int(tokens.shape[0])
+        shared: list[int] = []
+        matched = 0
+        cow = None
+        if self.prefix is not None:
+            shared, matched, cow = self.prefix.match(tokens)
+        span = min(n + int(max_new), model.max_t)
+        nblocks = -(-span // model.page_tokens)
+        need_new = nblocks - len(shared)
+        if cache.free_pages < need_new and self.prefix is not None:
+            evicted = self.prefix.evict(cache, need_new)
+            if evicted:
+                _metrics.prefix_cache_events(
+                    self._obs_id, "evicted").inc(evicted)
+        for b, pid in enumerate(shared):
+            cache.share_block(slot, b, pid)
         try:
-            with _tracing.TRACER.span("prefill", cat="serving",
-                                      tokens=req.n):
-                logits = self._dispatch(self.model.run_prefill,
-                                        req.tokens, slot)
-        except Exception as exc:  # noqa: BLE001 — isolate the prompt
-            self.model.cache.release(slot)
-            self.warning("prefill failed: %s", exc)
-            if not req.future.done():
-                req.future.set_exception(exc)
-            return
+            base = len(shared)
+            if cow is not None:
+                pid = cache.new_block(slot, base)
+                # the divergence copy: shared positions of the
+                # boundary block come along, the divergent tail
+                # overwrites its own private copy
+                model.copy_page(cow[0], pid)
+                base += 1
+            for b in range(base, nblocks):
+                cache.new_block(slot, b)
+        except PoolExhausted:
+            cache.release_slot_pages(slot)
+            raise
+        if self.prefix is not None:
+            if matched > 0:
+                self._m_prefix_hit.inc()
+                self._m_tok_shared.inc(matched)
+            else:
+                self._m_prefix_miss.inc()
+            self._m_tok_computed.inc(n - matched)
+        return matched
+
+    def _admit_cleanup(self, req: _PromptReq, slot: int,
+                       exc: Exception) -> None:
+        if self.model.paged:
+            self.model.cache.release_slot_pages(slot)
+        self.model.cache.release(slot)
+        self._refund(req)
+        self.warning("prefill failed: %s", exc)
+        if not req.future.done():
+            req.future.set_exception(exc)
+
+    def _post_prefill(self, req: _PromptReq, slot: int,
+                      logits: np.ndarray) -> None:
+        """Shared admission bookkeeping once a prompt's first logits
+        exist: trie registration, TTFT (admission-eligible clock),
+        first sample, live-lane creation."""
+        if self.prefix is not None:
+            self.prefix.insert(req.tokens,
+                               self.model.cache.tables[slot],
+                               self.model.cache)
         token = self._sample(logits)
-        ttft = time.monotonic() - req.t_submit
+        ttft = time.monotonic() - req.t_submit - req.pause_s
         self._m_ttft.observe(ttft)
         self._ttft_win.append(ttft)
         self._m_tok_prompt.inc(req.n)
@@ -1128,9 +2086,137 @@ class DecodeEngine(Logger):
         self._live.append(live)
         self._m_slots.set(len(self._live))
 
+    def _admit_prefilled(self, req: _PromptReq, slot: int,
+                         matched: int) -> None:
+        """Single-prompt prefill dispatch for a slot whose pages are
+        already set up (``matched`` tokens ride shared pages)."""
+        try:
+            with _tracing.TRACER.span("prefill", cat="serving",
+                                      tokens=req.n, shared=matched):
+                logits = self._dispatch(self.model.run_prefill,
+                                        req.tokens[matched:], slot,
+                                        matched)
+                if self.drafter is not None:
+                    # the drafter tracks the FULL prompt through its
+                    # own flat cache (it is tiny — sharing buys
+                    # nothing there)
+                    self._dispatch(self.drafter.run_prefill,
+                                   req.tokens, slot)
+        except Exception as exc:  # noqa: BLE001 — isolate the prompt
+            self._admit_cleanup(req, slot, exc)
+            return
+        self._post_prefill(req, slot, logits)
+
+    def _admit_window(self, group: list[tuple]) -> None:
+        """Admission coalescing (round 15): a burst of prompts whose
+        unshared tails fit one ``prompt_align`` window — the
+        steady-state shape of prefix-hit system-prompt traffic — pays
+        ONE batched window dispatch instead of one prefill each."""
+        w_len = self.model.prompt_align
+        n = len(group)
+        windows = np.zeros((n, w_len), np.int32)
+        slots = np.empty((n,), np.int32)
+        starts = np.empty((n,), np.int32)
+        lengths = np.empty((n,), np.int32)
+        for i, (req, slot, matched) in enumerate(group):
+            tail = req.tokens[matched:]
+            windows[i, :len(tail)] = tail
+            slots[i] = slot
+            starts[i] = matched
+            lengths[i] = len(tail)
+        try:
+            with _tracing.TRACER.span("prefill_window", cat="serving",
+                                      lanes=n, w=w_len):
+                logits = self._dispatch(
+                    self.model.run_window, windows, slots, starts,
+                    lengths, "serving-prefill")
+                if self.drafter is not None:
+                    for req, slot, _m in group:
+                        self._dispatch(self.drafter.run_prefill,
+                                       req.tokens, slot)
+        except Exception as exc:  # noqa: BLE001 — isolate the wave
+            for req, slot, _m in group:
+                self._admit_cleanup(req, slot, exc)
+            return
+        for i, (req, slot, _m) in enumerate(group):
+            self._post_prefill(req, slot,
+                               logits[i, int(lengths[i]) - 1])
+
+    def _admit_many(self, reqs: list[_PromptReq]) -> list[_PromptReq]:
+        """Admit a wave of prompts; returns the suffix to requeue
+        when the page pool cannot hold one (order preserved — nothing
+        is dropped or reordered past the blocked head).
+
+        Prompts are matched against the trie IN ORDER, and a prefix
+        MISS dispatches (and registers its blocks) immediately — so
+        the second system-prompt request of a burst already shares
+        the first one's pages, within one admission wave.  The
+        prefix-hit tails then coalesce into one batched window
+        dispatch."""
+        model = self.model
+        window: list[tuple] = []
+        requeue: list[_PromptReq] = []
+        for i, req in enumerate(reqs):
+            slot = model.cache.acquire()
+            matched = 0
+            if model.paged:
+                try:
+                    matched = self._setup_pages(slot, req.tokens,
+                                                req.max_new)
+                except PoolExhausted:
+                    model.cache.release(slot)
+                    requeue = list(reqs[i:])
+                    break
+                if self._pool_tripped:
+                    # capacity is back: resume taking traffic NOW
+                    with self._cond:
+                        self._pool_tripped = False
+                        if self._state == _OPEN:
+                            self._transition(_CLOSED)
+            # the batched window path needs the paged window program
+            # family (compiled when the prefix cache is on) and a
+            # tail that fits the prompt_align window
+            if (self.prefix is not None
+                    and not model.has_lstm
+                    and 0 < req.n - matched <= model.prompt_align):
+                window.append((req, slot, matched))
+            else:
+                self._admit_prefilled(req, slot, matched)
+        if len(window) == 1:
+            self._admit_prefilled(*window[0])
+        elif window:
+            self._admit_window(window)
+        return requeue
+
+    def _emit_tokens(self, s: _Live, tokens: list[int],
+                     now: float) -> bool:
+        """Append emitted tokens to a lane (speculative steps emit
+        several per dispatch); returns True when the lane finished
+        (EOS / budget / max-T)."""
+        dt = (now - s.t_last) / max(1, len(tokens))
+        done = False
+        for tok in tokens:
+            s.pos += 1
+            s.generated.append(int(tok))
+            self._m_token.observe(dt)
+            self._token_win.append(dt)
+            self._m_tok_gen.inc()
+            if ((self.eos_token is not None
+                 and int(tok) == self.eos_token)
+                    or len(s.generated) >= s.req.max_new
+                    or s.pos >= self.model.max_t):
+                done = True
+                break
+        s.t_last = now
+        return done
+
     def _step(self) -> None:
-        """One continuous-batching token step over every live lane."""
+        """One continuous-batching token step over every live lane.
+        No page bookkeeping here: admission reserved every block a
+        real token can land in, so the hot loop is pure dispatch."""
         live = self._live
+        if not live:
+            return
         tokens = np.asarray([s.generated[-1] for s in live], np.int32)
         slots = np.asarray([s.slot for s in live], np.int32)
         positions = np.asarray([s.pos for s in live], np.int32)
@@ -1143,9 +2229,7 @@ class DecodeEngine(Logger):
             self.warning("decode step failed for %d lanes: %s",
                          len(live), exc)
             for s in live:
-                self.model.cache.release(s.slot)
-                if not s.req.future.done():
-                    s.req.future.set_exception(exc)
+                self._fail_lane(s, exc)
             self._live = []
             self._m_slots.set(0)
             return
@@ -1153,19 +2237,118 @@ class DecodeEngine(Logger):
         still: list[_Live] = []
         for i, s in enumerate(live):
             token = self._sample(logits[i])
-            s.pos += 1
-            s.generated.append(token)
-            self._m_token.observe(now - s.t_last)
-            self._token_win.append(now - s.t_last)
-            s.t_last = now
-            self._m_tok_gen.inc()
-            done = ((self.eos_token is not None
-                     and token == self.eos_token)
-                    or len(s.generated) >= s.req.max_new
-                    # page boundary: the next input position would
-                    # fall off the bucketed max-T cache
-                    or s.pos >= self.model.max_t)
-            if done:
+            if self._emit_tokens(s, [token], now):
+                self._finish(s)
+            else:
+                still.append(s)
+        self._live = still
+        self._m_slots.set(len(still))
+
+    # ------------------------------------------------------------------
+    # speculative decoding (round 15): draft k with the population
+    # drafter, verify the window in ONE batched big-model forward
+    # ------------------------------------------------------------------
+    def _softmax(self, logits: np.ndarray) -> np.ndarray:
+        z = logits / max(self.temperature, 1e-9)
+        z = z - z.max(axis=-1, keepdims=True)
+        p = np.exp(z)
+        return p / p.sum(axis=-1, keepdims=True)
+
+    def _accept_lane(self, vlogits: np.ndarray, drafts: np.ndarray,
+                     qrow: np.ndarray | None) -> tuple[list[int], int]:
+        """Leviathan accept/reject for one lane.  ``vlogits``
+        (k+1, V) verifier logits, ``drafts`` (k,) drafted ids,
+        ``qrow`` (k, V) drafter probabilities (sampled mode only).
+        Returns ``(emitted_tokens, accepted_draft_count)``.  Greedy:
+        accept while the verifier's argmax equals the draft, emit the
+        verifier's token at the first mismatch — byte-identical to
+        non-speculative greedy by construction.  No bonus token is
+        emitted on a full accept: the drafter never consumed the last
+        draft, so the next round feeds it instead (state stays exact
+        with zero catch-up dispatches)."""
+        emitted: list[int] = []
+        accepted = 0
+        for i in range(self.spec_k):
+            d = int(drafts[i])
+            if qrow is None:  # greedy
+                g = int(np.argmax(vlogits[i]))
+                emitted.append(g)
+                if g != d:
+                    break
+                accepted += 1
+            else:  # temperature: exact rejection sampling
+                p = self._softmax(vlogits[i])
+                q = qrow[i]
+                if self._rng.random() < min(
+                        1.0, float(p[d]) / max(float(q[d]), 1e-12)):
+                    emitted.append(d)
+                    accepted += 1
+                    continue
+                resid = np.maximum(p - q, 0.0)
+                total = resid.sum()
+                probs = resid / total if total > 0 else p
+                emitted.append(int(self._rng.choice(len(p), p=probs)))
+                break
+        return emitted, accepted
+
+    def _step_spec(self) -> None:
+        """One speculative step: k drafter tokens per lane, one
+        batched verification forward, 1..k tokens emitted per lane."""
+        k = self.spec_k
+        # no page bookkeeping: admission reserved every block a REAL
+        # token can land in; the verify window's overhang past the
+        # reservation holds only discardable draft overflow, and the
+        # table routes those writes to the trash page by construction
+        live = self._live
+        if not live:
+            return
+        n = len(live)
+        slots = np.asarray([s.slot for s in live], np.int32)
+        base_pos = np.asarray([s.pos for s in live], np.int32)
+        cur = np.asarray([s.generated[-1] for s in live], np.int32)
+        drafts = np.empty((n, k), np.int32)
+        qprobs = (np.empty((n, k, self.model.vocab), np.float64)
+                  if self.temperature > 0 else None)
+        try:
+            with _tracing.TRACER.span("spec_draft", cat="serving",
+                                      lanes=n, k=k):
+                for j in range(k):
+                    dlogits = self._dispatch(self.drafter.run_decode,
+                                             cur, slots, base_pos + j)
+                    if qprobs is None:
+                        nxt = np.argmax(dlogits, axis=1)
+                    else:
+                        q = self._softmax(dlogits)
+                        qprobs[:, j] = q
+                        nxt = np.asarray(
+                            [self._rng.choice(q.shape[1], p=q[i])
+                             for i in range(n)])
+                    drafts[:, j] = nxt
+                    cur = nxt.astype(np.int32)
+            windows = np.concatenate(
+                [np.asarray([[s.generated[-1]] for s in live],
+                            np.int32), drafts], axis=1)
+            with _tracing.TRACER.span("spec_verify", cat="serving",
+                                      lanes=n, k=k):
+                vlogits = self._dispatch(self.model.run_verify,
+                                         windows, slots, base_pos)
+        except Exception as exc:  # noqa: BLE001 — the step is shared
+            self.warning("speculative step failed for %d lanes: %s",
+                         n, exc)
+            for s in live:
+                self._fail_lane(s, exc)
+            self._live = []
+            self._m_slots.set(0)
+            return
+        now = time.monotonic()
+        still: list[_Live] = []
+        for i, s in enumerate(live):
+            emitted, accepted = self._accept_lane(
+                vlogits[i], drafts[i],
+                None if qprobs is None else qprobs[i])
+            self._m_spec_acc.inc(accepted)
+            self._m_spec_rej.inc(k - accepted)
+            if self._emit_tokens(s, emitted, now):
                 self._finish(s)
             else:
                 still.append(s)
@@ -1202,10 +2385,54 @@ class DecodeEngine(Logger):
                 while (may_admit and self._pending
                        and len(admit) < free):
                     admit.append(self._pending.popleft())
-            for req in admit:
-                self._admit(req)
+            # admissions coalesce: prefix-hit tails share one batched
+            # window dispatch; pool exhaustion returns the blocked
+            # suffix in order — nothing is dropped silently
+            requeue = self._admit_many(admit)
+            if requeue:
+                with self._cond:
+                    self._pending.extendleft(reversed(requeue))
+                    if self._live or self._swap_req is not None:
+                        # token-capacity overload: a young backlog
+                        # just waits for draining lanes to release
+                        # pages; a STALLED one (head older than
+                        # max_queue_age) sheds new prompts through
+                        # the breaker until capacity returns
+                        head_age = (time.monotonic()
+                                    - self._pending[0].t_submit
+                                    - self._pending[0].pause_s)
+                        if self._state == _CLOSED \
+                                and head_age > self.max_queue_age:
+                            self.warning(
+                                "page pool exhausted (%d/%d pages "
+                                "free, head queued %.1fs): shedding "
+                                "new prompts while %d lanes drain",
+                                self.model.cache.free_pages,
+                                self.model.cache.pool_pages, head_age,
+                                len(self._live))
+                            self._transition(_OPEN)
+                            self._pool_tripped = True
+                        head = None
+                    else:
+                        # no lane will ever free a page — the prompt
+                        # cannot fit this pool, period
+                        head = self._pending.popleft()
+                if head is not None:
+                    self._refund(head)
+                    self._m_rejected.inc()
+                    if not head.future.done():
+                        head.future.set_exception(PoolExhausted(
+                            f"prompt of {head.n} tokens cannot fit "
+                            f"the {self.model.cache.pool_pages}-page "
+                            f"pool even with every lane drained and "
+                            f"the prefix cache evicted"))
             if self._live:
-                self._step()
+                if self.spec_k and all(
+                        s.pos + self.spec_k < self.model.max_t
+                        for s in self._live):
+                    self._step_spec()
+                else:
+                    self._step()
             self._maybe_apply_swap()
 
     # ------------------------------------------------------------------
@@ -1224,15 +2451,46 @@ class DecodeEngine(Logger):
                     "mean": round(1e3 * sum(vals) / len(vals), 3),
                     "window": len(vals)}
 
+        spec_acc = int(self._m_spec_acc.value)
+        spec_rej = int(self._m_spec_rej.value)
         out = {
-            "engine": "decode-bucketed-aot",
+            "engine": ("decode-paged-aot" if self.model.paged
+                       else "decode-bucketed-aot"),
             "admission": self.admission,
             "max_slots": self.model.max_slots,
             "max_t": self.model.max_t,
+            "paged": self.model.paged,
+            "page_tokens": (self.model.page_tokens
+                            if self.model.paged else None),
+            "pages": ({
+                "total": self.model.cache.pool_pages,
+                "used": self.model.cache.pages_used(),
+                "pool_tokens": self.model.pool_tokens,
+                "page_truncations": self.page_truncations,
+            } if self.model.paged else None),
+            "prefix_cache": ({
+                "nodes": self.prefix.nodes,
+                "hits": int(self._m_prefix_hit.value),
+                "misses": int(self._m_prefix_miss.value),
+                "shared_tokens": int(self._m_tok_shared.value),
+                "computed_tokens": int(self._m_tok_computed.value),
+            } if self.prefix is not None else None),
+            "speculative": ({
+                "draft_k": self.spec_k,
+                "drafter": self.drafter.model.manifest.get(
+                    "workflow", "?"),
+                "accepted": spec_acc,
+                "rejected": spec_rej,
+                "accept_rate": round(
+                    spec_acc / max(1, spec_acc + spec_rej), 3),
+            } if self.spec_k else None),
             "prompt_buckets": self.model.prompt_ladder(),
             "batch_buckets": self.model.batch_ladder(),
-            "programs_compiled": self.model.compile_count,
-            "programs_live": self.model.programs_live,
+            "block_buckets": self.model.block_ladder(),
+            "programs_compiled": self.model.compile_count
+            + (self.drafter.compile_count if self.drafter else 0),
+            "programs_live": self.model.programs_live
+            + (self.drafter.programs_live if self.drafter else 0),
             "warmup_seconds": round(self.warmup_seconds, 3),
             "cache_bytes": self.model.cache.nbytes(),
             "submitted": int(self._m_submitted.value),
