@@ -311,6 +311,68 @@ def test_oversized_request_fails_cleanly(lm_bundle):
         assert eng.model.cache.free_pages == 2
 
 
+def _assert_page_accounting(eng):
+    """Every page's refcount equals its holders (table references +
+    trie pins), and nothing on the free list is referenced anywhere —
+    the invariant the match→evict→share ordering race broke."""
+    cache = eng.model.cache
+    free = cache._free_pages
+    assert len(set(free)) == len(free), "double-freed page"
+    refs = np.zeros(cache.pool_pages, np.int64)
+    for slot in range(cache.max_slots):
+        for pid in cache.tables[slot]:
+            if int(pid) != cache.trash_page:
+                refs[int(pid)] += 1
+    stack = list(eng.prefix.root.children.values())
+    while stack:
+        node = stack.pop()
+        refs[node.page] += 1
+        stack.extend(node.children.values())
+    assert np.array_equal(refs, cache.ref), (refs, cache.ref)
+    assert all(int(cache.ref[p]) == 0 for p in free)
+
+
+def test_matched_pages_survive_own_eviction_pressure(lm_bundle):
+    """Regression for the admission ordering race: when pool pressure
+    makes the request's OWN just-matched trie leaves the eviction
+    victims, the matched pages are pinned first — so eviction can
+    unpin but never free them, the oversized request sheds with
+    PoolExhausted, and no page ends up simultaneously free-listed and
+    table-mapped (which previously let alloc_page hand a still-shared
+    page to another block)."""
+    man, P = _params(lm_bundle)
+    rng = np.random.default_rng(11)
+    base = rng.integers(0, VOCAB, size=16).astype(np.int32)
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=40, max_prompt=16,
+                      prompt_align=8, max_new_tokens=4, page_tokens=8,
+                      pool_tokens=32) as eng:  # 4 pages
+        # A seeds the trie: both full prompt blocks stay pinned
+        assert list(eng.generate(base, timeout=240)) \
+            == oracle_greedy(man, P, base, 4)
+        assert eng.model.cache.free_pages == 2
+        assert eng.prefix.nodes == 2
+        _assert_page_accounting(eng)
+        # B shares block 0, COWs off block 1, and asks for a 5-block
+        # worst-case span against a 4-page pool: the only evictable
+        # leaves are exactly B's matched pages
+        div = base.copy()
+        div[12:] = (div[12:] + 1) % VOCAB
+        fut = eng.submit(div, max_new_tokens=24)
+        with pytest.raises(PoolExhausted):
+            fut.result(timeout=240)
+        ev = obs_metrics.REGISTRY.get("znicz_prefix_cache_total")
+        events = {k[1]: int(c.value) for k, c in ev.items()
+                  if k[0] == eng._obs_id}
+        assert events.get("evicted", 0) > 0, \
+            "pressure never reached the eviction path"
+        _assert_page_accounting(eng)
+        # the pool recovered whole: a fitting prefix-sharing request
+        # still serves oracle-exact
+        assert list(eng.generate(div, timeout=240)) \
+            == oracle_greedy(man, P, div, 4)
+        _assert_page_accounting(eng)
+
+
 # ----------------------------------------------------------------------
 # speculative decoding
 # ----------------------------------------------------------------------
@@ -399,6 +461,17 @@ def test_attach_decode_meta_round_trip(lm_bundle, drafter_bundle,
         assert eng.spec_k == 2 and eng.drafter is not None
         out = eng.generate(np.array([2, 5]), timeout=300)
         assert len(out) == 6
+    # a published bundle's digest sidecar is refreshed by the stamp —
+    # the PublicationWatcher verifies it on load, so a stale hash
+    # would brick the bundle
+    from znicz_tpu.utils.snapshotter import _sha256_file
+    pub = str(tmp_path / "pub_lm.npz")
+    shutil.copyfile(lm_bundle, pub)
+    with open(f"{pub}.sha256", "w") as f:
+        f.write(_sha256_file(pub) + "\n")
+    attach_decode_meta(pub, page_tokens=8)
+    with open(f"{pub}.sha256") as f:
+        assert f.read().strip() == _sha256_file(pub)
     # scorer bundles refuse decode metadata
     from benchmarks.serve_bench import train_and_export
     fc = str(tmp_path / "fc.npz")

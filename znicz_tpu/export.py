@@ -195,6 +195,16 @@ def attach_decode_meta(path: str, *, page_tokens: int | None = None,
     with open(tmp, "wb") as f:
         f.write(buf.getvalue())
     os.replace(tmp, path)
+    sidecar = f"{path}.sha256"
+    if os.path.exists(sidecar):
+        # published bundles carry a digest sidecar the
+        # PublicationWatcher verifies on load — a stale hash after
+        # the rewrite would brick the bundle at serve time
+        from znicz_tpu.utils.snapshotter import _sha256_file
+        side_tmp = f"{sidecar}.{os.getpid()}.tmp"
+        with open(side_tmp, "w") as f:
+            f.write(_sha256_file(path) + "\n")
+        os.replace(side_tmp, sidecar)
     return meta
 
 

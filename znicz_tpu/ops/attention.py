@@ -488,8 +488,11 @@ class MultiHeadAttention(Forward):
         False write into the trash page (last table entry)."""
         ptok = pool.shape[1]
         nb = tables.shape[1] - 1
-        block = jnp.minimum(positions // ptok, nb - 1)
-        block = jnp.where(live, block, nb)  # trash entry
+        block = positions // ptok
+        # trash entry for dead lanes/positions AND for live positions
+        # past the table — an overflow (host bookkeeping slip) must
+        # discard the write, not overwrite the last allocated page
+        block = jnp.where(live & (block < nb), block, nb)
         page = jnp.take_along_axis(tables, block, axis=1)
         off = jnp.where(live, positions % ptok, 0)
         return pool.at[page, off].set(rows)

@@ -292,7 +292,13 @@ class PagedKVCache:
 
     def share_block(self, slot: int, block: int, pid: int) -> None:
         """Map ``block`` of ``slot`` to an EXISTING page by reference
-        (prefix sharing)."""
+        (prefix sharing).  The page must be live (ref > 0): a
+        zero-ref page sits on the free list, and re-refing it here
+        without unlinking it would let ``alloc_page`` hand the same
+        page to another sequence — callers must pin matched pages
+        before anything (eviction) can drop their last holder."""
+        assert int(self.ref[pid]) > 0, \
+            f"share_block: page {pid} is on the free list"
         self.tables[slot, block] = pid
         self.ref[pid] += 1
 
@@ -2023,14 +2029,24 @@ class DecodeEngine(Logger):
         span = min(n + int(max_new), model.max_t)
         nblocks = -(-span // model.page_tokens)
         need_new = nblocks - len(shared)
-        if cache.free_pages < need_new and self.prefix is not None:
-            evicted = self.prefix.evict(cache, need_new)
-            if evicted:
-                _metrics.prefix_cache_events(
-                    self._obs_id, "evicted").inc(evicted)
+        # Pin the matched pages BEFORE any eviction: mapping the
+        # shared blocks into the slot's table (and holding a
+        # temporary ref on the COW donor) keeps them off the free
+        # list even when evict() below unpins their trie leaves
+        # under pool pressure — otherwise a just-matched page could
+        # free and be re-allocated to another sequence while this
+        # request still maps it.
         for b, pid in enumerate(shared):
             cache.share_block(slot, b, pid)
+        if cow is not None:
+            cache.ref[cow[0]] += 1  # donor pin until the copy lands
         try:
+            if cache.free_pages < need_new \
+                    and self.prefix is not None:
+                evicted = self.prefix.evict(cache, need_new)
+                if evicted:
+                    _metrics.prefix_cache_events(
+                        self._obs_id, "evicted").inc(evicted)
             base = len(shared)
             if cow is not None:
                 pid = cache.new_block(slot, base)
@@ -2044,6 +2060,9 @@ class DecodeEngine(Logger):
         except PoolExhausted:
             cache.release_slot_pages(slot)
             raise
+        finally:
+            if cow is not None:
+                cache.ref_dec(cow[0])
         if self.prefix is not None:
             if matched > 0:
                 self._m_prefix_hit.inc()
