@@ -1,0 +1,609 @@
+"""Round-16 multi-tenant fleet: priority admission, token-bucket
+shedding, per-tenant breakers (half-open probing under MIXED one-shot
++ decode traffic), weighted A/B routing, replica autoscaling/repair,
+the shared ladder budget, and exactly-once TokenBudget accounting
+across retry/eviction.  CPU / tier-1 safe."""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from conftest import make_blobs
+from znicz_tpu.backends import XLADevice
+from znicz_tpu.export import ExportedModel
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.observe import metrics as obs_metrics
+from znicz_tpu.serving import (ContinuousBatcher, DeadlineExceeded,
+                               DecodeEngine, FleetEngine, Overloaded,
+                               PriorityQueue, QueueFull,
+                               SharedLadderBudget, TenantClass,
+                               TokenBucketLimiter, TokenBudget)
+from znicz_tpu.utils import prng
+from znicz_tpu.utils.config import root
+
+DIM, N_CLASSES, VOCAB = 12, 4, 10
+
+
+# ----------------------------------------------------------------------
+# shared trained bundles (module scope: train once)
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def oneshot_bundle(tmp_path_factory):
+    data, labels = make_blobs(48, N_CLASSES, DIM)
+    prng.seed_all(5)
+    wf = StandardWorkflow(
+        name="fleet_scorer",
+        loader_factory=lambda w: __import__(
+            "znicz_tpu.loader.fullbatch", fromlist=["ArrayLoader"]
+        ).ArrayLoader(
+            w, train_data=data[:160], train_labels=labels[:160],
+            valid_data=data[160:], valid_labels=labels[160:],
+            minibatch_size=32),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 24},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "softmax",
+             "->": {"output_sample_shape": N_CLASSES},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        ],
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    path = str(tmp_path_factory.mktemp("fleet") / "scorer.npz")
+    wf.export_forward(path)
+    return path, data
+
+
+@pytest.fixture(scope="module")
+def lm_bundle(tmp_path_factory):
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    rng = np.random.default_rng(31)
+    seq_len = 8
+    start = rng.integers(0, VOCAB, size=192)
+    data = ((start[:, None] + np.arange(seq_len)[None, :])
+            % VOCAB).astype(np.float32)
+    labels = ((start + seq_len) % VOCAB).astype(np.int32)
+    prng.seed_all(31)
+    wf = StandardWorkflow(
+        name="fleet_lm",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:128], train_labels=labels[:128],
+            valid_data=data[128:], valid_labels=labels[128:],
+            minibatch_size=32),
+        layers=[
+            {"type": "embedding",
+             "->": {"vocab_size": VOCAB, "dim": 8},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+            {"type": "pos_encoding", "->": {}},
+            {"type": "attention", "->": {"n_heads": 1, "causal": True},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "last_token", "->": {}},
+            {"type": "softmax", "->": {"output_sample_shape": VOCAB},
+             "<-": {"learning_rate": 0.1, "gradient_moment": 0.9}},
+        ],
+        decision_config={"max_epochs": 1})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    path = str(tmp_path_factory.mktemp("fleet") / "lm.npz")
+    wf.export_forward(path)
+    return path
+
+
+def make_fleet(oneshot_bundle, lm_bundle=None, **kwargs):
+    path, _data = oneshot_bundle
+    tenants = kwargs.pop("tenants", [
+        TenantClass("hi", priority=0),
+        TenantClass("lo", priority=2, rate=50, burst=8,
+                    max_queue_rows=32),
+    ])
+    fleet = FleetEngine(tenants=tenants, autoscale=False, **kwargs)
+    fleet.add_model("scorer", path, max_batch=8, max_delay_ms=1.0)
+    if lm_bundle is not None:
+        fleet.add_model("lm", lm_bundle, kind="lm", max_slots=4,
+                        max_t=32, max_prompt=8, prompt_align=4,
+                        max_new_tokens=4, paged=False)
+    return fleet
+
+
+# ----------------------------------------------------------------------
+# building blocks
+# ----------------------------------------------------------------------
+def test_priority_queue_ordering_and_eviction():
+    class R:
+        def __init__(self, name, prio, n=1):
+            self.name, self.priority, self.n = name, prio, n
+            self.t_submit = time.monotonic()
+
+    q = PriorityQueue()
+    for name, prio in (("lo1", 2), ("hi1", 0), ("lo2", 2), ("mid", 1),
+                       ("hi2", 0)):
+        q.append(R(name, prio))
+    assert len(q) == 5
+    # strict priority, FIFO within class
+    assert [r.name for r in q] == ["hi1", "hi2", "mid", "lo1", "lo2"]
+    assert q.peek().name == "hi1"
+    assert q.popleft().name == "hi1"
+    # requeue_front puts a retried request back at ITS class head
+    retried = R("hi0", 0)
+    q.requeue_front([retried])
+    assert q.popleft().name == "hi0"
+    # preemption frees from the LOWEST class, newest first
+    assert q.rows_below(0) == 3
+    evicted = q.evict_below(0, 2)
+    assert [r.name for r in evicted] == ["lo2", "lo1"]
+    assert [r.name for r in q] == ["hi2", "mid"]
+    # sweep removes matching requests wholesale
+    removed = q.sweep(lambda r: r.name == "mid")
+    assert [r.name for r in removed] == ["mid"]
+    assert [r.name for r in q] == ["hi2"]
+
+
+def test_token_bucket_limiter_refills():
+    bucket = TokenBucketLimiter(rate=100.0, burst=5.0)
+    assert all(bucket.try_acquire() for _ in range(5))
+    assert not bucket.try_acquire()  # burst spent
+    time.sleep(0.05)                 # ~5 tokens refill at 100/s
+    assert bucket.try_acquire(2)
+    unlimited = TokenBucketLimiter(rate=None)
+    assert all(unlimited.try_acquire(100) for _ in range(50))
+    with pytest.raises(ValueError):
+        TokenBucketLimiter(rate=-1)
+
+
+def test_token_budget_over_release_detected():
+    budget = TokenBudget(10)
+    assert budget.try_acquire(6)
+    budget.release(6)
+    assert budget.balanced()
+    budget.release(3)  # double release: detected, not silently eaten
+    assert budget.over_released == 3
+    assert not budget.balanced()
+
+
+# ----------------------------------------------------------------------
+# exactly-once TokenBudget accounting across retry/eviction
+# ----------------------------------------------------------------------
+def test_decode_budget_exact_once_across_eviction_and_retry(lm_bundle):
+    """Every reservation path — served, TTFT-expired in queue, failed
+    dispatch after retries — returns its tokens exactly once: the
+    budget drains to zero with zero over-releases."""
+    eng = DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=8,
+                       prompt_align=4, max_new_tokens=4,
+                       paged=True, page_tokens=8, pool_tokens=64,
+                       max_queue_tokens=64, retry_budget=1)
+    eng.start()
+    budget = eng._token_budget
+    assert budget is not None
+    # served path
+    out = eng.generate(np.array([1, 2, 3]), timeout=60)
+    assert len(out) == 4
+    # deadline-evicted path: occupy both slots with long generations,
+    # then queue a doomed prompt behind them
+    long1 = eng.submit(np.array([1, 2]), max_new_tokens=24)
+    long2 = eng.submit(np.array([2, 3]), max_new_tokens=24)
+    doomed = eng.submit(np.array([4, 5]), deadline_ms=1)
+    with pytest.raises(DeadlineExceeded):
+        doomed.result(timeout=30)
+    long1.result(timeout=60)
+    long2.result(timeout=60)
+    # failed-dispatch path: inject one prefill error past the retry
+    # budget so the lane fails terminally
+    root.common.engine.faults = {
+        "serving.program_error": {"at": [1, 2]}}
+    with pytest.raises(Exception):
+        eng.generate(np.array([6, 7]), timeout=60)
+    root.common.engine.faults = None
+    eng.shutdown()
+    assert budget.used == 0, "token reservation leaked"
+    assert budget.over_released == 0, "token reservation double-freed"
+    assert budget.balanced()
+
+
+def test_batcher_rows_exact_once_across_retry_and_eviction():
+    """Row/tenant accounting mirrors the budget contract: a re-queued
+    retry re-enters exactly once, a deadline eviction leaves zero
+    pending rows, a preempted request releases its rows to the
+    preemptor."""
+    calls = []
+    gate = threading.Event()
+
+    def run_batch(reqs):
+        calls.append([r.n for r in reqs])
+        if len(calls) == 1:
+            raise RuntimeError("transient")
+        gate.wait(5)
+        for r in reqs:
+            r.future.set_result(r.x)
+
+    b = ContinuousBatcher(run_batch, max_batch=4, max_delay_ms=0,
+                          max_queue=8, retry_budget=1,
+                          breaker_min_samples=100)
+    gate.set()
+    f = b.submit(np.ones((2, 1)), tenant="t0", priority=1)
+    np.testing.assert_array_equal(f.result(timeout=5), np.ones((2, 1)))
+    assert b.retries_total == 1
+    assert b.queue_rows == 0 and b.tenant_rows("t0") == 0
+    b.shutdown()
+
+
+def test_batcher_preemption_sheds_lowest_priority_only():
+    """A full queue admits a higher-priority request by evicting the
+    NEWEST lower-priority rows; equal/higher-priority pending rows are
+    untouched and the preempted futures see Overloaded."""
+    release = threading.Event()
+
+    def run_batch(reqs):
+        release.wait(10)
+        for r in reqs:
+            r.future.set_result(r.x)
+
+    b = ContinuousBatcher(run_batch, max_batch=2, max_delay_ms=5_000,
+                          max_queue=4, max_queue_age_ms=None)
+    # max_batch rows dispatch immediately and park in run_batch; fill
+    # the remaining queue with lo rows
+    parked = b.submit(np.ones((2, 1)), tenant="hi", priority=0)
+    time.sleep(0.05)
+    lo = [b.submit(np.ones((2, 1)), tenant="lo", priority=2),
+          b.submit(np.ones((2, 1)), tenant="lo", priority=2)]
+    with pytest.raises(QueueFull):  # lo cannot preempt its own class
+        b.submit(np.ones((1, 1)), tenant="lo", priority=2)
+    hi = b.submit(np.ones((2, 1)), tenant="hi", priority=0)
+    with pytest.raises(Overloaded, match="preempted"):
+        lo[1].result(timeout=5)  # newest lo evicted
+    release.set()
+    np.testing.assert_array_equal(hi.result(timeout=10),
+                                  np.ones((2, 1)))
+    np.testing.assert_array_equal(lo[0].result(timeout=10),
+                                  np.ones((2, 1)))
+    parked.result(timeout=10)
+    assert b.queue_rows == 0 and b.tenant_rows("lo") == 0
+    assert b.shed_total == 1
+    b.shutdown()
+
+
+def test_batcher_dispatches_high_priority_first():
+    release = threading.Event()
+    order = []
+
+    def run_batch(reqs):
+        release.wait(10)
+        for r in reqs:
+            order.append(r.tenant)
+            r.future.set_result(r.x)
+
+    b = ContinuousBatcher(run_batch, max_batch=1, max_delay_ms=0,
+                          max_queue=8)
+    first = b.submit(np.ones((1, 1)), tenant="warm", priority=1)
+    time.sleep(0.05)  # the scheduler parks inside run_batch
+    lo = b.submit(np.ones((1, 1)), tenant="lo", priority=2)
+    hi = b.submit(np.ones((1, 1)), tenant="hi", priority=0)
+    release.set()
+    for f in (first, lo, hi):
+        f.result(timeout=10)
+    b.shutdown()
+    assert order == ["warm", "hi", "lo"]
+
+
+def test_decode_priority_admission(lm_bundle):
+    """With one KV slot busy, a queued high-priority prompt admits
+    before an earlier-queued low-priority one."""
+    eng = DecodeEngine(lm_bundle, max_slots=1, max_t=32, max_prompt=8,
+                       prompt_align=4, max_new_tokens=2, paged=False)
+    eng.start()
+    done: list[str] = []
+    busy = eng.submit(np.array([1, 2]), max_new_tokens=20)
+    time.sleep(0.05)  # let it occupy the only slot
+    lo = eng.submit(np.array([3, 4]), tenant="lo", priority=2)
+    hi = eng.submit(np.array([5, 6]), tenant="hi", priority=0)
+    lo.add_done_callback(lambda f: done.append("lo"))
+    hi.add_done_callback(lambda f: done.append("hi"))
+    busy.result(timeout=60)
+    lo.result(timeout=60)
+    hi.result(timeout=60)
+    eng.shutdown()
+    assert done == ["hi", "lo"]
+
+
+# ----------------------------------------------------------------------
+# fleet: routing, isolation, breakers
+# ----------------------------------------------------------------------
+def test_fleet_weighted_ab_routing_exact(oneshot_bundle):
+    path, data = oneshot_bundle
+    fleet = FleetEngine(autoscale=False)
+    fleet.add_model("m", path, max_batch=8, max_delay_ms=0.5)
+    fleet.add_version("m", path, version="v2", weight=1.0)
+    fleet.set_traffic("m", {"v1": 3.0, "v2": 1.0})
+    with fleet:
+        for _ in range(12):
+            fleet("m", data[:1], timeout=60)
+        st = fleet.stats()["models"]["m"]["versions"]
+    # smooth weighted round-robin: exact 9/3 over 12 requests
+    assert st["v1"]["served"] == 9 and st["v2"]["served"] == 3
+    # pinned version bypasses the split
+    with pytest.raises(KeyError):
+        fleet.set_traffic("m", {"nope": 1.0})
+
+
+def test_fleet_flood_sheds_only_the_flooding_tenant(oneshot_bundle,
+                                                    lm_bundle):
+    """The isolation contract in miniature: a lo flood is absorbed
+    entirely inside lo (rate-limit shed + per-tenant breaker) while
+    hi traffic — one-shot AND decode — sees zero failures."""
+    path, data = oneshot_bundle
+    fleet = make_fleet(oneshot_bundle, lm_bundle)
+    with fleet:
+        hi_futures = []
+        shed = 0
+        for i in range(30):
+            try:
+                fleet.submit("scorer", data[:1], tenant="lo")
+            except (Overloaded, QueueFull):
+                shed += 1
+            hi_futures.append(fleet.submit("scorer", data[i:i + 2],
+                                           tenant="hi"))
+            if i % 5 == 0:
+                hi_futures.append(fleet.submit(
+                    "lm", np.array([i % VOCAB, 1]), tenant="hi"))
+        for f in hi_futures:
+            f.result(timeout=120)  # raises on ANY hi failure
+        assert shed > 0
+        st = fleet.stats()["tenants"]
+        assert st["hi"]["shed"] == 0 and st["hi"]["failed"] == 0
+        assert st["hi"]["served"] == len(hi_futures)
+        assert st["lo"]["shed"] == shed
+        # attested from the canonical series too
+        hi_shed = obs_metrics.fleet_requests(fleet._obs_id, "hi",
+                                             "shed")
+        lo_shed = obs_metrics.fleet_requests(fleet._obs_id, "lo",
+                                             "shed")
+        assert hi_shed.value == 0 and lo_shed.value == shed
+
+
+def test_fleet_tenant_breaker_half_open_mixed_paths(oneshot_bundle,
+                                                    lm_bundle):
+    """Per-tenant breaker under MIXED one-shot + decode traffic on a
+    single fleet: sustained rate-limit shedding opens lo's breaker
+    (hi stays closed and served on both paths), the cooldown goes
+    half-open, a DECODE probe closes it, a second flood re-opens it,
+    and a ONE-SHOT probe closes it again — both program families
+    drive the same tenant state machine."""
+    path, data = oneshot_bundle
+    fleet = FleetEngine(
+        tenants=[TenantClass("hi", priority=0),
+                 TenantClass("lo", priority=2, rate=30, burst=4)],
+        breaker_min_samples=4, breaker_window=8,
+        breaker_cooldown_ms=150.0, autoscale=False)
+    fleet.add_model("scorer", path, max_batch=8, max_delay_ms=1.0)
+    fleet.add_model("lm", lm_bundle, kind="lm", max_slots=4,
+                    max_t=32, max_prompt=8, prompt_align=4,
+                    max_new_tokens=2, paged=False)
+
+    def flood_until_open():
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:  # alternate paths: the flood itself is mixed
+                fleet.submit("scorer", data[:1], tenant="lo")
+                fleet.submit("lm", np.array([1, 2]), tenant="lo")
+            except Overloaded as exc:
+                if "breaker" in str(exc):
+                    return
+        raise AssertionError("lo breaker never opened")
+
+    with fleet:
+        flood_until_open()
+        state = fleet._tenant_state("lo")
+        assert state.state == "open"
+        assert obs_metrics.fleet_breaker_state(
+            fleet._obs_id, "lo").value == 2
+        # hi unaffected on BOTH paths while lo sheds
+        assert fleet("scorer", data[:2], tenant="hi",
+                     timeout=60).shape == (2, N_CLASSES)
+        assert len(fleet("lm", np.array([1]), tenant="hi",
+                         timeout=60)) == 2
+        assert fleet._tenant_state("hi").state == "closed"
+        # cooldown → half-open → DECODE probe closes
+        time.sleep(0.2)
+        assert len(fleet("lm", np.array([2, 3]), tenant="lo",
+                         timeout=60)) == 2
+        assert state.state == "closed"
+        # flood again → open → ONE-SHOT probe closes
+        flood_until_open()
+        assert state.state == "open"
+        time.sleep(0.2)
+        assert fleet("scorer", data[:1], tenant="lo",
+                     timeout=60).shape == (1, N_CLASSES)
+        assert state.state == "closed"
+
+
+def test_fleet_half_open_probe_failure_reopens(oneshot_bundle):
+    """A probe that sheds (still-flooding tenant) re-opens the
+    breaker instead of closing it."""
+    path, data = oneshot_bundle
+    # rate 2/s: the 150ms cooldown refills only 0.3 tokens, so the
+    # post-cooldown probe is itself rate-limited — deterministically
+    fleet = FleetEngine(
+        tenants=[TenantClass("lo", priority=2, rate=2.0, burst=2)],
+        breaker_min_samples=2, breaker_window=4,
+        breaker_cooldown_ms=100.0, autoscale=False)
+    fleet.add_model("scorer", path, max_batch=8, max_delay_ms=1.0)
+    with fleet:
+        deadline = time.monotonic() + 10
+        state = fleet._tenant_state("lo")
+        while state.state != "open" and time.monotonic() < deadline:
+            try:
+                fleet.submit("scorer", data[:1], tenant="lo")
+            except Overloaded:
+                pass
+        assert state.state == "open"
+        time.sleep(0.15)  # cooldown: next submit is the probe, and
+        # the bucket is still empty → the probe itself sheds → reopen
+        with pytest.raises(Overloaded):
+            fleet.submit("scorer", data[:1], tenant="lo")
+        assert state.state == "open"
+
+
+def test_fleet_tenant_deadline_and_queue_bound(oneshot_bundle):
+    path, data = oneshot_bundle
+    fleet = FleetEngine(
+        tenants=[TenantClass("slo", priority=1, deadline_ms=25,
+                             max_queue_rows=4)],
+        autoscale=False)
+    fleet.add_model("scorer", path, max_batch=8, max_delay_ms=5_000.0)
+    with fleet:
+        # the tenant's default deadline applies without a per-call one
+        f = fleet.submit("scorer", data[:2], tenant="slo")
+        with pytest.raises(DeadlineExceeded):
+            f.result(timeout=30)
+        assert fleet.stats()["tenants"]["slo"]["expired"] == 1
+        # per-tenant queue bound: 4 rows pending max
+        fleet.submit("scorer", data[:4], tenant="slo")
+        with pytest.raises(QueueFull, match="tenant 'slo' queue"):
+            fleet.submit("scorer", data[:2], tenant="slo")
+
+
+# ----------------------------------------------------------------------
+# shared ladder budget
+# ----------------------------------------------------------------------
+def test_shared_ladder_budget_evicts_lowest_priority_first(
+        oneshot_bundle):
+    path, _data = oneshot_bundle
+    premium = ExportedModel.load(path, device=XLADevice(), max_batch=8)
+    cheap = ExportedModel.load(path, device=XLADevice(), max_batch=8)
+    budget = SharedLadderBudget(max_programs=5, fleet="budget_test")
+    premium.attach_program_budget(budget, "premium", priority=0)
+    cheap.attach_program_budget(budget, "cheap", priority=2)
+    premium.warmup(8)   # 4 programs: 1,2,4,8
+    cheap.warmup(8)     # 4 more → pressure
+    st = budget.stats()
+    assert st["programs"] == 5
+    # the premium ladder is intact; the cheap one absorbed the
+    # pressure (its LRU buckets dropped)
+    assert st["per_model"]["premium"] == 4
+    assert st["per_model"]["cheap"] == 1
+    assert len(premium._programs) == 4
+    assert len(cheap._programs) == 1
+    evicted = obs_metrics.fleet_ladder_evictions("budget_test",
+                                                 "cheap")
+    assert evicted.value == 3
+    # a dropped bucket recompiles on demand and still serves
+    before = cheap.compile_count
+    out = cheap(np.zeros((1, DIM), np.float32))
+    assert out.shape == (1, N_CLASSES)
+    assert cheap.compile_count == before + 1
+
+
+def test_shared_ladder_budget_never_evicts_the_charged_program():
+    class FakeModel:
+        def __init__(self):
+            self.dropped = []
+
+        def drop_program(self, size):
+            self.dropped.append(size)
+            return True
+
+    budget = SharedLadderBudget(max_bytes=100, fleet="budget_test2")
+    m = FakeModel()
+    budget.register("only", m, priority=3)
+    budget.charge("only", 1, 400)  # over budget, but sole entry
+    assert m.dropped == []         # the charged program survives
+    budget.charge("only", 2, 50)   # now the old one is evictable
+    assert m.dropped == [1]
+
+
+# ----------------------------------------------------------------------
+# replicas: autoscaler, repair, chaos replica loss
+# ----------------------------------------------------------------------
+def test_fleet_autoscaler_scales_up_from_queue_age(oneshot_bundle):
+    path, data = oneshot_bundle
+    fleet = FleetEngine(autoscale=True, max_replicas=3)
+    fleet.autoscaler.queue_age_up_s = 0.02
+    fleet.autoscaler.cooldown_s = 0.0
+    fleet.add_model("m", path, max_batch=4, max_delay_ms=5_000.0)
+    with fleet:
+        group = fleet._models["m"].versions["v1"].group
+        assert group.live() == 1
+        f = fleet.submit("m", data[:1], tenant="default")
+        time.sleep(0.08)  # the parked request ages past the trigger
+        events = fleet.tick()
+        assert any("scaled m@v1 up" in e for e in events), events
+        assert group.live() == 2
+        up = obs_metrics.fleet_scale_events(fleet._obs_id, "m@v1",
+                                            "up")
+        assert up.value == 1
+        for eng in group.engines():
+            eng.flush()
+        f.result(timeout=60)
+
+
+def test_fleet_replica_loss_chaos_recovers_compile_free(
+        oneshot_bundle):
+    """fleet.replica_loss kills a live replica mid-traffic; routing
+    steers around it (zero failures), the autoscaler repairs the
+    group, and — because replicas share the warmed AOT ladder — the
+    repair compiles NOTHING."""
+    path, data = oneshot_bundle
+    root.common.engine.faults = {"fleet.replica_loss": {"at": [1]}}
+    fleet = FleetEngine(autoscale=True)
+    fleet.add_model("m", path, max_batch=8, max_delay_ms=1.0,
+                    replicas=2)
+    compiles = obs_metrics.xla_compiles("serving-aot")
+    with fleet:
+        warmed = compiles.value
+        group = fleet._models["m"].versions["v1"].group
+        assert group.live() == 2
+        fleet("m", data[:2], timeout=60)
+        # one tick: chaos kills a replica AND the autoscaler pass in
+        # the same tick repairs the group back to target
+        events = fleet.tick()
+        assert any("replica loss" in e for e in events), events
+        assert any("repaired" in e for e in events), events
+        assert group.live() == 2
+        # traffic kept flowing throughout
+        assert fleet("m", data[:2], timeout=60).shape == (2, N_CLASSES)
+        assert fleet("m", data[:2], timeout=60).shape == (2, N_CLASSES)
+        assert compiles.value == warmed, \
+            "replica repair recompiled the shared ladder"
+        repair = obs_metrics.fleet_scale_events(fleet._obs_id,
+                                                "m@v1", "repair")
+        assert repair.value == 1
+    plan = root.common.engine.faults
+    assert plan.events_fired == 1
+
+
+def test_fleet_tenant_flood_chaos_site(oneshot_bundle):
+    path, data = oneshot_bundle
+    root.common.engine.faults = {
+        "fleet.tenant_flood": {"at": [1], "n": 20}}
+    fleet = make_fleet((path, data))
+    with fleet:
+        events = fleet.tick()
+        assert any("injected flood" in e for e in events), events
+        st = fleet.stats()["tenants"]
+        # the flood landed on the LOWEST-priority tenant and was
+        # absorbed there (admitted + shed == burst), hi untouched
+        assert st["lo"]["submitted"] + st["lo"]["shed"] == 20
+        assert st["hi"]["shed"] == 0 and st["hi"]["submitted"] == 0
+        assert fleet("scorer", data[:2], tenant="hi",
+                     timeout=60).shape == (2, N_CLASSES)
+
+
+def test_fleet_ready_and_web_status(oneshot_bundle):
+    path, data = oneshot_bundle
+    fleet = make_fleet((path, data))
+    with fleet:
+        fleet("scorer", data[:1], tenant="hi", timeout=60)
+        assert fleet.ready()
+        status = fleet.serving_status()
+        assert status["name"].startswith("fleet:")
+        assert status["models"]["scorer"]["kind"] == "oneshot"
+        # an open LO breaker does not unready the process: it sheds
+        # exactly that tenant
+        fleet._tenant_state("lo").transition("open")
+        assert fleet.ready()
+    assert not fleet.ready()

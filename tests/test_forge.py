@@ -94,6 +94,143 @@ def test_http_publish_fetch(trained_wine, tmp_path):
         server.stop()
 
 
+def test_upload_writes_sha256_sidecar_and_fetch_verifies(
+        trained_wine, tmp_path):
+    import os
+
+    from znicz_tpu.utils.snapshotter import _sha256_file
+
+    registry = ForgeRegistry(str(tmp_path / "reg"))
+    bundle = str(tmp_path / "b.forge.tar.gz")
+    package(trained_wine, bundle, version="1.0.0")
+    registry.upload(bundle)
+    path = registry.fetch("wine")
+    sidecar = f"{path}.sha256"
+    assert os.path.exists(sidecar)
+    with open(sidecar) as f:
+        assert f.read().strip() == _sha256_file(path)
+
+
+def test_fetch_quarantines_corrupt_bundle_and_falls_back(
+        trained_wine, tmp_path):
+    """Round 16: a bundle whose bytes no longer match the sidecar is
+    QUARANTINED on fetch (never handed to a loader) and the fetch
+    falls back to the newest older good version, counted on the
+    canonical failure/recovery series."""
+    import os
+
+    from znicz_tpu.observe import metrics as obs_metrics
+    from znicz_tpu.utils.snapshotter import SnapshotCorrupt
+
+    registry = ForgeRegistry(str(tmp_path / "reg"))
+    for version in ("1.0.0", "1.1.0"):
+        bundle = str(tmp_path / f"b{version}.forge.tar.gz")
+        package(trained_wine, bundle, version=version)
+        registry.upload(bundle)
+    # corrupt the NEWEST on disk, behind the sidecar's back
+    newest = registry._bundle_path("wine", "1.1.0")
+    with open(newest, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xde\xad\xbe\xef")
+    fails = obs_metrics.snapshot_failures("forge")
+    recov = obs_metrics.recoveries("forge_fallback")
+    f0, r0 = fails.value, recov.value
+    path = registry.fetch("wine")  # falls back, does not raise
+    assert path.endswith("1.0.0.forge.tar.gz")
+    assert fails.value - f0 == 1 and recov.value - r0 == 1
+    # the corrupt file is out of the serving set, permanently
+    assert registry.list() == {"wine": ["1.0.0"]}
+    qdir = os.path.join(str(tmp_path / "reg"), "wine", "quarantine")
+    assert sorted(os.listdir(qdir)) == [
+        "1.1.0.forge.tar.gz", "1.1.0.forge.tar.gz.sha256"]
+    # the survivor still loads end-to-end
+    model_path = extract_model(path, str(tmp_path / "serve"))
+    model = ExportedModel.load(model_path, device=NumpyDevice())
+    data, _ = make_data()
+    assert model(data[:2]).shape == (2, 3)
+    # an EXPLICIT fetch of a corrupt version raises instead of
+    # silently substituting another version
+    bundle = str(tmp_path / "b2.forge.tar.gz")
+    package(trained_wine, bundle, version="1.2.0")
+    registry.upload(bundle)
+    corrupt = registry._bundle_path("wine", "1.2.0")
+    with open(corrupt, "r+b") as f:
+        f.seek(10)
+        f.write(b"\x00\x00\x00\x00")
+    with pytest.raises(SnapshotCorrupt):
+        registry.fetch("wine", "1.2.0")
+    # nothing left to fall back to → SnapshotCorrupt, not silence
+    with open(registry._bundle_path("wine", "1.0.0"), "r+b") as f:
+        f.seek(10)
+        f.write(b"\xff\xff\xff\xff")
+    with pytest.raises(SnapshotCorrupt, match="no version"):
+        registry.fetch("wine")
+
+
+def test_fetch_legacy_bundle_pins_sidecar_on_first_read(
+        trained_wine, tmp_path):
+    import os
+
+    registry = ForgeRegistry(str(tmp_path / "reg"))
+    bundle = str(tmp_path / "b.forge.tar.gz")
+    package(trained_wine, bundle, version="1.0.0")
+    registry.upload(bundle)
+    path = registry._bundle_path("wine", "1.0.0")
+    os.unlink(f"{path}.sha256")  # simulate a pre-round-16 upload
+    fetched = registry.fetch("wine")
+    assert os.path.exists(f"{fetched}.sha256")  # pinned on first read
+    # …and the pin is enforced from then on
+    with open(path, "r+b") as f:
+        f.seek(10)
+        f.write(b"\xde\xad")
+    from znicz_tpu.utils.snapshotter import SnapshotCorrupt
+    with pytest.raises(SnapshotCorrupt):
+        registry.fetch("wine")
+
+
+def test_fleet_model_corrupt_chaos_site(trained_wine, tmp_path):
+    """The fleet.model_corrupt site makes fetch treat the newest
+    bundle as digest-corrupt: quarantine + fallback, exactly the
+    failure the chaos arm injects."""
+    from znicz_tpu.utils.config import root
+
+    registry = ForgeRegistry(str(tmp_path / "reg"))
+    for version in ("1.0.0", "2.0.0"):
+        bundle = str(tmp_path / f"b{version}.forge.tar.gz")
+        package(trained_wine, bundle, version=version)
+        registry.upload(bundle)
+    root.common.engine.faults = {"fleet.model_corrupt": {"at": [1]}}
+    path = registry.fetch("wine")
+    assert path.endswith("1.0.0.forge.tar.gz")  # fell back past 2.0.0
+    assert registry.list() == {"wine": ["1.0.0"]}
+    plan = root.common.engine.faults
+    assert plan.counts() == {"fleet.model_corrupt": 1}
+
+
+def test_http_fetch_refuses_corrupt_bundle(trained_wine, tmp_path):
+    """The HTTP serve path rides the same verification: a fully
+    corrupt registry answers 410, never streams corrupt bytes."""
+    import urllib.error
+    import urllib.request
+
+    registry = ForgeRegistry(str(tmp_path / "reg"))
+    bundle = str(tmp_path / "b.forge.tar.gz")
+    package(trained_wine, bundle, version="1.0.0")
+    registry.upload(bundle)
+    with open(registry._bundle_path("wine", "1.0.0"), "r+b") as f:
+        f.seek(10)
+        f.write(b"\xde\xad\xbe\xef")
+    server = ForgeServer(registry, port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc_info:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/fetch?name=wine",
+                timeout=10)
+        assert exc_info.value.code == 410
+    finally:
+        server.stop()
+
+
 def test_latest_version_semver_ordering(trained_wine, tmp_path):
     """Numeric-aware AND release-over-pre-release: 2.0.0 beats
     2.0.0-rc1; longer numeric versions beat shorter."""

@@ -286,6 +286,13 @@ class ExportedModel(Logger):
         self._live_params: tuple = ()
         self._swap_lock = threading.RLock()
         self.weights_version = 0
+        # round 16: an optional FLEET-shared ladder budget — when many
+        # resident models share one device, program-cache pressure is
+        # a cross-model decision (evict the lowest-priority tenant's
+        # buckets first), so the fleet attaches one accountant here
+        self._shared_budget = None
+        self._budget_key: str | None = None
+        self._budget_priority = 0
         self._build_chain()
 
     @classmethod
@@ -555,29 +562,77 @@ class ExportedModel(Logger):
 
         return call
 
+    def attach_program_budget(self, budget, key: str,
+                              priority: int = 0) -> None:
+        """Join a fleet-shared ladder budget (round 16): every program
+        this model compiles is charged to ``budget`` under ``key`` at
+        the model's tenant ``priority`` (smaller = more important).
+        The budget may call :meth:`drop_program` back on ANY attached
+        model to relieve pressure — lowest-priority ladders first."""
+        self._shared_budget = budget
+        self._budget_key = str(key)
+        self._budget_priority = int(priority)
+        budget.register(key, self, priority)
+
+    def program_nbytes(self, size: int) -> int:
+        """Rough per-program working-set estimate used by the shared
+        ladder budget: the padded input batch bytes times the chain
+        depth (a proxy for the activations each bucket's program keeps
+        live — parameters are shared across buckets and excluded)."""
+        sample = int(np.prod(self.input_shape or (1,)))
+        return (size * sample * np.dtype(self.serve_dtype).itemsize
+                * (len(self.forwards) + 1))
+
+    def drop_program(self, size: int) -> bool:
+        """Evict one bucket's AOT program (shared-budget pressure or
+        explicit trimming).  A dispatch already holding the callable
+        keeps it alive; the next request for this bucket recompiles.
+        Returns True when a resident program was dropped."""
+        with self._swap_lock:
+            if self._programs.pop(size, None) is None:
+                return False
+            self.debug("dropped program for batch %d (shared ladder "
+                       "budget pressure)", size)
+            return True
+
     def program_for(self, size: int):
         """The AOT program serving a PADDED batch of exactly ``size``
         rows, compiled on first use and LRU-cached.  The engine warms
         the whole ladder through this; ``__call__`` routes through it
-        after rounding up."""
-        fn = self._programs.get(size)
-        if fn is not None:
-            self._programs.move_to_end(size)
-            self.program_hits[size] += 1
-            return fn
+        after rounding up.  Thread-safe: fleet replica engines share
+        one model, so the hit path takes the same lock the compile and
+        swap paths hold."""
+        compiled = False
+        local_evicted: list[int] = []
         with self._swap_lock:  # compile never races a weight flip
             fn = self._programs.get(size)
             if fn is not None:
-                return fn
-            self._initialize(size)
-            fn = self._aot_compile()
-            self._programs[size] = fn
-            if self.bucketing:
-                while len(self._programs) > self._program_capacity:
-                    evicted, _ = self._programs.popitem(last=False)
-                    self.debug(
-                        "evicted program for batch %d (LRU, cap %d)",
-                        evicted, self._program_capacity)
+                self._programs.move_to_end(size)
+                self.program_hits[size] += 1
+            else:
+                compiled = True
+                self._initialize(size)
+                fn = self._aot_compile()
+                self._programs[size] = fn
+                if self.bucketing:
+                    while len(self._programs) > self._program_capacity:
+                        evicted, _ = self._programs.popitem(last=False)
+                        local_evicted.append(evicted)
+                        self.debug(
+                            "evicted program for batch %d (LRU, cap "
+                            "%d)", evicted, self._program_capacity)
+        # the shared budget is touched OUTSIDE the model lock: its
+        # pressure handler takes other models' locks (drop_program),
+        # so holding ours here would invert the lock order
+        budget = self._shared_budget
+        if budget is not None:
+            for gone in local_evicted:
+                budget.forget(self._budget_key, gone)
+            if compiled:
+                budget.charge(self._budget_key, size,
+                              self.program_nbytes(size))
+            else:
+                budget.touch(self._budget_key, size)
         return fn
 
     # ------------------------------------------------------------------

@@ -16,7 +16,18 @@ Here a **forge bundle** is one ``.forge.tar.gz`` holding:
 :class:`ForgeServer`/:class:`ForgeClient` wrap it over HTTP (stdlib
 ``http.server``/``urllib`` — no tornado in this environment) so one
 host can publish models to the rest of a site, exactly the VelesForge
-workflow."""
+workflow.
+
+Integrity (round 16): every upload writes a ``.sha256`` sidecar (the
+:mod:`znicz_tpu.resilience.publisher` convention) and every
+:meth:`ForgeRegistry.fetch` verifies it BEFORE the bundle reaches a
+loader — a corrupt bundle is moved to the ``quarantine/`` subdirectory
+(counted on ``znicz_snapshot_failures_total{op=forge}``) and, when no
+explicit version was requested, the fetch falls back to the newest
+older good version (``znicz_recoveries_total{kind=forge_fallback}``).
+Pre-round-16 bundles without a sidecar get one on first verified read
+(trust-on-first-fetch, then pinned).  The ``fleet.model_corrupt``
+chaos site injects exactly this failure."""
 
 from __future__ import annotations
 
@@ -122,6 +133,7 @@ class ForgeRegistry(Logger):
                             f".forge.tar.gz")
 
     def upload(self, bundle_path: str) -> dict:
+        from znicz_tpu.utils.snapshotter import _sha256_file
         manifest = read_manifest(bundle_path)
         dest = self._bundle_path(manifest["name"], manifest["version"])
         os.makedirs(os.path.dirname(dest), exist_ok=True)
@@ -130,6 +142,7 @@ class ForgeRegistry(Logger):
         # upload race that a check-then-replace would leave open
         tmp = f"{dest}.{os.getpid()}.tmp"
         shutil.copyfile(bundle_path, tmp)
+        digest = _sha256_file(tmp)
         try:
             os.link(tmp, dest)
         except FileExistsError:
@@ -138,8 +151,15 @@ class ForgeRegistry(Logger):
                 f"published (versions are immutable)") from None
         finally:
             os.unlink(tmp)
-        self.info("published %s %s", manifest["name"],
-                  manifest["version"])
+        # digest sidecar AFTER the bundle lands (publisher.py write
+        # order): a concurrent fetch sees either a complete pair or a
+        # sidecar-less file it will TOFU-verify
+        side_tmp = f"{dest}.sha256.{os.getpid()}.tmp"
+        with open(side_tmp, "w") as f:
+            f.write(digest + "\n")
+        os.replace(side_tmp, f"{dest}.sha256")
+        self.info("published %s %s (sha256 %s…)", manifest["name"],
+                  manifest["version"], digest[:12])
         return manifest
 
     def list(self) -> dict[str, list[str]]:
@@ -173,12 +193,86 @@ class ForgeRegistry(Logger):
             return parts + [(1, 0, "")] * (width - len(parts))
         return sorted(versions, key=key)[-1]
 
+    def _verify(self, name: str, version: str, path: str) -> None:
+        """Digest-check one bundle; raises ``SnapshotCorrupt`` on a
+        mismatch (or when the ``fleet.model_corrupt`` chaos site says
+        so).  A sidecar-less legacy bundle is hashed and pinned on
+        first read (trust-on-first-fetch)."""
+        from znicz_tpu.resilience import faults as _faults
+        from znicz_tpu.utils.snapshotter import (SnapshotCorrupt,
+                                                 _sha256_file)
+        if _faults.fire("fleet.model_corrupt", name=name,
+                        version=version) is not None:
+            raise SnapshotCorrupt(
+                f"{path}: injected digest corruption "
+                f"(fleet.model_corrupt)")
+        sidecar = f"{path}.sha256"
+        got = _sha256_file(path)
+        if not os.path.exists(sidecar):
+            side_tmp = f"{sidecar}.{os.getpid()}.tmp"
+            with open(side_tmp, "w") as f:
+                f.write(got + "\n")
+            os.replace(side_tmp, sidecar)
+            self.info("pinned legacy bundle %s %s on first fetch "
+                      "(sha256 %s…)", name, version, got[:12])
+            return
+        with open(sidecar) as f:
+            want = f.read().strip()
+        if got != want:
+            raise SnapshotCorrupt(
+                f"{path}: sha256 {got[:12]}… != sidecar {want[:12]}…")
+
+    def _quarantine(self, name: str, path: str) -> str:
+        """Move a corrupt bundle (+ sidecar) out of the serving set so
+        no later fetch or latest_version can ever surface it again."""
+        qdir = os.path.join(self.directory, name, "quarantine")
+        os.makedirs(qdir, exist_ok=True)
+        dest = os.path.join(qdir, os.path.basename(path))
+        os.replace(path, dest)
+        sidecar = f"{path}.sha256"
+        if os.path.exists(sidecar):
+            os.replace(sidecar, f"{dest}.sha256")
+        return dest
+
     def fetch(self, name: str, version: str | None = None) -> str:
-        version = version or self.latest_version(name)
-        path = self._bundle_path(name, version)
-        if not os.path.exists(path):
-            raise KeyError(f"no bundle {name} {version}")
-        return path
+        """The digest-VERIFIED bundle path for ``name`` (newest
+        version when ``version`` is None).  A bundle failing
+        verification is quarantined; with no explicit version the
+        fetch falls back to the newest older good version, raising
+        ``SnapshotCorrupt`` only when nothing verifies."""
+        from znicz_tpu.observe import metrics as _metrics
+        from znicz_tpu.utils.snapshotter import SnapshotCorrupt
+        explicit = version is not None
+        fell_back = False
+        last_exc: Exception | None = None
+        while True:
+            version = version if explicit else self.latest_version(name)
+            path = self._bundle_path(name, version)
+            if not os.path.exists(path):
+                raise KeyError(f"no bundle {name} {version}")
+            try:
+                self._verify(name, version, path)
+            except SnapshotCorrupt as exc:
+                _metrics.snapshot_failures("forge").inc()
+                quarantined = self._quarantine(name, path)
+                self.warning("quarantined %s %s → %s: %s", name,
+                             version, quarantined, exc)
+                last_exc = exc
+                if explicit:
+                    raise
+                if not self.list().get(name):
+                    raise SnapshotCorrupt(
+                        f"no version of '{name}' verifies "
+                        f"(last: {last_exc})") from exc
+                fell_back = True
+                version = None
+                continue
+            if fell_back:
+                _metrics.recoveries("forge_fallback").inc()
+                self.info("fetch fell back to %s %s after "
+                          "quarantining newer corrupt version(s)",
+                          name, version)
+            return path
 
     def manifest(self, name: str, version: str | None = None) -> dict:
         return read_manifest(self.fetch(name, version))
@@ -212,11 +306,19 @@ class ForgeServer(Logger):
                         registry.list()).encode())
                     return
                 if parsed.path == "/fetch":
+                    from znicz_tpu.utils.snapshotter import \
+                        SnapshotCorrupt
                     q = urllib.parse.parse_qs(parsed.query)
                     try:
                         path = registry.fetch(
                             q["name"][0],
                             q.get("version", [None])[0])
+                    except SnapshotCorrupt as exc:
+                        # never stream corrupt bytes to a client: the
+                        # bundle was quarantined, nothing verifies
+                        self._send(410, json.dumps(
+                            {"error": str(exc)}).encode())
+                        return
                     except (KeyError, ValueError) as exc:
                         self._send(404, json.dumps(
                             {"error": str(exc)}).encode())

@@ -834,3 +834,111 @@ def publishes_total(source: str) -> Counter:
         "znicz_publishes_total",
         "Model bundles published to the serving handoff directory",
         labels=("source",)).labels(source=source)
+
+
+# ----------------------------------------------------------------------
+# round 16: multi-tenant fleet series — the isolation proof is read
+# from exactly these (the bench and the dryrun attest per-tenant p99,
+# shed attribution and replica counts from a live /metrics scrape)
+# ----------------------------------------------------------------------
+def fleet_requests(fleet: str, tenant: str, event: str) -> Counter:
+    """Per-tenant request lifecycle on one fleet: ``submitted``,
+    ``served``, ``shed`` (rate-limit/preemption/breaker), ``expired``
+    (deadline), ``failed``.  ``shed`` attribution per tenant is the
+    overload proof: under a low-priority flood ONLY the flooding
+    tenant's child moves."""
+    return REGISTRY.counter(
+        "znicz_fleet_requests_total",
+        "Fleet requests by tenant and lifecycle event",
+        labels=("fleet", "tenant", "event")).labels(
+        fleet=fleet, tenant=tenant, event=event)
+
+
+def fleet_latency_seconds(fleet: str, tenant: str) -> Histogram:
+    """Per-tenant SLO-latency distribution: submit→reply for one-shot
+    scoring, submit→first-token (TTFT) for generation — the
+    scheduling-bound metric in both cases (a generation's completion
+    time is proportional to the tokens requested; its cadence rides
+    ``znicz_serving_token_seconds``)."""
+    return REGISTRY.histogram(
+        "znicz_fleet_latency_seconds",
+        "Fleet SLO latency by tenant (reply for one-shot, TTFT for "
+        "generation)",
+        labels=("fleet", "tenant")).labels(fleet=fleet, tenant=tenant)
+
+
+def fleet_latency_p99_seconds(fleet: str, tenant: str) -> Gauge:
+    """Exact windowed per-tenant p99 exported as a summary-style
+    gauge (callback over the fleet's sliding window) — the SLO bound
+    the isolation attestation reads from the scrape, immune to
+    histogram-bucket interpolation error."""
+    return REGISTRY.gauge(
+        "znicz_fleet_latency_p99_seconds",
+        "Exact windowed p99 fleet latency by tenant",
+        labels=("fleet", "tenant")).labels(fleet=fleet, tenant=tenant)
+
+
+def fleet_breaker_state(fleet: str, tenant: str) -> Gauge:
+    """Per-TENANT circuit breaker (0=closed, 1=half-open, 2=open):
+    one tenant's breaker opening sheds only that tenant."""
+    return REGISTRY.gauge(
+        "znicz_fleet_breaker_state",
+        "Per-tenant fleet breaker state (0 closed, 1 half-open, "
+        "2 open)",
+        labels=("fleet", "tenant")).labels(fleet=fleet, tenant=tenant)
+
+
+def fleet_tenant_tokens(fleet: str, tenant: str) -> Gauge:
+    """Live token-bucket level per tenant (callback gauge)."""
+    return REGISTRY.gauge(
+        "znicz_fleet_tenant_tokens",
+        "Fleet admission token-bucket level by tenant",
+        labels=("fleet", "tenant")).labels(fleet=fleet, tenant=tenant)
+
+
+def fleet_models(fleet: str) -> Gauge:
+    """Resident models on one fleet (the dryrun tail's ``fleet=N
+    models``)."""
+    return REGISTRY.gauge(
+        "znicz_fleet_models",
+        "Models resident in the fleet",
+        labels=("fleet",)).labels(fleet=fleet)
+
+
+def fleet_replicas(fleet: str, model: str) -> Gauge:
+    """Live replica count per model (the autoscaler moves this; a
+    ``fleet.replica_loss`` injection dips it until repair)."""
+    return REGISTRY.gauge(
+        "znicz_fleet_replicas",
+        "Live serving replicas per fleet model",
+        labels=("fleet", "model")).labels(fleet=fleet, model=model)
+
+
+def fleet_scale_events(fleet: str, model: str, op: str) -> Counter:
+    """Autoscaler verdicts per model: ``up``, ``down``, ``repair``
+    (replica-loss respawn)."""
+    return REGISTRY.counter(
+        "znicz_fleet_scale_events_total",
+        "Fleet autoscaler scale events per model",
+        labels=("fleet", "model", "op")).labels(
+        fleet=fleet, model=model, op=op)
+
+
+def fleet_traffic_weight(fleet: str, model: str, version: str) -> Gauge:
+    """Configured A/B traffic fraction per model version (weighted
+    routing generalizing the round-13 two-version canary)."""
+    return REGISTRY.gauge(
+        "znicz_fleet_traffic_weight",
+        "Configured traffic fraction per fleet model version",
+        labels=("fleet", "model", "version")).labels(
+        fleet=fleet, model=model, version=version)
+
+
+def fleet_ladder_evictions(fleet: str, model: str) -> Counter:
+    """Bucket programs dropped by the SHARED ladder budget under
+    memory pressure — pressure lands on the lowest-priority model's
+    ladder first."""
+    return REGISTRY.counter(
+        "znicz_fleet_ladder_evictions_total",
+        "Bucket programs evicted by the shared fleet ladder budget",
+        labels=("fleet", "model")).labels(fleet=fleet, model=model)

@@ -95,6 +95,21 @@ SITES = {
         "the post-promotion probation check reports the freshly "
         "promoted model unhealthy, forcing an automatic rollback to "
         "the prior version",
+    "fleet.tenant_flood":
+        "FleetEngine.tick injects a burst of payload 'n' (default 32) "
+        "synthetic requests for payload 'tenant' (default the lowest-"
+        "priority tenant) — admission must shed the flood inside that "
+        "tenant's class without moving any other tenant's SLO",
+    "fleet.model_corrupt":
+        "ForgeRegistry.fetch treats the fetched bundle as failing its "
+        "sha256 digest — the registry must QUARANTINE it and fall "
+        "back to the newest older good version instead of handing "
+        "corrupt bytes to a loader",
+    "fleet.replica_loss":
+        "FleetEngine.tick kills one live replica of payload 'model' "
+        "(default the first model) mid-traffic — routing must steer "
+        "around the loss and the autoscaler must repair the group "
+        "with zero high-priority request failures",
 }
 
 #: spec keys that steer firing rather than ride the payload

@@ -19,6 +19,13 @@ Entry points::
     with DecodeEngine("lm.npz", max_slots=4, max_t=64) as eng:
         tokens = eng.generate(prompt)   # autoregressive generation
 
+    from znicz_tpu.serving import FleetEngine, TenantClass  # round 16
+    fleet = FleetEngine(tenants=[TenantClass("hi", priority=0)])
+    fleet.add_model("scorer", "model.npz")
+    fleet.add_model("lm", "lm.npz", kind="lm")
+    with fleet:
+        probs = fleet("scorer", x, tenant="hi")    # multi-tenant SLOs
+
 See :mod:`znicz_tpu.serving.engine` (one-shot scoring) and
 :mod:`znicz_tpu.serving.decode` (KV-cache generation) for the design
 notes.
@@ -28,7 +35,9 @@ from znicz_tpu.serving.batcher import (  # noqa: F401
     ContinuousBatcher,
     DeadlineExceeded,
     Overloaded,
+    PriorityQueue,
     QueueFull,
+    TokenBucketLimiter,
     TokenBudget,
 )
 from znicz_tpu.serving.buckets import (  # noqa: F401
@@ -47,6 +56,13 @@ from znicz_tpu.serving.decode import (  # noqa: F401
 from znicz_tpu.serving.engine import (  # noqa: F401
     ServingEngine,
     resolve_swap_state,
+)
+from znicz_tpu.serving.fleet import (  # noqa: F401
+    FleetAutoscaler,
+    FleetEngine,
+    ReplicaGroup,
+    SharedLadderBudget,
+    TenantClass,
 )
 
 

@@ -42,6 +42,21 @@ Round-11 resilience (graceful degradation under in-flight faults):
   failure → open again).  Every transition is a registry counter and
   the live state a gauge (``/metrics``, ``/readyz``).
 
+Round-16 tenancy (the fleet's admission plane): every request may
+carry a ``tenant`` + ``priority``.  Pending requests live in priority
+CLASSES — strict priority across classes (smaller number dispatches
+first), FIFO within a class — so a low-priority flood can delay a
+high-priority request by at most the dispatch already in flight.  The
+row bound becomes preemptive: when the queue is full and a
+higher-priority request arrives, the NEWEST lower-priority rows are
+shed (:class:`Overloaded`) to make room — the flooding class absorbs
+its own overload.  Per-request ``retry_budget`` overrides the engine
+default (per-tenant SLOs), per-tenant row bounds
+(``tenant_max_rows``) cap any one tenant's share of the queue, and
+the breaker's stall-trip watches the HIGHEST-priority head only — a
+starved low class is a shedding/deadline problem for that class, not
+evidence of a stalled device.
+
 The batcher knows nothing about models or devices — it hands each
 coalesced batch (a list of :class:`Request`) to the ``run_batch``
 callable and that callable resolves the futures.
@@ -92,9 +107,19 @@ class TokenBudget:
     engine bounds admission in the same currency.  ``try_acquire`` is
     non-blocking (admission control wants an immediate
     :class:`QueueFull`, never a hidden wait); ``release`` returns a
-    request's charge when it completes, fails or expires."""
+    request's charge when it completes, fails or expires.
 
-    __slots__ = ("capacity", "_used", "_lock")
+    Round 16 tightened the accounting contract to exactly-once: a
+    reservation must be released exactly one time across every exit
+    path (served, dispatch-failed after retries, deadline-evicted,
+    preempted, shed at the pool) — a retry that re-queues a request
+    at the queue front KEEPS its reservation (the work is still
+    pending).  A release that exceeds what is held no longer clamps
+    silently: it is counted on :attr:`over_released` (and the excess
+    discarded), so a double-release shows up as a nonzero counter in
+    the accounting tests instead of as quiet over-admission."""
+
+    __slots__ = ("capacity", "_used", "_lock", "over_released")
 
     def __init__(self, capacity: int) -> None:
         if capacity < 1:
@@ -102,6 +127,9 @@ class TokenBudget:
         self.capacity = int(capacity)
         self._used = 0
         self._lock = threading.Lock()
+        #: tokens released beyond what was held — MUST stay 0; any
+        #: nonzero value is a double-release bug in a caller
+        self.over_released = 0
 
     @property
     def used(self) -> int:
@@ -123,17 +151,194 @@ class TokenBudget:
             return True
 
     def release(self, n: int) -> None:
+        n = int(n)
         with self._lock:
-            self._used = max(0, self._used - int(n))
+            if n > self._used:
+                self.over_released += n - self._used
+                n = self._used
+            self._used -= n
+
+    def balanced(self) -> bool:
+        """True when every reservation was returned exactly once —
+        nothing outstanding, nothing over-released (assert this when
+        the owning queue is idle)."""
+        with self._lock:
+            return self._used == 0 and self.over_released == 0
+
+
+class TokenBucketLimiter:
+    """Classic token-bucket rate limiter (round 16): ``rate`` units
+    refill per second up to ``burst``; ``try_acquire`` is non-blocking
+    — admission control sheds instead of waiting.  ``rate=None``
+    disables limiting (always admits).  Thread-safe; refill is
+    computed lazily from the monotonic clock, so an idle bucket needs
+    no timer thread."""
+
+    __slots__ = ("rate", "burst", "_level", "_t_last", "_lock")
+
+    def __init__(self, rate: float | None, burst: float | None = None
+                 ) -> None:
+        self.rate = None if rate is None else float(rate)
+        if self.rate is not None and self.rate <= 0:
+            raise ValueError(f"need rate > 0 (or None), got {rate}")
+        self.burst = float(burst if burst is not None
+                           else (self.rate or 1.0))
+        if self.burst <= 0:
+            raise ValueError(f"need burst > 0, got {burst}")
+        self._level = self.burst
+        self._t_last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def _refill(self, now: float) -> None:
+        self._level = min(self.burst, self._level
+                          + (now - self._t_last) * (self.rate or 0.0))
+        self._t_last = now
+
+    @property
+    def level(self) -> float:
+        """Current token level (telemetry)."""
+        if self.rate is None:
+            return self.burst
+        with self._lock:
+            self._refill(time.monotonic())
+            return self._level
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        if self.rate is None:
+            return True
+        with self._lock:
+            self._refill(time.monotonic())
+            if self._level < n:
+                return False
+            self._level -= n
+            return True
+
+
+class PriorityQueue:
+    """Pending requests in strict priority classes (round 16).
+
+    Smaller ``priority`` dispatches first; FIFO within a class.  Works
+    for any request object carrying ``priority``, ``n`` (rows/tokens)
+    and ``t_submit``.  NOT thread-safe — callers hold their own
+    condition lock (the batcher's ``_cond``)."""
+
+    __slots__ = ("_classes",)
+
+    def __init__(self) -> None:
+        self._classes: dict[int, deque] = {}
+
+    def append(self, req) -> None:
+        prio = int(getattr(req, "priority", 0))
+        self._classes.setdefault(prio, deque()).append(req)
+
+    def appendleft(self, req) -> None:
+        prio = int(getattr(req, "priority", 0))
+        self._classes.setdefault(prio, deque()).appendleft(req)
+
+    def requeue_front(self, reqs) -> None:
+        """Retry path: requests re-enter the FRONT of their own
+        class, original order preserved."""
+        for req in reversed(list(reqs)):
+            self.appendleft(req)
+
+    def peek(self):
+        """The request that would dispatch next (None when empty)."""
+        for prio in sorted(self._classes):
+            q = self._classes[prio]
+            if q:
+                return q[0]
+        return None
+
+    def popleft(self):
+        for prio in sorted(self._classes):
+            q = self._classes[prio]
+            if q:
+                req = q.popleft()
+                if not q:
+                    del self._classes[prio]
+                return req
+        raise IndexError("pop from empty PriorityQueue")
+
+    def __len__(self) -> int:
+        # telemetry readers (stats, gauges) call this without the
+        # owner's lock — retry on a concurrent class-dict mutation
+        try:
+            return sum(len(q) for q in self._classes.values())
+        except RuntimeError:
+            return sum(len(q) for q in list(self._classes.values()))
+
+    def __bool__(self) -> bool:
+        try:
+            return any(self._classes.values())
+        except RuntimeError:
+            return any(list(self._classes.values()))
+
+    def __iter__(self):
+        for prio in sorted(self._classes):
+            yield from list(self._classes[prio])
+
+    def oldest_t(self) -> float | None:
+        """Submit time of the oldest pending request across ALL
+        classes (admission-window clock + queue-age telemetry)."""
+        heads = [q[0].t_submit for q in self._classes.values() if q]
+        return min(heads) if heads else None
+
+    def sweep(self, pred) -> list:
+        """Remove and return every request matching ``pred``
+        (deadline eviction)."""
+        removed: list = []
+        for prio in list(self._classes):
+            q = self._classes[prio]
+            hits = [r for r in q if pred(r)]
+            if not hits:
+                continue
+            removed.extend(hits)
+            keep = deque(r for r in q if not pred(r))
+            if keep:
+                self._classes[prio] = keep
+            else:
+                del self._classes[prio]
+        return removed
+
+    def rows_below(self, priority: int) -> int:
+        """Rows held by classes STRICTLY lower-priority (numerically
+        greater) than ``priority`` — what preemption could free."""
+        return sum(r.n for prio, q in self._classes.items()
+                   if prio > priority for r in q)
+
+    def evict_below(self, priority: int, rows_needed: int) -> list:
+        """Preemption: pop the NEWEST requests from the lowest class
+        upward (strictly below ``priority``) until ``rows_needed``
+        rows are freed; returns the evicted requests.  Newest-first
+        within a class: the evicted waited least, so the least sunk
+        queue time is thrown away."""
+        evicted: list = []
+        freed = 0
+        for prio in sorted(self._classes, reverse=True):
+            if prio <= priority:
+                break
+            q = self._classes[prio]
+            while q and freed < rows_needed:
+                req = q.pop()
+                evicted.append(req)
+                freed += req.n
+            if not q:
+                del self._classes[prio]
+            if freed >= rows_needed:
+                break
+        return evicted
 
 
 class Request:
     """One submitted batch of rows riding the queue."""
 
-    __slots__ = ("x", "n", "future", "t_submit", "deadline", "attempts")
+    __slots__ = ("x", "n", "future", "t_submit", "deadline", "attempts",
+                 "tenant", "priority", "retry_budget")
 
     def __init__(self, x: np.ndarray,
-                 deadline_ms: float | None = None) -> None:
+                 deadline_ms: float | None = None,
+                 tenant: str | None = None, priority: int = 0,
+                 retry_budget: int | None = None) -> None:
         self.x = x
         self.n = int(x.shape[0])
         self.future: Future = Future()
@@ -141,6 +346,11 @@ class Request:
         self.deadline = (None if deadline_ms is None
                          else self.t_submit + float(deadline_ms) / 1e3)
         self.attempts = 0
+        self.tenant = tenant
+        self.priority = int(priority)
+        #: per-request override of the batcher's retry budget (the
+        #: fleet sets this from the tenant's SLO class)
+        self.retry_budget = retry_budget
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -186,8 +396,10 @@ class ContinuousBatcher(Logger):
             self._m_state.set(_STATE_CODE[_CLOSED])
             _metrics.serving_queue_age_seconds(obs_id).set_function(
                 self.oldest_age_s)
-        self._pending: deque[Request] = deque()
+        self._pending = PriorityQueue()
         self._rows = 0
+        #: rows pending per tenant (per-tenant queue bounds)
+        self._tenant_rows: dict[str, int] = {}
         self._cond = threading.Condition()
         self._stop = False
         self._flush_now = False
@@ -213,15 +425,42 @@ class ContinuousBatcher(Logger):
     def breaker_state(self) -> str:
         return self._state
 
+    def tenant_rows(self, tenant: str) -> int:
+        """Rows currently pending for one tenant (telemetry)."""
+        return self._tenant_rows.get(tenant, 0)
+
     def oldest_age_s(self) -> float:
-        """Age of the oldest pending request (0 when idle)."""
-        pending = self._pending
-        if not pending:
-            return 0.0
+        """Age of the oldest pending request across all priority
+        classes (0 when idle; telemetry — the breaker's stall-trip
+        watches the highest-priority head instead, see
+        :meth:`_breaker_tick`)."""
         try:
-            return max(0.0, time.monotonic() - pending[0].t_submit)
-        except IndexError:  # drained between the check and the peek
+            oldest = self._pending.oldest_t()
+        except RuntimeError:  # classes dict mutated mid-iteration
             return 0.0
+        if oldest is None:
+            return 0.0
+        return max(0.0, time.monotonic() - oldest)
+
+    # -- row accounting (call under _cond) ------------------------------
+    def _account_add(self, req: Request) -> None:
+        self._rows += req.n
+        if req.tenant is not None:
+            self._tenant_rows[req.tenant] = \
+                self._tenant_rows.get(req.tenant, 0) + req.n
+        if self._queue_gauge is not None:
+            self._queue_gauge.set(self._rows)
+
+    def _account_remove(self, req: Request) -> None:
+        self._rows -= req.n
+        if req.tenant is not None:
+            left = self._tenant_rows.get(req.tenant, 0) - req.n
+            if left > 0:
+                self._tenant_rows[req.tenant] = left
+            else:
+                self._tenant_rows.pop(req.tenant, None)
+        if self._queue_gauge is not None:
+            self._queue_gauge.set(self._rows)
 
     # ------------------------------------------------------------------
     # circuit breaker (call under _cond)
@@ -251,15 +490,21 @@ class ContinuousBatcher(Logger):
 
     def _breaker_tick(self, now: float) -> None:
         """Open → half-open after the cooldown; age-trip when the
-        oldest pending request exceeds the stall threshold."""
+        HIGHEST-priority pending head exceeds the stall threshold.
+        The stall-trip exists to detect a wedged dispatch path: under
+        priority scheduling a starved low class ages unboundedly while
+        the device is perfectly healthy, so only the head that would
+        dispatch next is evidence of a stall — a starved class is
+        handled by its own deadlines, bounds and preemption."""
         if self._state == _OPEN \
                 and now - self._opened_at >= self.breaker_cooldown:
             self._transition(_HALF_OPEN)
+        head = self._pending.peek()
         if (self._state == _CLOSED and self.max_queue_age is not None
-                and self._pending
-                and now - self._pending[0].t_submit > self.max_queue_age):
-            self._trip(f"oldest request pending "
-                       f"{now - self._pending[0].t_submit:.1f}s "
+                and head is not None
+                and now - head.t_submit > self.max_queue_age):
+            self._trip(f"next-dispatch request pending "
+                       f"{now - head.t_submit:.1f}s "
                        f"(> {self.max_queue_age:.1f}s)")
 
     def _record_outcome(self, ok: bool) -> None:
@@ -279,14 +524,24 @@ class ContinuousBatcher(Logger):
 
     # ------------------------------------------------------------------
     def submit(self, x: np.ndarray,
-               deadline_ms: float | None = None) -> Future:
+               deadline_ms: float | None = None, *,
+               tenant: str | None = None, priority: int = 0,
+               retry_budget: int | None = None,
+               tenant_max_rows: int | None = None) -> Future:
         """Enqueue a request; returns the future of its output rows.
 
-        Raises :class:`QueueFull` when the bounded queue has no room,
-        :class:`Overloaded` while the breaker sheds load,
+        ``priority`` (smaller = more important) selects the priority
+        class; ``tenant`` labels the rows for per-tenant bounds
+        (``tenant_max_rows`` caps THIS tenant's pending rows);
+        ``retry_budget`` overrides the engine default per request.
+
+        Raises :class:`QueueFull` when the bounded queue has no room
+        (after preempting strictly lower-priority rows if that frees
+        enough), :class:`Overloaded` while the breaker sheds load,
         :class:`DeadlineExceeded` for a non-positive deadline, and
         ``RuntimeError`` after shutdown."""
-        req = Request(x, deadline_ms=deadline_ms)
+        req = Request(x, deadline_ms=deadline_ms, tenant=tenant,
+                      priority=priority, retry_budget=retry_budget)
         if req.n < 1 or req.n > self.max_batch:
             raise ValueError(
                 f"request of {req.n} rows outside 1..{self.max_batch} "
@@ -294,6 +549,7 @@ class ContinuousBatcher(Logger):
         if deadline_ms is not None and deadline_ms <= 0:
             raise DeadlineExceeded(
                 f"deadline_ms={deadline_ms} already expired at submit")
+        preempted: list[Request] = []
         with self._cond:
             if self._stop:
                 raise RuntimeError("batcher is shut down")
@@ -306,15 +562,43 @@ class ContinuousBatcher(Logger):
                 raise Overloaded(
                     "circuit breaker open — load shed (retry after "
                     f"{self.breaker_cooldown * 1e3:.0f}ms)")
-            if self._rows + req.n > self.max_queue:
+            if tenant_max_rows is not None and tenant is not None \
+                    and self.tenant_rows(tenant) + req.n \
+                    > int(tenant_max_rows):
                 raise QueueFull(
-                    f"serving queue full ({self._rows} rows pending, "
-                    f"limit {self.max_queue})")
+                    f"tenant '{tenant}' queue bound reached "
+                    f"({self.tenant_rows(tenant)} rows pending, "
+                    f"limit {tenant_max_rows})")
+            if self._rows + req.n > self.max_queue:
+                # preemptive admission: shed the NEWEST strictly
+                # lower-priority rows when that fully makes room — a
+                # flooding class absorbs its own overload instead of
+                # bouncing higher-priority traffic
+                need = self._rows + req.n - self.max_queue
+                if self._pending.rows_below(req.priority) >= need:
+                    preempted = self._pending.evict_below(req.priority,
+                                                          need)
+                    for ev in preempted:
+                        self._account_remove(ev)
+                        self.shed_total += 1
+                        if self._obs_id:
+                            _metrics.serving_requests(
+                                self._obs_id, "shed").inc()
+                else:
+                    raise QueueFull(
+                        f"serving queue full ({self._rows} rows "
+                        f"pending, limit {self.max_queue})")
             self._pending.append(req)
-            self._rows += req.n
-            if self._queue_gauge is not None:
-                self._queue_gauge.set(self._rows)
+            self._account_add(req)
             self._cond.notify_all()
+        # fail preempted futures OUTSIDE the lock: done-callbacks (the
+        # fleet's per-tenant outcome accounting) must never run under
+        # the batcher condition
+        for ev in preempted:
+            if not ev.future.done():
+                ev.future.set_exception(Overloaded(
+                    "preempted by higher-priority traffic while the "
+                    "queue was full"))
         return req.future
 
     def flush(self) -> None:
@@ -338,29 +622,24 @@ class ContinuousBatcher(Logger):
         never occupies bucket rows.  Call under ``_cond``."""
         if not any(r.deadline is not None for r in self._pending):
             return
-        keep: deque[Request] = deque()
-        for req in self._pending:
-            if req.expired(now):
-                self._rows -= req.n
-                self.expired_total += 1
-                if self._obs_id:
-                    _metrics.serving_requests(self._obs_id,
-                                              "expired").inc()
-                req.future.set_exception(DeadlineExceeded(
-                    f"deadline passed after "
-                    f"{(now - req.t_submit) * 1e3:.0f}ms in queue"))
-            else:
-                keep.append(req)
-        if len(keep) != len(self._pending):
-            self._pending = keep
-            if self._queue_gauge is not None:
-                self._queue_gauge.set(self._rows)
+        expired = self._pending.sweep(lambda r: r.expired(now))
+        for req in expired:
+            self._account_remove(req)
+            self.expired_total += 1
+            if self._obs_id:
+                _metrics.serving_requests(self._obs_id,
+                                          "expired").inc()
+            req.future.set_exception(DeadlineExceeded(
+                f"deadline passed after "
+                f"{(now - req.t_submit) * 1e3:.0f}ms in queue"))
 
     def _wait_timeout(self, now: float) -> float:
         """How long the admission wait may sleep: bounded by the
         window remainder, the nearest pending deadline, and a 250 ms
         housekeeping tick (age-trip + eviction responsiveness)."""
-        remain = self._pending[0].t_submit + self.max_delay - now
+        oldest = self._pending.oldest_t()
+        remain = (oldest if oldest is not None else now) \
+            + self.max_delay - now
         deadlines = [r.deadline for r in self._pending
                      if r.deadline is not None]
         if deadlines:
@@ -395,14 +674,18 @@ class ContinuousBatcher(Logger):
                 self._evict_expired(time.monotonic())
                 batch: list[Request] = []
                 rows = 0
-                while (self._pending
-                       and rows + self._pending[0].n <= self.max_batch):
+                while self._pending:
+                    # strict priority order: the highest class's FIFO
+                    # prefix fills the bucket first; stop at the first
+                    # head that does not fit (no head-of-line skip —
+                    # per-class ordering holds)
+                    nxt = self._pending.peek()
+                    if rows + nxt.n > self.max_batch:
+                        break
                     req = self._pending.popleft()
                     rows += req.n
                     batch.append(req)
-                self._rows -= rows
-                if self._queue_gauge is not None:
-                    self._queue_gauge.set(self._rows)
+                    self._account_remove(req)
                 self._flush_now = False
                 self._cond.notify_all()
             if not batch:  # everything expired / spurious wakeup
@@ -423,13 +706,17 @@ class ContinuousBatcher(Logger):
 
     def _dispatch_failed(self, batch: list[Request], exc) -> None:
         """Retry-budget accounting: requests with budget left re-enter
-        the queue FRONT (order preserved); the rest fail.  During
-        shutdown nothing retries — the drain must terminate."""
+        the FRONT of their own priority class (order preserved); the
+        rest fail.  A per-request ``retry_budget`` (the fleet's
+        per-tenant SLO) overrides the engine default.  During shutdown
+        nothing retries — the drain must terminate."""
         retry: list[Request] = []
         now = time.monotonic()
         with self._cond:
             for req in batch:
-                if (not self._stop and req.attempts < self.retry_budget
+                budget = (req.retry_budget if req.retry_budget
+                          is not None else self.retry_budget)
+                if (not self._stop and req.attempts < budget
                         and not req.expired(now)):
                     req.attempts += 1
                     retry.append(req)
@@ -438,10 +725,9 @@ class ContinuousBatcher(Logger):
                 if self._obs_id:
                     _metrics.serving_requests(
                         self._obs_id, "retried").inc(len(retry))
-                self._pending.extendleft(reversed(retry))
-                self._rows += sum(r.n for r in retry)
-                if self._queue_gauge is not None:
-                    self._queue_gauge.set(self._rows)
+                self._pending.requeue_front(retry)
+                for req in retry:
+                    self._account_add(req)
                 self._cond.notify_all()
         failed = [r for r in batch if r not in retry]
         if failed:

@@ -116,7 +116,8 @@ from znicz_tpu.observe import tracing as _tracing
 from znicz_tpu.resilience import faults as _faults
 from znicz_tpu.serving.batcher import (_CLOSED, _HALF_OPEN, _OPEN,
                                        _STATE_CODE, DeadlineExceeded,
-                                       Overloaded, QueueFull)
+                                       Overloaded, PriorityQueue,
+                                       QueueFull)
 from znicz_tpu.serving.buckets import bucket_for, ladder, next_pow2
 from znicz_tpu.utils.logger import Logger
 
@@ -1378,10 +1379,11 @@ class _PromptReq:
     admit (round-13 documented noise band, fixed in round 15)."""
 
     __slots__ = ("tokens", "n", "max_new", "future", "t_submit",
-                 "deadline", "pause_s", "charged")
+                 "deadline", "pause_s", "charged", "tenant", "priority")
 
     def __init__(self, tokens: np.ndarray, max_new: int,
-                 deadline_ms: float | None) -> None:
+                 deadline_ms: float | None,
+                 tenant: str | None = None, priority: int = 0) -> None:
         self.tokens = tokens
         self.n = int(tokens.shape[0])
         self.max_new = int(max_new)
@@ -1389,6 +1391,8 @@ class _PromptReq:
         self.t_submit = time.monotonic()
         self.pause_s = 0.0
         self.charged = 0  # tokens held against the admission budget
+        self.tenant = tenant
+        self.priority = int(priority)
         self.deadline = (None if deadline_ms is None
                          else self.t_submit + float(deadline_ms) / 1e3)
 
@@ -1593,7 +1597,10 @@ class DecodeEngine(Logger):
         # exact-value windows for dashboard percentiles
         self._ttft_win: deque = deque(maxlen=4096)
         self._token_win: deque = deque(maxlen=4096)
-        self._pending: deque[_PromptReq] = deque()
+        #: queued prompts in priority classes (round 16): the fleet's
+        #: high-priority tenants reach a KV slot before any flooded
+        #: low class, FIFO within a class
+        self._pending = PriorityQueue()
         self._live: list[_Live] = []
         self._cond = threading.Condition()
         self._stop = False
@@ -1679,13 +1686,18 @@ class DecodeEngine(Logger):
     # request path
     # ------------------------------------------------------------------
     def submit(self, prompt, *, max_new_tokens: int | None = None,
-               deadline_ms: float | None = None) -> Future:
+               deadline_ms: float | None = None,
+               tenant: str | None = None, priority: int = 0) -> Future:
         """Enqueue a prompt (1-D array of token ids); returns a future
         of the generated ids (np.int32, the first sampled token
         onward).  Raises :class:`QueueFull` under backpressure,
         :class:`Overloaded` while the breaker sheds, and the future
         fails with :class:`DeadlineExceeded` if ``deadline_ms`` passes
-        before the first token (TTFT deadline)."""
+        before the first token (TTFT deadline).  ``tenant`` /
+        ``priority`` (round 16): queued prompts admit to KV slots in
+        strict priority order, and a token-budget-full queue sheds the
+        NEWEST strictly lower-priority queued prompts to make room for
+        a higher-priority arrival."""
         if not self._started:
             raise RuntimeError("engine not started — call start()")
         prompt = np.asarray(np.round(np.asarray(prompt, np.float64)),
@@ -1701,7 +1713,8 @@ class DecodeEngine(Logger):
                 f"deadline_ms={deadline_ms} already expired at submit")
         req = _PromptReq(prompt,
                          max_new_tokens or self.max_new_tokens,
-                         deadline_ms)
+                         deadline_ms, tenant=tenant, priority=priority)
+        preempted: list[_PromptReq] = []
         with self._cond:
             if self._stop:
                 raise RuntimeError("engine is shut down")
@@ -1726,17 +1739,55 @@ class DecodeEngine(Logger):
                 # capacity is tokens
                 want = req.n + req.max_new
                 if not self._token_budget.try_acquire(want):
-                    self._m_rejected.inc()
-                    raise QueueFull(
-                        f"decode token budget full "
-                        f"({self._token_budget.used} of "
-                        f"{self._token_budget.capacity} tokens held; "
-                        f"request wants {want})")
+                    # preemptive admission (round 16): shed queued
+                    # prompts of strictly LOWER priority, newest
+                    # first, when that frees enough budget — the
+                    # flooding class absorbs its own overload
+                    preempted = self._make_budget_room(req, want)
+                    if not self._token_budget.try_acquire(want):
+                        self._m_rejected.inc()
+                        raise QueueFull(
+                            f"decode token budget full "
+                            f"({self._token_budget.used} of "
+                            f"{self._token_budget.capacity} tokens "
+                            f"held; request wants {want})")
                 req.charged = want
             self._pending.append(req)
             self._cond.notify_all()
+        for victim in preempted:  # fail outside the condition
+            if not victim.future.done():
+                victim.future.set_exception(Overloaded(
+                    "preempted by higher-priority traffic while the "
+                    "decode token budget was full"))
         self._m_submitted.inc()
         return req.future
+
+    def _make_budget_room(self, req: _PromptReq,
+                          want: int) -> list[_PromptReq]:
+        """Evict queued (never live) strictly lower-priority prompts,
+        newest first, until ``want`` tokens could be acquired; returns
+        the victims (their futures are failed by the caller outside
+        the lock).  Call under ``_cond``."""
+        victims: list[_PromptReq] = []
+        if self._token_budget is None:
+            return victims
+        evictable = sorted(
+            (r for r in self._pending
+             if r.priority > req.priority and r.charged),
+            key=lambda r: r.t_submit, reverse=True)
+        if sum(r.charged for r in evictable) \
+                + self._token_budget.available < want:
+            return victims  # preemption cannot make room — shed req
+        for victim in evictable:
+            if self._token_budget.available >= want:
+                break
+            victims.append(victim)
+            self._refund(victim)
+            self.shed_total += 1
+            _metrics.serving_requests(self._obs_id, "shed").inc()
+        removed = set(map(id, victims))
+        self._pending.sweep(lambda r: id(r) in removed)
+        return victims
 
     def _refund(self, req: _PromptReq) -> None:
         if req.charged and self._token_budget is not None:
@@ -1938,20 +1989,15 @@ class DecodeEngine(Logger):
             return  # admission paused: nobody's clock is running
         if not any(r.deadline is not None for r in self._pending):
             return
-        keep: deque[_PromptReq] = deque()
-        for req in self._pending:
-            if req.expired(now):
-                self.expired_total += 1
-                _metrics.serving_requests(self._obs_id,
-                                          "expired").inc()
-                self._refund(req)
-                req.future.set_exception(DeadlineExceeded(
-                    f"TTFT deadline passed after "
-                    f"{(now - req.t_submit - req.pause_s) * 1e3:.0f}ms "
-                    f"admission-eligible in queue"))
-            else:
-                keep.append(req)
-        self._pending = keep
+        for req in self._pending.sweep(lambda r: r.expired(now)):
+            self.expired_total += 1
+            _metrics.serving_requests(self._obs_id,
+                                      "expired").inc()
+            self._refund(req)
+            req.future.set_exception(DeadlineExceeded(
+                f"TTFT deadline passed after "
+                f"{(now - req.t_submit - req.pause_s) * 1e3:.0f}ms "
+                f"admission-eligible in queue"))
 
     def _chaos(self) -> None:
         spike = _faults.fire("serving.latency_spike")
@@ -2093,6 +2139,10 @@ class DecodeEngine(Logger):
                                self.model.cache)
         token = self._sample(logits)
         ttft = time.monotonic() - req.t_submit - req.pause_s
+        # stamp TTFT onto the future: the fleet's per-tenant latency
+        # observes generation requests at TTFT (the admission-bound
+        # SLO — completion time is work-proportional, round-12 split)
+        req.future.ttft_s = ttft
         self._m_ttft.observe(ttft)
         self._ttft_win.append(ttft)
         self._m_tok_prompt.inc(req.n)
@@ -2410,16 +2460,17 @@ class DecodeEngine(Logger):
             requeue = self._admit_many(admit)
             if requeue:
                 with self._cond:
-                    self._pending.extendleft(reversed(requeue))
+                    self._pending.requeue_front(requeue)
                     if self._live or self._swap_req is not None:
                         # token-capacity overload: a young backlog
                         # just waits for draining lanes to release
                         # pages; a STALLED one (head older than
                         # max_queue_age) sheds new prompts through
                         # the breaker until capacity returns
+                        blocked = self._pending.peek()
                         head_age = (time.monotonic()
-                                    - self._pending[0].t_submit
-                                    - self._pending[0].pause_s)
+                                    - blocked.t_submit
+                                    - blocked.pause_s)
                         if self._state == _CLOSED \
                                 and head_age > self.max_queue_age:
                             self.warning(
@@ -2531,6 +2582,11 @@ class DecodeEngine(Logger):
                 "expired": self.expired_total,
                 "shed": self.shed_total,
             },
+            "token_budget": ({
+                "capacity": self._token_budget.capacity,
+                "used": self._token_budget.used,
+                "over_released": self._token_budget.over_released,
+            } if self._token_budget is not None else None),
         }
         return out
 

@@ -269,14 +269,21 @@ class ServingEngine(Logger):
     # request path
     # ------------------------------------------------------------------
     def submit(self, x: np.ndarray,
-               deadline_ms: float | None = None) -> Future:
+               deadline_ms: float | None = None, *,
+               tenant: str | None = None, priority: int = 0,
+               retry_budget: int | None = None,
+               tenant_max_rows: int | None = None) -> Future:
         """Enqueue a request (``x``: batch of samples, 1..max_batch
         rows); returns a future of the output rows.  Raises
         :class:`QueueFull` under backpressure and :class:`Overloaded`
         while the breaker sheds load.  With ``deadline_ms`` the future
         fails fast with :class:`DeadlineExceeded` if the request is
         still queued when the deadline passes — its rows are evicted
-        before dispatch and never reach a program."""
+        before dispatch and never reach a program.  ``tenant`` /
+        ``priority`` / ``retry_budget`` / ``tenant_max_rows`` are the
+        round-16 tenancy knobs (see
+        :class:`~znicz_tpu.serving.batcher.ContinuousBatcher` — the
+        fleet passes them from the tenant's SLO class)."""
         if self._batcher is None:
             raise RuntimeError("engine not started — call start()")
         x = np.ascontiguousarray(x, dtype=self.model.serve_dtype)
@@ -285,7 +292,10 @@ class ServingEngine(Logger):
                 f"input sample shape {x.shape[1:]} != exported "
                 f"{self.model.input_shape}")
         try:
-            future = self._batcher.submit(x, deadline_ms=deadline_ms)
+            future = self._batcher.submit(
+                x, deadline_ms=deadline_ms, tenant=tenant,
+                priority=priority, retry_budget=retry_budget,
+                tenant_max_rows=tenant_max_rows)
         except QueueFull:  # includes Overloaded load shedding
             self._m_rejected.inc()
             raise

@@ -11,13 +11,18 @@ environment): ``/`` is a self-refreshing HTML page, ``/status.json``
 the machine-readable feed, ``/metrics`` the Prometheus text
 exposition of the process-global :mod:`znicz_tpu.observe` registry
 (compile counts, per-unit run-time histograms, transfer bytes,
-serving latency — everything train + serve register), and
-``/trace.json`` a live Chrome-trace/Perfetto dump of the host-span
-ring buffer (open it in ``ui.perfetto.dev``), and — round 11 —
-``/healthz`` (liveness, always 200) + ``/readyz`` (readiness fed from
-the registry: circuit-breaker state, serving queue age, last-step
-staleness; 503 while any engine sheds load) so external supervisors
-can probe both the training and the serving engine.
+serving latency — everything train + serve register; since round 16
+one process typically hosts a FLEET, so ``/metrics`` aggregates N
+serving/decode engines under per-engine labels plus the per-tenant
+fleet series), and ``/trace.json`` a live Chrome-trace/Perfetto dump
+of the host-span ring buffer (open it in ``ui.perfetto.dev``), and —
+round 11 — ``/healthz`` (liveness, always 200) + ``/readyz``
+(readiness fed from the registry: circuit-breaker state per engine,
+serving queue age, last-step staleness; 503 while any ENGINE sheds
+load — a fleet tenant's own breaker opening is NOT an engine outage:
+it sheds exactly that tenant and is reported per tenant, never
+flipping the process probe) so external supervisors can probe
+training and every resident serving engine at once.
 """
 
 from __future__ import annotations
@@ -33,9 +38,12 @@ from znicz_tpu.utils.logger import Logger
 
 def gather_status(workflow) -> dict:
     """One workflow's live status snapshot (scalars only — safe to
-    read from the serving thread while training runs).  A registered
-    :class:`znicz_tpu.serving.ServingEngine` reports its own snapshot
-    (bucket occupancy, latency percentiles, queue depth) through the
+    read from the serving thread while training runs).  Anything with
+    a ``serving_status`` hook — a
+    :class:`znicz_tpu.serving.ServingEngine`, a
+    :class:`~znicz_tpu.serving.DecodeEngine`, or a whole
+    :class:`~znicz_tpu.serving.FleetEngine` (per-tenant SLO state,
+    models, replica groups) — reports its own snapshot through the
     same feed."""
     if hasattr(workflow, "serving_status"):
         return workflow.serving_status()
@@ -172,8 +180,14 @@ class WebStatusServer(Logger):
         """/readyz body, fed from the observe REGISTRY (so it reflects
         exactly what ``/metrics`` exports, not object state):
 
-        - ``znicz_serving_breaker_state`` — any engine with an OPEN
-          breaker (2) makes the process not-ready (it is shedding);
+        - ``znicz_serving_breaker_state`` — any ENGINE with an OPEN
+          breaker (2) makes the process not-ready (it is shedding
+          every caller);
+        - ``znicz_fleet_breaker_state`` (round 16) — per-TENANT fleet
+          breakers are reported under ``tenants`` but are
+          REPORT-ONLY: an open tenant breaker sheds exactly that
+          tenant while every other tenant is served normally, so it
+          must not flip a supervisor's routing decision;
         - ``znicz_serving_queue_age_seconds`` — reported per engine;
           not-ready when it exceeds ``engine.ready_max_queue_age_s``
           (default unset = report-only);
@@ -211,6 +225,14 @@ class WebStatusServer(Logger):
                 out["engines"].setdefault(engine, {})["breaker"] = state
                 if state == "open":
                     not_ready(f"breaker open on engine {engine}")
+        fam = metrics.REGISTRY.get("znicz_fleet_breaker_state")
+        if fam is not None:
+            out["tenants"] = {}
+            for key, child in fam.items():
+                fleet, tenant = key
+                state = {0: "closed", 1: "half_open",
+                         2: "open"}.get(int(child.value), "?")
+                out["tenants"][f"{fleet}/{tenant}"] = state
         fam = metrics.REGISTRY.get("znicz_serving_queue_age_seconds")
         max_age = root.common.engine.get("ready_max_queue_age_s", None)
         if fam is not None:
