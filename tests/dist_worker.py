@@ -22,7 +22,7 @@ import sys
 
 
 def build_workflow(tp_dir: "str | None" = None, learning_rate=0.1,
-                   max_epochs=3):
+                   max_epochs=3, tp: "bool | None" = None):
     """Tiny blob-classification MLP, mirroring the layer/optimizer
     config of ``tests/test_parallel.build``.  The data generator is
     duplicated here on purpose: importing ``tests.conftest`` (where
@@ -38,6 +38,7 @@ def build_workflow(tp_dir: "str | None" = None, learning_rate=0.1,
     from znicz_tpu.loader.fullbatch import ArrayLoader
     from znicz_tpu.models.standard_workflow import StandardWorkflow
 
+    tp = (tp_dir is not None) if tp is None else tp
     n_classes, dim, per_class = 3, 12, 40
     rnd = np.random.RandomState(7)
     centers = rnd.uniform(-4.0, 4.0, size=(n_classes, dim))
@@ -58,12 +59,12 @@ def build_workflow(tp_dir: "str | None" = None, learning_rate=0.1,
         layers=[
             {"type": "all2all_tanh",
              "->": {"output_sample_shape": 16,
-                    "model_parallel": "column" if tp_dir else None},
+                    "model_parallel": "column" if tp else None},
              "<-": {"learning_rate": learning_rate,
                     "gradient_moment": 0.9}},
             {"type": "all2all_tanh",
              "->": {"output_sample_shape": 12,
-                    "model_parallel": "row" if tp_dir else None},
+                    "model_parallel": "row" if tp else None},
              "<-": {"learning_rate": learning_rate,
                     "gradient_moment": 0.9}},
             {"type": "softmax", "->": {"output_sample_shape": n_classes},
@@ -90,6 +91,116 @@ def build_ring_workflow():
         seq_parallel=True, n_heads=2, seq_len=12, features=8,
         n_train=72, n_valid=24, minibatch_size=24, max_epochs=10,
         learning_rate=0.05)
+
+
+def run_partition(shard_dir: str) -> dict:
+    """Round 17: the declarative partition table under REAL
+    multi-process SPMD — a TP (column+row) + ZeRO-1 net and a
+    streaming-loader net with per-host 1/N reads, both placed
+    entirely through the rule engine.  The digest carries the table
+    dump and the resolved specs so the parent can assert every
+    process resolved the IDENTICAL table (multi-host bring-up is a
+    lookup, not a rewrite), plus warmed-step compile counts and the
+    trained state for the single-process loss-parity check."""
+    import jax
+    import numpy as np
+
+    from znicz_tpu.loader.streaming import StreamingLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.observe import metrics as obs_metrics
+    from znicz_tpu.utils import prng
+
+    launcher = _partition_launcher
+    wf_tp = build_workflow(tp=True, max_epochs=3)
+    wf_tp.initialize(device=launcher.make_device())
+    wf_tp.run()
+    table = wf_tp.partition
+    region_unit = wf_tp._region_unit
+    compiles = obs_metrics.xla_compiles(f"region:{region_unit.name}")
+    before = compiles.value
+    wf_tp.loader.run()
+    region_unit.run()
+    warmed_delta = compiles.value - before
+    wf_tp.forwards[0].weights.map_read()
+    wf_tp.forwards[1].weights.map_read()
+
+    # streaming net: per-host 1/N reads through put_local_batch
+    prng.seed_all(4321)
+    stream_wf = StandardWorkflow(
+        name="dist_stream",
+        loader_factory=lambda w: StreamingLoader(
+            w, shard_dir, minibatch_size=16, prefetch_depth=2,
+            normalization_scale=2.0 / 255.0, normalization_bias=-1.0),
+        layers=[
+            {"type": "all2all_tanh",
+             "->": {"output_sample_shape": 16, "weights_filling": "he"},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "softmax",
+             "->": {"output_sample_shape": 4, "weights_filling": "he"},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        ],
+        decision_config={"max_epochs": 6})
+    stream_wf._max_fires = 10 ** 6
+    stream_wf.initialize(device=launcher.device)
+    loader = stream_wf.loader
+    loader.warmup()
+    # content proof for the per-host 1/N reads at a PINNED schedule
+    # point (the first delivered batch): each host uploaded only its
+    # local rows through put_local_batch; the assembled global batch
+    # must be row-for-row identical to what one process reads whole.
+    # Lockstep collective read (every process executes this).
+    loader.run()
+    first = np.asarray(launcher.device.get(
+        loader.minibatch_raw._devmem), dtype=np.float64)
+    first_labels = np.asarray(launcher.device.get(
+        loader.minibatch_labels._devmem))
+    stream_batch_rows = [float(r) for r in
+                         first.reshape(first.shape[0], -1).sum(axis=1)]
+    stream_batch_labels = [int(x) for x in first_labels]
+    stream_wf.run()
+    stream_region = stream_wf._region_unit
+    scompiles = obs_metrics.xla_compiles(f"region:{stream_region.name}")
+    sbefore = scompiles.value
+    loader.run()
+    stream_region.run()
+    warmed_stream_delta = scompiles.value - sbefore
+    stream_wf.forwards[0].weights.map_read()
+    stream_wf.stop()
+
+    col = wf_tp.forwards[0]
+    return {
+        "partition_table": table.dump(),
+        "resolved_specs": {path: str(tuple(res.spec))
+                           for path, res in sorted(table.leaves.items())},
+        "col_weights_spec": str(tuple(
+            table.leaves[f"{col.name}/weights"].spec)),
+        "zero1_engaged": all(g._zero1 for g in wf_tp.gds
+                             if g.weights is not None and g.weights),
+        "warmed_step_compiles": int(warmed_delta),
+        "warmed_stream_compiles": int(warmed_stream_delta),
+        "w0_sum": float(wf_tp.forwards[0].weights.mem.sum()),
+        "w1_sum": float(wf_tp.forwards[1].weights.mem.sum()),
+        "w0_l2": float((wf_tp.forwards[0].weights.mem ** 2).sum()),
+        "w1_l2": float((wf_tp.forwards[1].weights.mem ** 2).sum()),
+        "min_validation_n_err": int(wf_tp.decision.min_validation_n_err),
+        "stream_w_sum": float(stream_wf.forwards[0].weights.mem.sum()),
+        "stream_w_l2": float(
+            (np.asarray(stream_wf.forwards[0].weights.mem,
+                        dtype=np.float64) ** 2).sum()),
+        "stream_batch_rows": stream_batch_rows,
+        "stream_batch_labels": stream_batch_labels,
+        "stream_final_loss": [None if x is None else float(x)
+                              for x in stream_wf.decision.epoch_loss],
+        "stream_local_batch": int(loader.local_batch),
+        "stream_prefetch_hits": int(loader.prefetch_hits),
+        "stream_min_valid_n_err": int(
+            stream_wf.decision.min_validation_n_err),
+        "n_processes": jax.process_count(),
+    }
+
+
+#: launcher handle for run_partition (set by main before dispatch)
+_partition_launcher = None
 
 
 def run_genetics() -> dict:
@@ -139,20 +250,38 @@ def main() -> None:
     mode_arg = sys.argv[5] if len(sys.argv) > 5 else None
     ring_mode = mode_arg == "ring"
     shard_mode = mode_arg in ("genetics", "ensemble")
-    tp_dir = None if (mode_arg is None or ring_mode or shard_mode) \
-        else mode_arg
+    partition_mode = mode_arg == "partition"
+    tp_dir = None if (mode_arg is None or ring_mode or shard_mode
+                      or partition_mode) else mode_arg
 
-    # 2 virtual CPU devices per process, configured BEFORE any jax use
-    # (the container's sitecustomize already imported jax, so go
+    # a fixed 4-device GLOBAL mesh split over however many processes
+    # run (2 per process for the 2-proc smoke, all 4 for the
+    # single-process loss-parity reference), configured BEFORE any jax
+    # use (the container's sitecustomize already imported jax, so go
     # through jax.config like tests/conftest.py does).
+    devices_per_proc = 4 // n_processes if partition_mode else 2
+    import os
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # read at backend init (post-import, pre-first-use) — the
+        # fallback for jax versions without the config option below
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+                    f"{devices_per_proc}").strip()
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 2)
+    try:
+        jax.config.update("jax_num_cpu_devices", devices_per_proc)
+    except AttributeError:  # older jax: XLA_FLAGS above covers it
+        pass
+    # (jax_cpu_collectives_implementation=gloo is set by
+    # parallel.distributed.ensure_initialized during the Launcher's
+    # bootstrap — cross-process CPU computations fail without it)
 
     from znicz_tpu.launcher import Launcher
     from znicz_tpu.utils import prng
 
-    n_model = 2 if (tp_dir or ring_mode) else 1
+    n_model = 2 if (tp_dir or ring_mode or partition_mode) else 1
     if process_id == 0:
         launcher = Launcher(listen=coordinator, n_processes=n_processes,
                             n_model=n_model)
@@ -161,9 +290,23 @@ def main() -> None:
                             process_id=process_id, n_model=n_model)
     assert launcher.mode == ("master" if process_id == 0 else "slave")
     assert jax.process_count() == n_processes
-    assert len(jax.devices()) == 2 * n_processes
+    assert len(jax.devices()) == devices_per_proc * n_processes
 
     prng.seed_all(1234)
+
+    if partition_mode:
+        global _partition_launcher
+        _partition_launcher = launcher
+        digest = run_partition(sys.argv[6])
+        digest.update({
+            "process_id": process_id,
+            "mode": launcher.mode,
+            "n_global_devices": len(jax.devices()),
+        })
+        with open(out_path, "w") as fh:
+            json.dump(digest, fh)
+        print(f"worker {process_id}: OK partition", flush=True)
+        return
 
     if shard_mode:
         digest = (run_genetics() if mode_arg == "genetics"

@@ -38,8 +38,9 @@ def _free_port() -> int:
 
 
 def _run_workers(tmp_path, extra_args=(),
-                 agree_keys=AGREE_KEYS) -> list[dict]:
-    """Spawn the 2-process worker harness and return both digests
+                 agree_keys=AGREE_KEYS,
+                 n_processes=N_PROCESSES) -> list[dict]:
+    """Spawn the n-process worker harness and return all digests
     (one launch/communicate/assert implementation for every mode)."""
     coordinator = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
@@ -49,11 +50,11 @@ def _run_workers(tmp_path, extra_args=(),
     env.pop("XLA_FLAGS", None)
 
     procs, outs = [], []
-    for pid in range(N_PROCESSES):
+    for pid in range(n_processes):
         out = tmp_path / f"digest_{pid}.json"
         outs.append(out)
         procs.append(subprocess.Popen(
-            [sys.executable, WORKER, str(pid), str(N_PROCESSES),
+            [sys.executable, WORKER, str(pid), str(n_processes),
              coordinator, str(out), *extra_args],
             cwd=REPO, env=env,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
@@ -71,9 +72,10 @@ def _run_workers(tmp_path, extra_args=(),
         assert proc.returncode == 0, \
             f"worker {proc.args[2]} failed:\n{stdout[-4000:]}"
     digests = [json.loads(out.read_text()) for out in outs]
-    for key in agree_keys:
-        assert digests[0][key] == digests[1][key], \
-            f"{key}: master {digests[0][key]} != slave {digests[1][key]}"
+    for other in digests[1:]:
+        for key in agree_keys:
+            assert digests[0][key] == other[key], \
+                f"{key}: master {digests[0][key]} != slave {other[key]}"
     return digests
 
 
@@ -128,6 +130,99 @@ def test_two_process_ring_attention(tmp_path):
     # 24 validation samples, 3 classes: chance ≈ 16 errors; the
     # attention net must do clearly better through the ring gradients
     assert master["min_validation_n_err"] <= 8
+
+
+def _write_partition_shards(tmp_path):
+    """Shared on-disk shard set for the streaming half of the
+    partition smoke (written once by the parent; both worker
+    processes read their 1/N of every epoch from it)."""
+    import numpy as np
+
+    from znicz_tpu.loader.streaming import write_shards
+
+    rng = np.random.default_rng(21)
+    protos = rng.normal(0, 1, (4, 6, 6))
+    data = np.concatenate(
+        [p + 0.3 * rng.normal(size=(40, 6, 6)) for p in protos])
+    data = np.clip((data + 4.0) * 32.0, 0, 255).astype(np.uint8)
+    labels = np.repeat(np.arange(4), 40).astype(np.int32)
+    order = rng.permutation(len(data))  # class-mixed train/valid split
+    data, labels = data[order], labels[order]
+    shard_dir = tmp_path / "shards"
+    write_shards(str(shard_dir), data[:128], labels[:128],
+                 valid_data=data[128:], valid_labels=labels[128:],
+                 rows_per_shard=32)
+    return str(shard_dir)
+
+
+PARTITION_AGREE = ("w0_sum", "w1_sum", "w0_l2", "w1_l2",
+                   "min_validation_n_err", "partition_table",
+                   "resolved_specs", "col_weights_spec",
+                   "stream_w_sum", "stream_min_valid_n_err",
+                   "stream_batch_rows")
+
+
+@pytest.mark.slow
+def test_two_process_partition_rules_streaming_smoke(tmp_path):
+    """ISSUE 13's two-process CPU smoke: the dryrun-class TP+ZeRO-1
+    net and a streaming-loader run execute unmodified under 2
+    ``jax.distributed`` processes with per-host data reads; every
+    process resolves the IDENTICAL partition table; warmed steps
+    compile nothing; and the final losses/weights agree with a
+    single-process run over the same 4-device global mesh."""
+    shard_dir = _write_partition_shards(tmp_path)
+    two = _run_workers(tmp_path, extra_args=("partition", shard_dir),
+                       agree_keys=PARTITION_AGREE)
+    for digest in two:
+        # multi-host bring-up was a table LOOKUP: rules resolved, TP
+        # placement is a rule consequence, nothing recompiled warm
+        assert digest["zero1_engaged"]
+        assert digest["col_weights_spec"] == "(None, 'model')"
+        assert digest["warmed_step_compiles"] == 0
+        assert digest["warmed_stream_compiles"] == 0
+        assert digest["n_processes"] == 2
+        assert digest["n_global_devices"] == 4
+        # per-host data reads: each process stages HALF the global
+        # minibatch (16 rows over a 4-way data axis, 2 hosts)
+        assert digest["stream_local_batch"] == 8
+        assert digest["stream_prefetch_hits"] > 0
+    ref_dir = tmp_path / "ref"
+    ref_dir.mkdir()
+    ref = _run_workers(ref_dir, extra_args=("partition", shard_dir),
+                       agree_keys=(), n_processes=1)[0]
+    assert ref["n_processes"] == 1
+    assert ref["stream_local_batch"] == 16  # one host reads it all
+    # the partition TABLE is process-count independent (that is the
+    # point: pod bring-up changes nothing about placement decisions)
+    assert ref["partition_table"] == two[0]["partition_table"]
+    assert ref["resolved_specs"] == two[0]["resolved_specs"]
+    # loss/weight parity with the single-process run (same global
+    # mesh, same programs; cross-process collectives may reassociate
+    # floating-point sums, hence allclose not bitwise)
+    assert ref["min_validation_n_err"] == two[0]["min_validation_n_err"]
+    assert ref["stream_min_valid_n_err"] == \
+        two[0]["stream_min_valid_n_err"]
+    # per-host reads assemble the EXACT batch one process reads whole
+    # (same rows, same order — pure data, so the sums are identical)
+    assert two[0]["stream_batch_rows"] == \
+        pytest.approx(ref["stream_batch_rows"], rel=1e-12), \
+        (two[0]["stream_batch_rows"], ref["stream_batch_rows"])
+    for key in ("w0_sum", "w1_sum", "w0_l2", "w1_l2"):
+        assert two[0][key] == pytest.approx(ref[key], rel=1e-4), \
+            (key, two[0][key], ref[key])
+    # loss parity for the streamed run: the per-host-read data plane
+    # was proven IDENTICAL above (exact row digests), so any drift is
+    # float reassociation in the cross-process collectives amplified
+    # through 2 epochs of momentum — the LOSS (what the issue's done
+    # bar names) must agree tightly, the raw weight sums loosely
+    for got, want in zip(two[0]["stream_final_loss"],
+                         ref["stream_final_loss"]):
+        if want is not None:
+            assert got == pytest.approx(want, rel=0.02), \
+                (two[0]["stream_final_loss"], ref["stream_final_loss"])
+    for key in ("stream_w_sum", "stream_w_l2"):
+        assert two[0][key] == pytest.approx(ref[key], rel=0.15), \
+            (key, two[0][key], ref[key])
 
 
 @pytest.mark.slow

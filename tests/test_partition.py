@@ -1,0 +1,447 @@
+"""Declarative partition-rule engine (parallel.partition).
+
+Three contracts:
+
+1. **Resolution semantics** — scalars replicated, first match wins,
+   unmatched leaves hard-error, ZeRO-1 (dim, pad) and member-axis
+   divisibility are rule consequences of the logical shape.
+2. **Golden tables** — the default rule tables reproduce the
+   pre-rule attribute path's TP / ZeRO-1 / population member-axis
+   placements BITWISE on the 8-device CPU mesh: training with rules
+   ON equals training with ``engine.partition_rules = False`` (the
+   legacy attribute arm) leaf for leaf, weights and opt state.
+3. **Coverage linter** — every Vector slot the dryrun net, the
+   LM/decode export path and the population trainer allocate matches
+   exactly one rule (one override, or exactly one default when no
+   override), and no unit module hand-sets the legacy slot
+   attributes anymore (grep test).
+"""
+
+import re
+
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tests.conftest import make_blobs
+from znicz_tpu.backends import XLADevice
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.parallel import make_mesh, partition, zero1_partition
+from znicz_tpu.parallel.axis import DATA_AXIS, MODEL_AXIS
+from znicz_tpu.utils import prng
+from znicz_tpu.utils.config import root
+
+N_CLASSES, DIM = 3, 12
+
+
+# ----------------------------------------------------------------------
+# 1. resolution semantics
+# ----------------------------------------------------------------------
+def table():
+    return partition.PartitionTable("t")
+
+
+def test_scalar_short_circuits_before_rules():
+    t = table()
+    t.declare(r".*", P(DATA_AXIS))  # would be illegal for a scalar
+    for shape in ((), (1,), (1, 1)):
+        res = t.resolve("fc1/weird", shape)
+        assert tuple(res.spec) == ()
+        assert res.rule == "<scalar>"
+
+
+def test_first_match_wins_in_declaration_order():
+    t = table()
+    t.declare(r"/weights$", P(None, MODEL_AXIS))
+    t.declare(r"fc1/weights$", P(MODEL_AXIS))  # later → shadowed
+    res = t.resolve("fc1/weights", (8, 16), n_data=4)
+    assert tuple(res.spec) == (None, MODEL_AXIS)
+    assert res.model_shard_dim == 1
+
+
+def test_unmatched_leaf_is_hard_error():
+    with pytest.raises(partition.UnmatchedLeafError, match="no rule"):
+        table().resolve("fc1/definitely_not_a_slot", (4, 4))
+
+
+def test_redeclare_replaces_in_place():
+    t = table()
+    t.declare_leaf("fc1/output", P(DATA_AXIS, MODEL_AXIS))
+    t.declare_leaf("fc1/output", partition.BATCH)
+    res = t.resolve("fc1/output", (8, 16), n_data=4)
+    assert tuple(res.spec) == (DATA_AXIS, None)  # full-rank batch spec
+    assert res.model_shard_dim is None
+
+
+def test_zero1_dim_and_pad_are_rule_consequences():
+    t = table()
+    t.declare_leaf("gd/acc_grad_w", partition.Zero1(model_dim=1))
+    res = t.resolve("gd/acc_grad_w", (10, 16), n_data=8)
+    dim, pad = zero1_partition((10, 16), 8, 1)
+    assert (res.data_shard_dim, res.data_shard_pad) == (dim, pad)
+    assert res.model_shard_dim == 1
+    assert res.padded_shape()[dim] % 8 == 0
+    spec = tuple(res.spec)
+    assert spec[dim] == DATA_AXIS and spec[1] == MODEL_AXIS
+
+
+def test_member_divisibility_is_a_rule_consequence():
+    t = table()
+    t.declare_leaf("pop/fc1.weights", partition.Member(model_dim=2))
+    res = t.resolve("pop/fc1.weights", (8, 12, 16), n_data=4)
+    assert tuple(res.spec) == (DATA_AXIS, None, MODEL_AXIS)
+    assert res.member_axis
+    # an indivisible member count stays replicated on dim 0
+    res = t.resolve("pop/fc1.weights", (6, 12, 16), n_data=4)
+    assert tuple(res.spec) == (None, None, MODEL_AXIS)
+
+
+def test_member_model_dim_zero_rejected():
+    t = table()
+    t.declare_leaf("pop/x.y", partition.Member(model_dim=0))
+    with pytest.raises(partition.PartitionMismatchError,
+                       match="member axis"):
+        t.resolve("pop/x.y", (8, 4), n_data=4)
+
+
+def test_default_tail_covers_canonical_slots():
+    t = table()
+    batch = t.resolve("fc1/output", (8, 16), n_data=4)
+    assert batch.batch_major
+    assert tuple(batch.spec) == (DATA_AXIS, None)
+    repl = t.resolve("fc1/weights", (12, 16), n_data=4)
+    assert tuple(repl.spec) == ()
+
+
+def test_shard_and_gather_fns_round_trip_with_pad():
+    mesh = make_mesh(n_data=8, n_model=1)
+    device = XLADevice(mesh=mesh)
+    t = table()
+    t.declare_leaf("gd/acc_grad_w", partition.Zero1())
+
+    class _Vec:  # minimal stand-in: shape + structural flags
+        name = "gd.acc_grad_w"
+        batch_major = False
+        member_axis = False
+
+        def __init__(self, shape):
+            self.shape = shape
+
+    logical = (10, 4)
+    res = t.resolve("gd/acc_grad_w", logical, n_data=8)
+    t.leaves["gd/acc_grad_w"] = res
+    shard_fns, gather_fns = partition.make_shard_and_gather_fns(
+        t, mesh, device)
+    arr = np.arange(np.prod(logical), dtype=np.float32).reshape(logical)
+    dev = shard_fns["gd/acc_grad_w"](arr)
+    assert tuple(dev.shape) == res.padded_shape()
+    back = gather_fns["gd/acc_grad_w"](dev)
+    np.testing.assert_array_equal(back, arr)
+    del _Vec
+
+
+# ----------------------------------------------------------------------
+# 2. golden tables: rules ≡ legacy attribute path BITWISE
+# ----------------------------------------------------------------------
+def build_tp(minibatch_size=24, max_epochs=2):
+    data, labels = make_blobs(40, N_CLASSES, DIM)
+    gd_cfg = {"learning_rate": 0.1, "gradient_moment": 0.9,
+              "weights_decay": 0.0005}
+    return StandardWorkflow(
+        name="partition_tp",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:96], train_labels=labels[:96],
+            valid_data=data[96:], valid_labels=labels[96:],
+            minibatch_size=minibatch_size),
+        layers=[
+            {"type": "all2all_tanh",
+             "->": {"output_sample_shape": 16,
+                    "model_parallel": "column"}, "<-": gd_cfg},
+            {"type": "all2all_tanh",
+             "->": {"output_sample_shape": 8, "model_parallel": "row"},
+             "<-": gd_cfg},
+            {"type": "softmax", "->": {"output_sample_shape": N_CLASSES},
+             "<-": gd_cfg},
+        ],
+        decision_config={"max_epochs": max_epochs})
+
+
+def gather_state(wf):
+    """Every persistent leaf (params + momentum), host-fetched."""
+    out = {}
+    for gd in wf.gds:
+        for attr in sorted(gd.__dict__):
+            from znicz_tpu.memory import Vector
+            vec = gd.__dict__[attr]
+            if isinstance(vec, Vector) and vec \
+                    and not vec.batch_major:
+                vec.map_read()
+                out[f"{gd.name}.{attr}"] = np.array(vec.mem, copy=True)
+    for fwd in wf.forwards:
+        for name in fwd.EXPORT_PARAMS:
+            vec = getattr(fwd, name, None)
+            if vec is not None and vec:
+                vec.map_read()
+                out[f"{fwd.name}.{name}"] = np.array(vec.mem, copy=True)
+    return out
+
+
+def _run_arm(rules_on: bool, builder, mesh_kwargs, seed=1234):
+    root.common.engine.partition_rules = rules_on
+    prng.seed_all(seed)
+    wf = builder()
+    wf.initialize(device=XLADevice(mesh=make_mesh(**mesh_kwargs)))
+    wf.run()
+    return gather_state(wf), wf
+
+
+def test_golden_tp_zero1_bitwise_vs_attribute_path():
+    """TP (column+row) + ZeRO-1 momentum on the (4 data × 2 model)
+    mesh: the rule-engine arm must train BITWISE identically to the
+    legacy attribute arm — same specs ⇒ same GSPMD program ⇒ same
+    floats."""
+    mesh_kwargs = dict(n_data=4, n_model=2)
+    legacy, _ = _run_arm(False, build_tp, mesh_kwargs)
+    ruled, wf = _run_arm(True, build_tp, mesh_kwargs)
+    assert any(g._zero1 for g in wf.gds), "zero1 never engaged"
+    # the table actually decided the placements
+    assert wf.partition.leaves, "no leaves bound"
+    col = wf.forwards[0]
+    res = wf.partition.leaves[f"{col.name}/weights"]
+    assert tuple(res.spec) == (None, MODEL_AXIS)
+    assert legacy.keys() == ruled.keys()
+    for key in legacy:
+        np.testing.assert_array_equal(
+            legacy[key], ruled[key], err_msg=key)
+
+
+def test_golden_placements_match_legacy_shardings():
+    """Physical placement parity: for every leaf the device would
+    place, the rule-resolved NamedSharding equals the legacy
+    attribute-derived one (the compat layer is populated FROM the
+    table, so the legacy branch must agree when fed those attrs)."""
+    root.common.engine.partition_rules = True
+    prng.seed_all(1234)
+    wf = build_tp(max_epochs=1)
+    device = XLADevice(mesh=make_mesh(n_data=4, n_model=2))
+    wf.initialize(device=device)
+    from znicz_tpu.memory import Vector
+    checked = 0
+    for unit in wf.units:
+        for attr, vec in list(unit.__dict__.items()):
+            if not isinstance(vec, Vector) or not vec:
+                continue
+            res = getattr(vec, "_partition", None)
+            if res is None:
+                continue
+            ruled = device.sharding_for(vec)
+            vec._partition = None
+            try:
+                legacy = device.sharding_for(vec)
+            finally:
+                vec._partition = res
+            assert ruled == legacy, (res.path, ruled, legacy)
+            checked += 1
+    assert checked > 10
+
+
+def test_golden_member_axis_bitwise_vs_attribute_path():
+    """Population member-axis placements as rule consequences: a
+    K=8 stacked population step must produce bitwise-identical
+    stacked weights under both arms on the 8-device mesh."""
+    from znicz_tpu.population import PopulationTrainer
+
+    def build(seed):
+        prng.seed_all(seed)
+        data, labels = make_blobs(40, N_CLASSES, DIM)
+        return StandardWorkflow(
+            name="partition_pop",
+            loader_factory=lambda w: ArrayLoader(
+                w, train_data=data[:96], train_labels=labels[:96],
+                valid_data=data[96:], valid_labels=labels[96:],
+                minibatch_size=24),
+            layers=[
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": N_CLASSES},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            ],
+            decision_config={"max_epochs": 1})
+
+    def run_arm(rules_on):
+        root.common.engine.partition_rules = rules_on
+        prng.seed_all(1234)
+        trainer = PopulationTrainer(
+            lambda **kw: build(4321), 8, base_seed=500, evolve=None,
+            mesh=make_mesh(n_data=8, n_model=1), name="pop_golden")
+        trainer.initialize()
+        for _ in range(4):
+            trainer.region.step()
+        out = [np.array(np.asarray(sv), copy=True)
+               for sv in trainer.region.svecs]
+        shardings = [getattr(sv._devmem, "sharding", None)
+                     for sv in trainer.region.svecs]
+        return out, shardings, trainer
+
+    legacy, legacy_sh, _ = run_arm(False)
+    ruled, ruled_sh, trainer = run_arm(True)
+    member_svecs = [sv for sv in trainer.region.svecs if sv.member_axis]
+    assert member_svecs, "no member-stacked leaves"
+    # 8 members over the 8-way data axis: the member axis is sharded
+    sharded = [sv for sv in member_svecs
+               if len(sv._devmem.sharding.device_set) == 8]
+    assert sharded, "member axis never sharded over the mesh"
+    for a, b in zip(legacy, ruled):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(legacy_sh, ruled_sh):
+        assert a == b
+
+
+# ----------------------------------------------------------------------
+# 3. coverage linter
+# ----------------------------------------------------------------------
+def _assert_covered(wf):
+    t = wf.partition
+    assert t.leaves, f"{wf.name}: nothing bound through the table"
+    for path in t.leaves:
+        audit = t.audit(path)
+        assert len(audit["overrides"]) <= 1, audit
+        assert audit["overrides"] or len(audit["defaults"]) == 1, audit
+    return len(t.leaves)
+
+
+def test_linter_dryrun_net_full_coverage():
+    import __graft_entry__ as graft
+
+    root.common.engine.pallas_interpret = True
+    root.common.engine.flash_attention = True
+    root.common.engine.pallas_layer_norm = True
+    wf = graft._build_dryrun_net(8)
+    wf.initialize(device=XLADevice(mesh=make_mesh(n_data=4, n_model=2)))
+    n = _assert_covered(wf)
+    assert n >= 40  # conv/attention/LN/TP/dropout/softmax chains
+
+
+def test_linter_lm_decode_export_path_coverage(tmp_path):
+    """The LM the decode engine exports: embedding → pos_encoding →
+    causal attention → last_token → softmax, plus the exported
+    model's serving-side input staging vector."""
+    toks = np.random.default_rng(5).integers(
+        0, 12, size=(32, 8)).astype(np.int32)
+    labels = np.roll(toks[:, -1], 1).astype(np.int32) % 5
+    wf = StandardWorkflow(
+        name="partition_lm",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=toks[:24], train_labels=labels[:24],
+            valid_data=toks[24:], valid_labels=labels[24:],
+            minibatch_size=8),
+        layers=[
+            {"type": "embedding", "->": {"vocab_size": 12, "dim": 16}},
+            {"type": "pos_encoding", "->": {}},
+            {"type": "attention", "->": {"n_heads": 2, "causal": True},
+             "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+            {"type": "last_token", "->": {}},
+            {"type": "softmax", "->": {"output_sample_shape": 5},
+             "<-": {"learning_rate": 0.01, "gradient_moment": 0.9}},
+        ],
+        decision_config={"max_epochs": 1})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice(mesh=make_mesh(n_data=8)))
+    _assert_covered(wf)
+    wf.run()
+    bundle = str(tmp_path / "lm.npz")
+    wf.export_forward(bundle)
+    from znicz_tpu.export import ExportedModel
+    model = ExportedModel.load(bundle, device=XLADevice(), max_batch=4)
+    assert model is not None
+
+
+def test_linter_population_trainer_coverage():
+    from znicz_tpu.population import PopulationTrainer
+
+    def build(**kw):
+        data, labels = make_blobs(40, N_CLASSES, DIM)
+        return StandardWorkflow(
+            name="partition_pop_lint",
+            loader_factory=lambda w: ArrayLoader(
+                w, train_data=data[:96], train_labels=labels[:96],
+                valid_data=data[96:], valid_labels=labels[96:],
+                minibatch_size=24),
+            layers=[
+                {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": N_CLASSES},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            ],
+            decision_config={"max_epochs": 1})
+
+    trainer = PopulationTrainer(
+        build, 8, base_seed=500, evolve=None,
+        mesh=make_mesh(n_data=8, n_model=1), name="pop_lint")
+    trainer.initialize()
+    wf = trainer.template
+    n = _assert_covered(wf)
+    member_paths = [p for p, r in wf.partition.leaves.items()
+                    if r.member_axis]
+    assert member_paths, "no member-axis leaves in the table"
+    assert n > len(member_paths)
+
+
+def test_ring_rides_seq_axis_on_3d_mesh():
+    """A 3-D (data × model × seq) mesh gives sequence parallelism its
+    own axis: the ring engages on ``seq`` (not ``model``), the output
+    leaf's rule resolves to P('data', 'seq'), and the net still
+    learns through the cross-axis collectives."""
+    from znicz_tpu.models.samples import attention_seq
+    from znicz_tpu.parallel.axis import SEQ_AXIS
+
+    mesh = make_mesh(n_data=2, n_model=2, n_seq=2)
+    assert dict(mesh.shape) == {"data": 2, "model": 2, "seq": 2}
+    wf = attention_seq.build(
+        seq_parallel=True, n_heads=2, seq_len=12, features=8,
+        n_train=72, n_valid=24, minibatch_size=24, max_epochs=6,
+        learning_rate=0.05)
+    wf.initialize(device=XLADevice(mesh=mesh))
+    attn = next(u for u in wf.forwards
+                if type(u).__name__ == "MultiHeadAttention")
+    assert attn.ring_active, "ring did not engage on the seq axis"
+    assert attn._ring_axis == SEQ_AXIS
+    res = wf.partition.leaves[f"{attn.name}/output"]
+    assert tuple(res.spec)[:2] == (DATA_AXIS, SEQ_AXIS)
+    assert attn.output.model_shard_axis == SEQ_AXIS
+    wf.run()
+    # 24 valid samples, 3 classes: chance ≈ 16 — must beat it clearly
+    assert wf.decision.min_validation_n_err <= 8
+
+
+def test_no_unit_module_sets_shard_attributes_directly():
+    """Grep test: sharding decisions are declared through the rule
+    engine; no unit/loader/serving/population module hand-sets the
+    legacy slot attributes anymore.  memory.py (slot definitions),
+    parallel/partition.py (the compat layer) and backends.py (the
+    legacy branch) are the only legitimate writers."""
+    import pathlib
+
+    import znicz_tpu
+
+    pkg = pathlib.Path(znicz_tpu.__file__).parent
+    pattern = re.compile(
+        r"\.(model_shard_dim|data_shard_dim|data_shard_pad|"
+        r"member_axis|model_shard_axis)\s*=[^=]")
+    allowed = {pkg / "memory.py", pkg / "parallel" / "partition.py"}
+    offenders = []
+    for src in sorted(pkg.rglob("*.py")):
+        if src in allowed:
+            continue
+        for lineno, line in enumerate(
+                src.read_text().splitlines(), start=1):
+            if pattern.search(line):
+                offenders.append(f"{src.relative_to(pkg)}:{lineno}: "
+                                 f"{line.strip()}")
+    assert not offenders, (
+        "slot attributes must be rule consequences now:\n"
+        + "\n".join(offenders))

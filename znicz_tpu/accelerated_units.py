@@ -53,7 +53,7 @@ class AcceleratedUnit(Unit):
         super().__init__(workflow, name=name, **kwargs)
         self.device: Device | None = None
         self._in_region = False
-        self.rng_state = Vector(name="rng_state")
+        self.rng_state = Vector(name=f"{self.name}.rng_state")
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -123,11 +123,45 @@ class AcceleratedUnit(Unit):
 
     def init_vectors(self, *vectors: Vector) -> None:
         """Attach vectors to the device (reference:
-        ``AcceleratedUnit.init_vectors``)."""
+        ``AcceleratedUnit.init_vectors``).
+
+        On XLA devices every Vector first BINDS against the owning
+        workflow's partition-rule table (``parallel.partition``): its
+        canonical ``unit.name/slot`` path resolves to a PartitionSpec
+        (first match wins, unmatched = hard error) and the legacy
+        slot attributes are stamped FROM that resolution, so
+        ``Device.sharding_for`` becomes a table lookup."""
         assert self.device is not None
+        from znicz_tpu.parallel import partition
+        table = (None if self.device.is_host_only
+                 else partition.table_for(self.workflow))
+        framework_unit = type(self).__module__.startswith("znicz_tpu")
         for vec in vectors:
             if vec:
+                if table is not None:
+                    try:
+                        partition.bind(table, vec, self.name,
+                                       self.device)
+                    except partition.UnmatchedLeafError:
+                        # the hard-error contract covers the
+                        # framework's slot vocabulary; user/test units
+                        # with ad-hoc names keep the legacy attribute
+                        # path unless they declare rules
+                        if framework_unit:
+                            raise
                 vec.initialize(self.device)
+
+    def partition_leaf(self, slot: str, placement, vec: Vector | None = None,
+                       logical_shape=None):
+        """Declare this unit's ``slot`` placement in the workflow's
+        partition table (an exact-path override rule).  Under
+        ``engine.partition_rules=False`` the same decision is applied
+        as the legacy slot attributes instead — one call site, two
+        arms, pinned bitwise-equal by the golden-table test."""
+        from znicz_tpu.parallel import partition
+        vec = vec if vec is not None else getattr(self, slot)
+        return partition.declare(self, vec, placement, slot=slot,
+                                 logical_shape=logical_shape)
 
     def unmap_vectors(self, *vectors: Vector) -> None:
         for vec in vectors:

@@ -157,6 +157,17 @@ class XLADevice(Device):
         return 1 if self.mesh is None else self.mesh.shape[DATA_AXIS]
 
     def sharding_for(self, vector) -> "jax.sharding.Sharding | None":
+        """Placement for one Vector on this device's mesh.
+
+        Table-bound Vectors (allocated through a workflow that owns a
+        ``parallel.partition.PartitionTable``) are a pure LOOKUP: the
+        spec was resolved once from the workflow's ordered rule table
+        at bind time.  The attribute-derived branch below survives as
+        the compatibility layer for bare Vectors (tests, serving
+        staging buffers) and for the ``engine.partition_rules=False``
+        A/B arm — the golden-table test pins the two paths
+        bitwise-equal on the default tables.
+        """
         if self.mesh is None:
             return None
         from jax.sharding import NamedSharding, PartitionSpec
@@ -164,7 +175,12 @@ class XLADevice(Device):
         from znicz_tpu.parallel.axis import DATA_AXIS, MODEL_AXIS
         if vector is None:
             return replicated_sharding(self.mesh)
+        resolved = getattr(vector, "_partition", None)
+        if resolved is not None:
+            from znicz_tpu.parallel.partition import sharding_of
+            return sharding_of(self.mesh, resolved)
         model_dim = getattr(vector, "model_shard_dim", None)
+        model_axis = getattr(vector, "model_shard_axis", MODEL_AXIS)
         data_dim = getattr(vector, "data_shard_dim", None)
         member = getattr(vector, "member_axis", False)
         if member:
@@ -187,7 +203,7 @@ class XLADevice(Device):
             if ndim and vector.shape[0] % self.n_data_shards == 0:
                 spec[0] = DATA_AXIS
             if model_dim is not None:
-                spec[model_dim] = MODEL_AXIS
+                spec[model_dim] = model_axis
             return NamedSharding(self.mesh, PartitionSpec(*spec))
         if not vector.batch_major and model_dim is None \
                 and data_dim is None:
@@ -215,7 +231,7 @@ class XLADevice(Device):
                 raise ValueError(
                     f"Vector '{vector.name}': dim 0 is the batch (data"
                     f"-sharded) — it cannot also carry the model axis")
-            spec[model_dim] = MODEL_AXIS
+            spec[model_dim] = model_axis
         return NamedSharding(self.mesh, PartitionSpec(*spec))
 
     def put(self, arr: np.ndarray, vector=None):
@@ -229,6 +245,22 @@ class XLADevice(Device):
         sharding = self.sharding_for(vector)
         if sharding is None:
             return jax.device_put(arr, self.jax_device)
+        if self.mesh is not None and jax.process_count() > 1 \
+                and not sharding.is_fully_addressable:
+            # Multi-process upload WITHOUT the hidden collective:
+            # ``jax.device_put`` onto a non-addressable sharding runs
+            # a host-side ``assert_equal`` broadcast, which executes
+            # immediately on this thread while previously dispatched
+            # step programs (and their in-program collectives) are
+            # still in flight asynchronously — on the CPU/Gloo backend
+            # the two interleave in different orders per process and
+            # cross lanes (corrupt data or a gloo size-mismatch
+            # abort).  Every host mirror is GLOBAL bookkeeping (the
+            # per-host slice path is ``put_local_batch``), so each
+            # addressable device's shard is a local slice of ``arr``
+            # and no cross-process traffic is needed at all.
+            return jax.make_array_from_callback(
+                arr.shape, sharding, lambda idx: arr[idx])
         return jax.device_put(arr, sharding)
 
     def put_local_batch(self, arr: np.ndarray, vector=None):
@@ -237,6 +269,13 @@ class XLADevice(Device):
         array without any cross-host gather.  Single-process falls
         through to :meth:`put` (arr already is the whole batch)."""
         if self.mesh is not None and jax.process_count() > 1:
+            if self.jax_device.platform == "cpu":
+                # same zero-copy hazard as :meth:`put`: on CPU the
+                # local shards ALIAS the host array — a staging-ring
+                # slot reused by the producer after upload would
+                # silently rewrite the device batch (half the global
+                # rows become the NEXT batch's data).  Detach.
+                arr = np.array(arr, copy=True)
             sharding = self.sharding_for(vector)
             assert sharding is not None
             return jax.make_array_from_process_local_data(sharding, arr)

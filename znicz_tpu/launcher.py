@@ -64,11 +64,16 @@ class Launcher(Logger):
                  load_kwargs: dict | None = None,
                  chunk: int = 1,
                  n_model: int = 1,
+                 n_seq: int = 1,
                  **kwargs) -> None:
         super().__init__(**kwargs)
         #: model-axis size for the global mesh (tensor parallelism over
         #: the distributed device grid; 1 = pure DP)
         self.n_model = int(n_model)
+        #: seq-axis size for the global mesh (sequence parallelism —
+        #: the ring rides its own third axis on a data×model×seq grid;
+        #: 1 = historical 2-D mesh)
+        self.n_seq = int(n_seq)
         #: steps per device dispatch (>1 → StandardWorkflow.run_chunked)
         self.chunk = int(chunk)
         self.backend = backend
@@ -88,12 +93,28 @@ class Launcher(Logger):
         self._interrupted = False
         self._old_handlers: dict[int, Any] = {}
         # distributed mode ------------------------------------------------
+        if listen and master:
+            raise ValueError("--listen and --master are exclusive")
         self.coordinator = listen or master
         self.process_id = process_id
         self.n_processes = n_processes
         self.is_master = master is None  # standalone or the --listen host
-        if listen and master:
-            raise ValueError("--listen and --master are exclusive")
+        if not self.coordinator:
+            # env bring-up (parallel.distributed contract): export
+            # ZNICZ_COORDINATOR / ZNICZ_NUM_PROCESSES /
+            # ZNICZ_PROCESS_ID and run the SAME command on every host
+            # — the pod-scale path where flags never differ per host
+            from znicz_tpu.parallel import distributed
+            spec = distributed.env_spec()
+            if spec is not None:
+                self.coordinator = spec["coordinator_address"]
+                self.n_processes = spec.get("num_processes",
+                                            self.n_processes)
+                if self.process_id is None:
+                    self.process_id = spec.get("process_id")
+                self.is_master = (self.process_id or 0) == 0
+                self._init_distributed(self.is_master)
+                return
         if self.coordinator:
             self._init_distributed(listen is not None)
 
@@ -108,19 +129,22 @@ class Launcher(Logger):
 
     def _init_distributed(self, is_coordinator: bool) -> None:
         """PJRT multi-host bootstrap (replaces the reference's
-        Server/Client handshake; reference: ``veles/server.py``)."""
+        Server/Client handshake; reference: ``veles/server.py``) —
+        idempotent, shared with bench.py via
+        ``parallel.distributed.ensure_initialized``."""
         import jax
-        kwargs: dict = {"coordinator_address": self.coordinator}
-        if self.n_processes is not None:
-            kwargs["num_processes"] = self.n_processes
-        if self.process_id is not None:
-            kwargs["process_id"] = self.process_id
-        elif is_coordinator:
-            kwargs["process_id"] = 0
+
+        from znicz_tpu.parallel import distributed
+        process_id = self.process_id
+        if process_id is None and is_coordinator:
+            process_id = 0
         self.info("distributed init (%s) @ %s",
                   "coordinator" if is_coordinator else "worker",
                   self.coordinator)
-        jax.distributed.initialize(**kwargs)
+        distributed.ensure_initialized(
+            coordinator=self.coordinator,
+            num_processes=self.n_processes,
+            process_id=process_id)
         self.is_master = jax.process_index() == 0
 
     # ------------------------------------------------------------------
@@ -134,21 +158,24 @@ class Launcher(Logger):
                     "host-only numpy oracle cannot join a device mesh "
                     "(each process would silently train an independent "
                     "replica)")
-            if not self.coordinator and self.n_model > 1:
+            if not self.coordinator and (self.n_model > 1
+                                         or self.n_seq > 1):
                 raise ValueError(
-                    f"n_model={self.n_model} requires distributed mode "
-                    f"(--listen/--master builds the global mesh); a "
+                    f"n_model={self.n_model}/n_seq={self.n_seq} "
+                    f"requires distributed mode (--listen/--master or "
+                    f"the ZNICZ_* env builds the global mesh); a "
                     f"standalone run would silently ignore it")
             if self.coordinator:
                 # Distributed mode: SPMD over the GLOBAL mesh (all
-                # hosts' devices); XLA lays the gradient all-reduce
-                # over ICI/DCN.  This is the whole point of the
-                # bootstrap — a local-only device would silently train
-                # per-host replicas.
+                # hosts' devices, data × model[, seq]); XLA lays the
+                # gradient all-reduce over ICI/DCN.  This is the whole
+                # point of the bootstrap — a local-only device would
+                # silently train per-host replicas.
                 from znicz_tpu.backends import XLADevice
                 from znicz_tpu.parallel import make_mesh
                 self.device = XLADevice(
-                    mesh=make_mesh(n_model=self.n_model))
+                    mesh=make_mesh(n_model=self.n_model,
+                                   n_seq=self.n_seq))
             else:
                 self.device = Device.create(self.backend)
         return self.device

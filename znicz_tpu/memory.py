@@ -66,7 +66,8 @@ class Vector:
 
     __slots__ = ("_mem", "_devmem", "_state", "_device", "_tracing", "name",
                  "batch_major", "model_shard_dim", "data_shard_dim",
-                 "data_shard_pad", "member_axis")
+                 "data_shard_pad", "member_axis", "model_shard_axis",
+                 "_partition")
 
     def __init__(self, mem: np.ndarray | None = None,
                  name: str = "", batch_major: bool = False,
@@ -111,6 +112,16 @@ class Vector:
         #: off on save and re-pad on load, so checkpoints stay
         #: layout-independent (``Unit.state_dict``/``load_state``).
         self.data_shard_pad = 0
+        #: mesh axis ``model_shard_dim`` rides — MODEL by default; the
+        #: ring sets SEQ on a 3-D (data × model × seq) mesh so DP × TP
+        #: × SP compose without overloading the model axis
+        self.model_shard_axis = "model"
+        #: resolved placement from the workflow's declarative
+        #: partition-rule table (parallel.partition) — when set,
+        #: ``backends.sharding_for`` is a pure lookup and the slot
+        #: attributes above are a compatibility layer populated FROM
+        #: this resolution, not hand-set by units
+        self._partition = None
         if mem is not None:
             self.reset(mem)
 

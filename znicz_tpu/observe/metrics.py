@@ -445,6 +445,25 @@ def loader_prefetch(loader: str, event: str) -> Counter:
         labels=("loader", "event")).labels(loader=loader, event=event)
 
 
+def partition_rules(workflow: str) -> Gauge:
+    """Size of a workflow's declarative partition-rule table (unit
+    overrides + default tail) — the dryrun tail attests
+    ``partition=rules, specs=N`` from this pair of gauges."""
+    return REGISTRY.gauge(
+        "znicz_partition_rules",
+        "Partition-rule table size (overrides + default tail)",
+        labels=("workflow",)).labels(workflow=workflow)
+
+
+def partition_leaves(workflow: str) -> Gauge:
+    """Vector leaves bound (resolved) through a workflow's partition
+    table — every placed buffer the rule engine decided."""
+    return REGISTRY.gauge(
+        "znicz_partition_leaves",
+        "Vector leaves resolved through the partition-rule table",
+        labels=("workflow",)).labels(workflow=workflow)
+
+
 def snapshot_seconds(op: str) -> Histogram:
     return REGISTRY.histogram(
         "znicz_snapshot_seconds",

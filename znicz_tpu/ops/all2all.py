@@ -91,22 +91,25 @@ class All2All(Forward):
                     f"make_mesh always provides one; custom meshes "
                     f"must too, or drop model_parallel)")
             n_model = mesh.shape[MODEL_AXIS]
+        from jax.sharding import PartitionSpec as P
+        from znicz_tpu.parallel.axis import DATA_AXIS, MODEL_AXIS
         if self.model_parallel == "column":
             if n_out % n_model:
                 raise ValueError(
                     f"{self}: column-parallel n_out {n_out} not "
                     f"divisible by model axis size {n_model}")
-            self.weights.model_shard_dim = 1
+            self.partition_leaf("weights", P(None, MODEL_AXIS))
             if self.include_bias:
-                self.bias.model_shard_dim = 0
+                self.partition_leaf("bias", P(MODEL_AXIS))
             # output features ride the model axis: (batch, n_out/m)
-            self.output.model_shard_dim = 1  # 1-D sample shape enforced
+            # (1-D sample shape enforced above)
+            self.partition_leaf("output", P(DATA_AXIS, MODEL_AXIS))
         else:  # row
             if n_in % n_model:
                 raise ValueError(
                     f"{self}: row-parallel n_in {n_in} not divisible "
                     f"by model axis size {n_model}")
-            self.weights.model_shard_dim = 0
+            self.partition_leaf("weights", P(MODEL_AXIS))
             # bias replicated: added after the psum; output replicated
 
     def initialize(self, device=None, **kwargs) -> None:

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 
 from znicz_tpu.memory import Vector
 from znicz_tpu.ops.nn_units import Forward, GradientDescentBase
-from znicz_tpu.parallel.axis import MODEL_AXIS
+from znicz_tpu.parallel.axis import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 
 def _split_heads(qkv, n_heads: int):
@@ -132,26 +132,38 @@ class MultiHeadAttention(Forward):
                 self.bias_out.reset(np.zeros(d, np.float32))
         self.output.reset(np.zeros((b, t, d),
                                    dtype=self.output_store_dtype))
+        from jax.sharding import PartitionSpec as P
+        from znicz_tpu.parallel import partition
         mesh = getattr(self.device, "mesh", None)
         self._ring_active = False
-        # Vector.reset preserves model_shard_dim: clear any stale
-        # time-sharding from a prior ring-engaged initialize (the
-        # ring branch below re-sets it when it actually engages)
-        self.output.model_shard_dim = None
+        #: mesh axis the ring rotates over: a 3-D (data × model × seq)
+        #: mesh gives sequence parallelism its OWN axis so DP × TP ×
+        #: SP compose; 2-D meshes keep the historical model-axis ring
+        self._ring_axis = (SEQ_AXIS if mesh is not None
+                           and mesh.shape.get(SEQ_AXIS, 1) > 1
+                           else MODEL_AXIS)
+        # the default (non-ring) placement replaces any stale
+        # time-sharding rule from a prior ring-engaged initialize;
+        # the ring branch below re-declares when it actually engages
+        self.partition_leaf("output", partition.BATCH)
         if self.seq_parallel:
-            if mesh is None or mesh.shape.get(MODEL_AXIS, 1) < 2:
+            ring_n = 1 if mesh is None \
+                else mesh.shape.get(self._ring_axis, 1)
+            if ring_n < 2:
                 # no ring to ride — fall back to local attention (the
                 # math is identical; seq_parallel is a layout choice).
                 # The configured flag stays intact so a later
                 # re-initialize on a capable mesh engages the ring.
                 pass
             else:
-                if t % mesh.shape[MODEL_AXIS]:
+                if t % ring_n:
                     raise ValueError(
                         f"{self}: time axis {t} not divisible by the "
-                        f"model-axis size {mesh.shape[MODEL_AXIS]}")
+                        f"{self._ring_axis}-axis size {ring_n}")
                 self._ring_active = True
-                self.output.model_shard_dim = 1  # time rides the ring
+                # time rides the ring: declared, not hand-set
+                self.partition_leaf(
+                    "output", P(DATA_AXIS, self._ring_axis))
         # fused flash-attention Pallas kernel (ops/pallas_attention):
         # DEFAULT ON for real TPU devices — the measured winner at
         # every T (chip A/B in PERF.md round 5 / SEQ_BENCH.json:
@@ -200,7 +212,8 @@ class MultiHeadAttention(Forward):
             self._ring_fold, self._ring_block_q, self._ring_block_k \
                 = ring_fold_choice(
                     mesh, (b, t, self.n_heads, dh),
-                    axis_name=MODEL_AXIS, block_k=self.flash_block_k,
+                    axis_name=self._ring_axis,
+                    block_k=self.flash_block_k,
                     pallas_fold=bool(rflag), head_pack=head_pack)
             self._ring_pack = (head_pack
                                if self._ring_fold == "pallas" else 1)
@@ -279,7 +292,8 @@ class MultiHeadAttention(Forward):
                 sequence_sharded_attention
             o = sequence_sharded_attention(
                 self.device.mesh, q, k, v, causal=self.causal,
-                axis_name=MODEL_AXIS, dot_dtype=dot_dtype,
+                axis_name=getattr(self, "_ring_axis", MODEL_AXIS),
+                dot_dtype=dot_dtype,
                 block_k=self.flash_block_k,
                 # round 6: the per-hop fold is the flash KERNEL when
                 # the gate resolved it legal (initialize); the scan

@@ -64,6 +64,7 @@ class LayerNorm(Forward):
         self._ln_mesh = None
         self._ln_spec = None
         msd = getattr(self.input, "model_shard_dim", None)
+        msd_axis = getattr(self.input, "model_shard_axis", None)
         ndim = len(self.input.shape)
         if engaged and multi_device:
             # mesh-native path: a pallas_call has no GSPMD sharding
@@ -74,8 +75,9 @@ class LayerNorm(Forward):
             # axis; γ/β grad sums psum in the backward.
             # ``engine.pallas_shard_map = False`` restores the old
             # conservative gate (kernel off on multi-device meshes).
-            spec, _ = kernel_shard_spec(mesh, ndim,
-                                        model_shard_dim=msd)
+            spec, _ = kernel_shard_spec(
+                mesh, ndim, model_shard_dim=msd,
+                **({"model_axis": msd_axis} if msd_axis else {}))
             engaged = (
                 bool(root.common.engine.get("pallas_shard_map", True))
                 and msd != ndim - 1  # feature axis must stay whole

@@ -141,15 +141,20 @@ class Forward(AcceleratedUnit):
         return self.act_store_dtype
 
     def inherit_model_shard(self, *vectors) -> None:
-        """Copy the input's model-axis sharding to same-shaped output
-        vectors.  Every shape-preserving (elementwise) forward should
+        """Declare that same-shaped output vectors shard like the
+        input.  Every shape-preserving (elementwise) forward should
         call this after allocating its outputs so tensor-parallel
         feature sharding passes through instead of silently degrading
         to replicated (which would make GSPMD all-gather the
-        activations between a column and row layer every step)."""
-        model_dim = getattr(self.input, "model_shard_dim", None)
+        activations between a column and row layer every step).
+        Declarative since round 17: each vector gets an exact-path
+        rule in the workflow's partition table derived from the
+        input's resolved placement (``partition.like``)."""
+        from znicz_tpu.parallel import partition
         for vec in vectors:
-            vec.model_shard_dim = model_dim
+            placement = partition.like(self.input,
+                                       batch_major=vec.batch_major)
+            partition.declare(self, vec, placement)
 
 
 # ----------------------------------------------------------------------
@@ -262,9 +267,13 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
                                           dtype=self.act_store_dtype))
             # the error cotangent shards like the tensor it's the
             # gradient of (tensor parallelism: feature-sharded
-            # activations get feature-sharded errors)
-            self.err_input.model_shard_dim = getattr(
-                self.input, "model_shard_dim", None)
+            # activations get feature-sharded errors) — declared as a
+            # rule derived from the input's resolved placement
+            from znicz_tpu.parallel import partition
+            partition.declare(self, self.err_input,
+                              partition.like(self.input,
+                                             batch_major=True),
+                              slot="err_input")
         if not self.need_err_input and (self.weights is None
                                         or not self.weights):
             # weightless AND nothing upstream wants the error: the unit
@@ -296,23 +305,33 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
     def _alloc_accumulator(self, acc_vec: Vector, param_vec: Vector) -> None:
         """Allocate a momentum accumulator for ``param_vec``: storage
         dtype from the bf16-optimizer-state policy, model-axis sharding
-        inherited, and — under ZeRO-1 — a ``data_shard_dim`` annotation
-        (plus zero padding up to a multiple of the data-axis size) so
-        each chip STORES only 1/N of the state.  Units with extra
+        inherited, and — under ZeRO-1 — a data-sharded dim plus zero
+        padding so each chip STORES only 1/N of the state.  The
+        (dim, pad) choice is a RULE CONSEQUENCE now: the unit declares
+        a :class:`~znicz_tpu.parallel.partition.Zero1` placement for
+        the accumulator's leaf path and the engine derives the
+        sharded layout from the logical shape; units with extra
         parameter pairs (attention's output projection) call this for
         their own accumulators so every lever composes identically."""
-        from znicz_tpu.parallel.mesh import zero1_partition
-        shape = list(param_vec.shape)
-        acc_vec.model_shard_dim = getattr(param_vec, "model_shard_dim",
-                                          None)
+        from znicz_tpu.parallel import partition
+        shape = tuple(param_vec.shape)
+        from znicz_tpu.parallel.axis import MODEL_AXIS
+        model_dim = getattr(param_vec, "model_shard_dim", None)
+        model_axis = getattr(param_vec, "model_shard_axis",
+                             MODEL_AXIS) or MODEL_AXIS
         if self._zero1:
-            dim, pad = zero1_partition(shape, self.device.n_data_shards,
-                                       acc_vec.model_shard_dim)
-            if dim is not None:
-                shape[dim] += pad
-                acc_vec.data_shard_dim = dim
-                acc_vec.data_shard_pad = pad
-        acc_vec.reset(np.zeros(tuple(shape), dtype=self.opt_state_dtype))
+            placement = partition.Zero1(model_dim)
+        elif model_dim is None:
+            placement = partition.REPLICATED
+        else:
+            placement = partition.model_sharded(model_dim,
+                                                axis=model_axis)
+        resolved = partition.declare(self, acc_vec, placement,
+                                     logical_shape=shape)
+        acc_vec.reset(np.zeros(resolved.padded_shape(),
+                               dtype=self.opt_state_dtype))
+        partition.stamp(self, acc_vec, resolved,
+                        pad_applied=bool(resolved.data_shard_pad))
 
     @property
     def opt_state_dtype(self) -> np.dtype:

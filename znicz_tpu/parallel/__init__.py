@@ -13,10 +13,14 @@ bootstrap is ``jax.distributed.initialize`` over DCN (SURVEY.md §2.5,
 from znicz_tpu.parallel.axis import (  # noqa: F401
     DATA_AXIS,
     MODEL_AXIS,
+    SEQ_AXIS,
     current_data_axis,
     data_axis,
     maybe_pmean,
     maybe_psum,
+)
+from znicz_tpu.parallel.distributed import (  # noqa: F401
+    ensure_initialized,
 )
 from znicz_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,

@@ -1,0 +1,88 @@
+"""Multi-host bring-up: ``jax.distributed.initialize`` from env or
+flags.
+
+The reference's cluster bootstrap was an explicit Server/Client
+handshake (``veles/server.py``); the TPU-native replacement is PJRT
+multi-process SPMD — every host runs the same program over one global
+mesh.  This module is the single home of that bootstrap so the
+Launcher, ``bench.py`` and the dryrun all bring up a pod slice the
+same way, **unmodified**: export three env vars and run the same
+command on every host.
+
+Environment contract (flags win over env; both optional):
+
+- ``ZNICZ_COORDINATOR``  — ``host:port`` of process 0,
+- ``ZNICZ_NUM_PROCESSES`` — total process count,
+- ``ZNICZ_PROCESS_ID``   — this process's index (0 = master).
+
+On TPU pods the PJRT plugin can discover all three; on CPU/GPU
+clusters (and the two-process CI smoke) they must be given.
+"""
+
+from __future__ import annotations
+
+import os
+
+ENV_COORDINATOR = "ZNICZ_COORDINATOR"
+ENV_NUM_PROCESSES = "ZNICZ_NUM_PROCESSES"
+ENV_PROCESS_ID = "ZNICZ_PROCESS_ID"
+
+_initialized = False
+
+
+def env_spec() -> dict | None:
+    """The env-var bring-up request, or None when unset."""
+    coordinator = os.environ.get(ENV_COORDINATOR)
+    if not coordinator:
+        return None
+    spec: dict = {"coordinator_address": coordinator}
+    n = os.environ.get(ENV_NUM_PROCESSES)
+    if n is not None:
+        spec["num_processes"] = int(n)
+    pid = os.environ.get(ENV_PROCESS_ID)
+    if pid is not None:
+        spec["process_id"] = int(pid)
+    return spec
+
+
+def ensure_initialized(coordinator: str | None = None,
+                       num_processes: int | None = None,
+                       process_id: int | None = None) -> bool:
+    """Idempotent ``jax.distributed.initialize``.
+
+    Explicit arguments win; otherwise the env contract above is
+    consulted.  Returns True when this process is part of an
+    initialized multi-process runtime (including when a caller
+    already initialized it), False when nothing requested distributed
+    mode — callers can branch mesh construction on the result.
+    """
+    global _initialized
+    import jax
+
+    if _initialized:
+        return True
+    spec = env_spec() or {}
+    if coordinator is not None:
+        spec["coordinator_address"] = coordinator
+    if num_processes is not None:
+        spec["num_processes"] = num_processes
+    if process_id is not None:
+        spec["process_id"] = process_id
+    if not spec.get("coordinator_address"):
+        return False
+    try:
+        # CPU backends need a collectives implementation for
+        # cross-process computations (the default "none" fails every
+        # multi-process program); harmless no-op on TPU pods
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):  # pragma: no cover - old jax
+        pass
+    jax.distributed.initialize(**spec)
+    _initialized = True
+    return True
+
+
+def process_info() -> tuple[int, int]:
+    """(process_index, process_count) of the current runtime."""
+    import jax
+    return jax.process_index(), jax.process_count()

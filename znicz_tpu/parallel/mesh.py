@@ -19,7 +19,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from znicz_tpu.parallel.axis import DATA_AXIS, MODEL_AXIS
+from znicz_tpu.parallel.axis import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
 
 
 def shard_map_fn():
@@ -185,18 +185,29 @@ def zero1_specs(mesh: Mesh, ndim: int, data_shard_dim: int,
 
 
 def make_mesh(n_data: int | None = None, n_model: int = 1,
-              devices=None) -> Mesh:
-    """Build a (data, model) mesh over the available devices.
+              n_seq: int = 1, devices=None) -> Mesh:
+    """Build a (data, model[, seq]) mesh over the available devices.
 
-    ``n_data=None`` uses all devices on the data axis — the DP layout
-    matching the reference's capability (its only scale-out strategy
-    was data parallelism, SURVEY.md §2.5).
+    ``n_data=None`` uses all remaining devices on the data axis — the
+    DP layout matching the reference's capability (its only scale-out
+    strategy was data parallelism, SURVEY.md §2.5).  ``devices``
+    defaults to ``jax.devices()``, which under a multi-process runtime
+    (``parallel.distributed``) is the GLOBAL device list — the same
+    call that builds an 8-way virtual CPU mesh builds a pod slice.
+
+    ``n_seq > 1`` adds a third ``seq`` axis for sequence parallelism
+    (the ring rides it instead of doubling up on ``model``, so
+    DP × TP × SP compose); ``n_seq=1`` keeps the historical 2-D mesh
+    so existing sharding specs and tests are untouched.
     """
     if devices is None:
         devices = jax.devices()
     if n_data is None:
-        n_data = len(devices) // n_model
-    use = n_data * n_model
+        n_data = len(devices) // (n_model * n_seq)
+    use = n_data * n_model * n_seq
+    if n_seq > 1:
+        grid = np.asarray(devices[:use]).reshape(n_data, n_model, n_seq)
+        return Mesh(grid, axis_names=(DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
     grid = np.asarray(devices[:use]).reshape(n_data, n_model)
     return Mesh(grid, axis_names=(DATA_AXIS, MODEL_AXIS))
 

@@ -190,19 +190,36 @@ class PopulationRegion(Logger):
                 "axis — member axis stays replicated (time-sliced)",
                 self.n_members, n_data)
 
-        # stack: one member_axis Vector per region leaf
+        # stack: one member_axis Vector per region leaf.  Placement is
+        # DECLARATIVE: each stacked leaf gets a Member rule in the
+        # template workflow's partition table (member-axis placement
+        # and its divisibility fallback are rule consequences), shared
+        # leaves get an explicit replicated rule; the rules-off arm
+        # applies the equivalent legacy attributes.
+        from znicz_tpu.parallel import partition
+        table = partition.table_for(self.template)
         self.svecs: list[Vector] = []
         for vec, member in zip(vectors, self.member_mask):
             key = self._keyof.get(id(vec), (vec.name, ""))
             sname = f"{self.name}.{key[0]}.{key[1] or vec.name}"
             if not member:
                 svec = Vector(name=sname)
+                placement = partition.REPLICATED
                 svec.reset(np.asarray(vec))
             else:
                 svec = Vector(name=sname, member_axis=True)
-                if vec.model_shard_dim is not None:
-                    svec.model_shard_dim = vec.model_shard_dim + 1
+                md = (vec.model_shard_dim + 1
+                      if vec.model_shard_dim is not None else None)
+                placement = partition.Member(md)
                 svec.reset(self._stacked_init(vec, member_states))
+            path = partition.path_of(svec)
+            if table is not None:
+                table.declare_leaf(path, placement)
+                table.bind(svec, path, self.pop_device)
+            else:
+                partition.apply_legacy(svec, partition.materialize(
+                    placement, path, tuple(svec.shape),
+                    getattr(self.pop_device, "n_data_shards", 1)))
             svec.initialize(self.pop_device)
             self.svecs.append(svec)
         # template device copies are dead weight now — the stacked

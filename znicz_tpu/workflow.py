@@ -37,6 +37,12 @@ class Workflow(Container):
         super().__init__(workflow, name=name, **kwargs)
         self.start_point = StartPoint(self, name="start_point")
         self.end_point = EndPoint(self, name="end_point")
+        from znicz_tpu.parallel.partition import PartitionTable
+        #: the workflow's ONE ordered partition-rule table — units
+        #: declare placement overrides into it (TP, ring, ZeRO-1,
+        #: population member axis) and every Vector binds against it
+        #: at init_vectors time (parallel.partition)
+        self.partition = PartitionTable(name=self.name)
         self.stopped = Bool(False)
         self._finished = False
         self._max_fires: int | None = None  # safety valve for tests
