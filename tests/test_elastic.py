@@ -1,0 +1,153 @@
+"""Elastic 2-process drills (round 18, slow): a real gang of
+``jax.distributed`` OS processes loses a member mid-epoch, the
+ElasticSupervisor restarts training on the surviving mesh, and the
+final weights are BITWISE-equal to an uninterrupted single-process run
+restored from the same snapshot — plus the preemption arm: a
+``host.preempt`` notice triggers the barriered checkpoint-on-signal
+and costs at most one step of progress."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from znicz_tpu.resilience import supervisor as sup
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: steps per drill epoch: 128 train rows / batch 16 + 32 valid / 16
+STEPS_PER_EPOCH = 10
+
+
+def _write_drill_shards(tmp_path) -> str:
+    from znicz_tpu.loader.streaming import write_shards
+
+    rng = np.random.default_rng(21)
+    protos = rng.normal(0, 1, (4, 6, 6))
+    data = np.concatenate(
+        [p + 0.3 * rng.normal(size=(40, 6, 6)) for p in protos])
+    data = np.clip((data + 4.0) * 32.0, 0, 255).astype(np.uint8)
+    labels = np.repeat(np.arange(4), 40).astype(np.int32)
+    order = rng.permutation(len(data))
+    data, labels = data[order], labels[order]
+    shard_dir = str(tmp_path / "shards")
+    write_shards(shard_dir, data[:128], labels[:128],
+                 valid_data=data[128:], valid_labels=labels[128:],
+                 rows_per_shard=32)
+    return shard_dir
+
+
+def _supervisor(tmp_path, shard_dir, tag, n_processes,
+                fault_recipe=None, initial_snapshot=None,
+                max_restarts=2):
+    work = str(tmp_path / tag)
+    snaps = os.path.join(work, "snaps")
+
+    def argv_for(pid, n, attempt):
+        return [sys.executable, "-m",
+                "znicz_tpu.resilience.elastic_worker",
+                os.path.join(work, f"digest_a{attempt}_p{pid}.json"),
+                shard_dir]
+
+    fault_env = {}
+    if fault_recipe is not None:
+        fault_env["ZNICZ_ELASTIC_FAULTS"] = json.dumps(fault_recipe)
+    return sup.ElasticSupervisor(
+        argv_for, n_processes=n_processes, work_dir=work,
+        snapshot_dir=snaps, snapshot_prefix="elastic",
+        heartbeat_timeout_s=10.0, start_grace_s=240.0,
+        poll_interval_s=0.1, max_restarts=max_restarts,
+        initial_snapshot=initial_snapshot,
+        env={"JAX_PLATFORMS": None, "XLA_FLAGS": None,
+             "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", ""),
+             "ZNICZ_ELASTIC_SNAPSHOT_DIR": snaps,
+             "ZNICZ_COLLECTIVE_TIMEOUT_S": "20",
+             "ZNICZ_HEARTBEAT_INTERVAL_S": "0.2",
+             "ZNICZ_DIST_INIT_TIMEOUT_S": "120"},
+        fault_env=fault_env)
+
+
+def _digest(work_dir: str, attempt: int, pid: int = 0) -> dict:
+    path = os.path.join(work_dir, f"digest_a{attempt}_p{pid}.json")
+    with open(path) as fh:
+        return json.load(fh)
+
+
+@pytest.mark.slow
+def test_elastic_kill_resume_bitwise_parity(tmp_path):
+    """ISSUE 14 acceptance drill: 2 processes, ``host.loss`` kills
+    process 1 mid-epoch (step 25 of 60 — epoch 3's 5th step), the
+    supervisor detects the loss, restarts on the surviving 1-process
+    mesh from the newest good snapshot, and the final weights are
+    BITWISE-equal to an uninterrupted single-process run restored from
+    the SAME snapshot — with zero warmed-step compiles after the
+    restart."""
+    shard_dir = _write_drill_shards(tmp_path)
+    drill = _supervisor(
+        tmp_path, shard_dir, "drill", n_processes=2,
+        fault_recipe={"host.loss": {"process": 1, "at": [25]}})
+    summary = drill.run()
+    assert summary["ok"], summary
+    assert summary["restarts"] == 1
+    assert summary["losses"] == {"loss": 1}
+    assert summary["final_processes"] == 1
+    resume = summary["resume_snapshots"][1]
+    assert resume and os.path.exists(resume), summary
+    # the restart resumed mid-run (epoch 2's boundary snapshot), not
+    # from scratch — at most one epoch of progress re-trained
+    assert summary["resumed_step"] == 2 * STEPS_PER_EPOCH
+    elastic = _digest(drill.work_dir, attempt=1)
+    assert elastic["n_processes"] == 1
+    assert elastic["resumed_from"] == resume
+    # the partition table re-resolved onto the SURVIVING mesh (2 local
+    # devices vs the 4-device gang mesh of attempt 0)
+    assert elastic["bound_mesh"]["data"] == 2
+    assert elastic["warmed_step_compiles"] == 0
+    assert elastic["epochs_done"] == 6
+
+    # reference arm: a 1-process gang restored from the SAME snapshot
+    ref = _supervisor(tmp_path, shard_dir, "ref", n_processes=1,
+                      initial_snapshot=resume, max_restarts=0)
+    ref_summary = ref.run()
+    assert ref_summary["ok"] and ref_summary["restarts"] == 0
+    reference = _digest(ref.work_dir, attempt=0)
+    assert reference["resumed_from"] == resume
+    assert reference["warmed_step_compiles"] == 0
+    # THE parity bar: bitwise-identical trained weights
+    assert elastic["weights_sha256"] == reference["weights_sha256"], (
+        elastic["weight_sums"], reference["weight_sums"])
+    assert elastic["weight_sums"] == reference["weight_sums"]
+
+
+@pytest.mark.slow
+def test_elastic_preemption_checkpoint_loses_at_most_one_step(tmp_path):
+    """Preemption arm: process 1 receives a ``host.preempt`` notice at
+    step 23; the whole gang checkpoints at the announced barrier step
+    (23 + preempt_barrier_steps) — process 0 writes, process 1 fences
+    on the sidecar — exits EXIT_PREEMPTED, and the supervisor restarts
+    the SURVIVING process from that checkpoint: progress up to the
+    barrier step survives, so the preemption cost is at most the one
+    in-flight step."""
+    shard_dir = _write_drill_shards(tmp_path)
+    drill = _supervisor(
+        tmp_path, shard_dir, "preempt", n_processes=2,
+        fault_recipe={"host.preempt": {"process": 1, "at": [23]}})
+    summary = drill.run()
+    assert summary["ok"], summary
+    assert summary["restarts"] == 1
+    assert summary["losses"] == {"preempt": 1}
+    assert summary["final_processes"] == 1
+    resume = summary["resume_snapshots"][1]
+    # the preemption checkpoint (unique barrier-step suffix) is what
+    # the restart resumed from — not an older epoch boundary
+    assert "preempt_s27" in os.path.basename(resume), resume
+    # ≤ 1 step of progress lost: the resume position is the barrier
+    # step itself (23 + 4), beyond the signal step
+    assert summary["resumed_step"] == 27
+    elastic = _digest(drill.work_dir, attempt=1)
+    assert elastic["warmed_step_compiles"] == 0
+    assert elastic["epochs_done"] == 6
+    assert elastic["bound_mesh"]["data"] == 2

@@ -1,0 +1,582 @@
+"""Elastic supervision (round 18): heartbeats, the coordinator-side
+monitor, the preemption barrier + checkpoint-on-signal, the
+process-0-only snapshot/publish write discipline, the bounded
+``jax.distributed`` bring-up, and the gang supervisor's restart
+classification (exercised on stub workers — no jax in the gang, so the
+whole file stays in the fast tier; the real 2-process elastic drill
+lives in ``tests/test_elastic.py``, slow)."""
+
+import gzip
+import hashlib
+import json
+import os
+import pickle
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from znicz_tpu.observe import metrics as obs_metrics
+from znicz_tpu.resilience import faults as res_faults
+from znicz_tpu.resilience import supervisor as sup
+from znicz_tpu.utils.config import root
+from znicz_tpu.utils.snapshotter import Snapshotter
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------------------
+# heartbeat writer + monitor
+# ----------------------------------------------------------------------
+def test_heartbeat_writer_beats_and_annotates(tmp_path):
+    w = sup.HeartbeatWriter(str(tmp_path), 3, interval_s=0.05)
+    w.start()
+    w.beat(7)
+    w.annotate(resumed_step=42)
+    hb = json.load(open(sup.heartbeat_path(str(tmp_path), 3)))
+    assert hb["process"] == 3 and hb["step"] == 7
+    assert hb["resumed_step"] == 42 and hb["pid"] == os.getpid()
+    t0 = hb["time"]
+    time.sleep(0.15)  # interval thread refreshes wall-clock alone
+    hb2 = json.load(open(sup.heartbeat_path(str(tmp_path), 3)))
+    assert hb2["time"] > t0 and hb2["step"] == 7
+    w.stop()
+
+
+def test_heartbeat_freeze_keeps_time_flowing(tmp_path):
+    w = sup.HeartbeatWriter(str(tmp_path), 0, interval_s=0.05)
+    w.start()
+    w.beat(5)
+    w.freeze()
+    w.beat(9)  # frozen: step must NOT advance
+    time.sleep(0.12)
+    hb = json.load(open(sup.heartbeat_path(str(tmp_path), 0)))
+    assert hb["step"] == 5
+    assert time.time() - hb["time"] < 1.0
+    w.stop()
+
+
+def test_monitor_ok_stale_and_grace(tmp_path):
+    mon = sup.HeartbeatMonitor(str(tmp_path), 2, timeout_s=10.0,
+                               start_grace_s=100.0)
+    now = time.time()
+    sup._atomic_write_json(sup.heartbeat_path(str(tmp_path), 0),
+                           {"process": 0, "step": 3, "time": now})
+    st = mon.poll(now=now)
+    assert st[0]["status"] == "ok" and st[0]["step"] == 3
+    # process 1 never beat: within grace → starting, not dead
+    assert st[1]["status"] == "starting"
+    assert mon.dead(now=now) == []
+    # past the grace with still no file → missing/dead
+    assert mon.poll(now=now + 200.0)[1]["status"] == "missing"
+    # process 0's beats stop entirely → stale (the host vanished)
+    st = mon.poll(now=now + 200.0)
+    assert st[0]["status"] == "stale"
+    assert set(mon.dead(now=now + 200.0)) == {(0, "loss"), (1, "loss")}
+
+
+def test_monitor_detects_stalled_step_counter(tmp_path):
+    mon = sup.HeartbeatMonitor(str(tmp_path), 1, timeout_s=60.0,
+                               stall_timeout_s=3.0)
+    t0 = time.time()
+    path = sup.heartbeat_path(str(tmp_path), 0)
+    sup._atomic_write_json(path, {"process": 0, "step": 5, "time": t0})
+    assert mon.poll(now=t0)[0]["status"] == "ok"
+    # wall-clock beats keep flowing, step frozen past the stall bound
+    sup._atomic_write_json(path, {"process": 0, "step": 5,
+                                  "time": t0 + 5.0})
+    st = mon.poll(now=t0 + 5.0)
+    assert st[0]["status"] == "stalled"
+    assert st[0]["step_age_s"] == pytest.approx(5.0)
+    assert mon.dead(now=t0 + 5.0) == [(0, "stall")]
+    # step advances again → healthy
+    sup._atomic_write_json(path, {"process": 0, "step": 6,
+                                  "time": t0 + 6.0})
+    assert mon.poll(now=t0 + 6.0)[0]["status"] == "ok"
+
+
+def test_monitor_gauges_feed_canonical_series(tmp_path):
+    mon = sup.HeartbeatMonitor(str(tmp_path), 2, timeout_s=5.0)
+    mon.register_gauges()
+    sup._atomic_write_json(sup.heartbeat_path(str(tmp_path), 0),
+                           {"process": 0, "step": 1,
+                            "time": time.time() - 2.5})
+    age0 = obs_metrics.heartbeat_age_seconds(0).value
+    assert 2.0 < age0 < 10.0
+    assert obs_metrics.heartbeat_age_seconds(1).value == float("inf")
+    fam = obs_metrics.REGISTRY.get("znicz_heartbeat_age_seconds")
+    assert {k[0] for k, _ in fam.items()} >= {"0", "1"}
+
+
+# ----------------------------------------------------------------------
+# preemption: flag + barrier + checkpoint-on-signal
+# ----------------------------------------------------------------------
+class _StubWorkflow:
+    """The minimal workflow surface the WorkerSupervisor touches."""
+
+    name = "stub_wf"
+    snapshotter = None
+    loader = None
+
+    def __init__(self):
+        self._step_hooks = []
+        self.stopped_calls = 0
+        self.state = {"__units__": {"u": {"w": [1.0, 2.0]}}}
+
+    def add_step_hook(self, fn):
+        self._step_hooks.append(fn)
+
+    def remove_step_hook(self, fn):
+        self._step_hooks.remove(fn)
+
+    def on_step_boundary(self):
+        for fn in list(self._step_hooks):
+            fn()
+
+    def state_dict(self, allow_collective=False):
+        assert allow_collective, \
+            "checkpoint-on-signal must gather in lockstep"
+        return self.state
+
+    def stop(self):
+        self.stopped_calls += 1
+
+
+class _StubSnapshotter:
+    def __init__(self, directory):
+        self.directory = str(directory)
+        self.prefix = "stub"
+
+
+def test_preempt_flag_first_writer_wins(tmp_path):
+    sup.request_preempt_flag(str(tmp_path), 12, 1, "first")
+    sup.request_preempt_flag(str(tmp_path), 99, 0, "second")
+    flag = sup.preempt_flag(str(tmp_path))
+    assert flag["barrier_step"] == 12 and flag["requested_by"] == 1
+
+
+def test_worker_supervisor_checkpoint_on_signal(tmp_path):
+    wf = _StubWorkflow()
+    wf.snapshotter = _StubSnapshotter(tmp_path / "snaps")
+    supv = sup.WorkerSupervisor(
+        wf, directory=str(tmp_path / "hb"), process_index=0,
+        process_count=1, heartbeat_interval_s=0.05)
+    supv.attach()
+    before = obs_metrics.checkpoint_on_signal().value
+    wf.on_step_boundary()
+    wf.on_step_boundary()
+    assert supv.step == 2
+    supv.request_preempt("SIGTERM test")  # barrier = step + 1
+    with pytest.raises(sup.Preempted) as err:
+        wf.on_step_boundary()
+    assert err.value.code == sup.EXIT_PREEMPTED
+    path = err.value.snapshot_path
+    assert path.endswith("preempt_s3.pickle.gz") and os.path.exists(path)
+    # sha256 sidecar landed and verifies; the state round-trips
+    digest = open(path + ".sha256").read().strip()
+    assert hashlib.sha256(open(path, "rb").read()).hexdigest() == digest
+    assert pickle.load(gzip.open(path, "rb")) == wf.state
+    assert wf.stopped_calls == 1
+    assert obs_metrics.checkpoint_on_signal().value == before + 1
+    hb = json.load(open(sup.heartbeat_path(str(tmp_path / "hb"), 0)))
+    assert hb["checkpoint_on_signal"] == 1
+    assert hb["checkpoint_path"] == path
+    supv.detach()
+
+
+def test_worker_supervisor_peer_flag_joins_barrier(tmp_path):
+    """A process that never saw the signal picks the preempt flag up
+    from the channel at its next step boundary and checkpoints at the
+    SAME barrier step."""
+    wf = _StubWorkflow()
+    wf.snapshotter = _StubSnapshotter(tmp_path / "snaps")
+    supv = sup.WorkerSupervisor(
+        wf, directory=str(tmp_path), process_index=0, process_count=1,
+        heartbeat_interval_s=0.05)
+    supv.attach()
+    wf.on_step_boundary()
+    sup.request_preempt_flag(str(tmp_path), 3, 1, "peer signal")
+    wf.on_step_boundary()  # step 2 < barrier 3: keeps training
+    with pytest.raises(sup.Preempted):
+        wf.on_step_boundary()  # step 3 == barrier: checkpoint
+    assert supv.step == 3
+    supv.detach()
+
+
+def test_watchdog_surfaces_peer_lost(tmp_path, monkeypatch):
+    """A dead peer leaves this process blocked in a collective — the
+    watchdog bounds time-in-step and surfaces a detectable PeerLost
+    exit instead of an infinite gloo/ICI hang."""
+    exits = []
+    monkeypatch.setattr(sup.os, "_exit", lambda rc: exits.append(rc))
+    wf = _StubWorkflow()
+    supv = sup.WorkerSupervisor(
+        wf, directory=str(tmp_path), process_index=0, process_count=2,
+        heartbeat_interval_s=0.05, collective_timeout_s=0.3)
+    supv.attach()
+    time.sleep(0.6)
+    assert exits == [], "watchdog fired during bring-up (step 0)"
+    wf.on_step_boundary()  # first boundary arms the bound
+    deadline = time.time() + 5.0
+    while not exits and time.time() < deadline:
+        time.sleep(0.05)
+    assert sup.EXIT_PEER_LOST in exits
+    hb = json.load(open(sup.heartbeat_path(str(tmp_path), 0)))
+    assert hb.get("peer_lost") is True
+    supv.detach()
+
+
+def test_host_loss_site_respects_process_filter():
+    plan = res_faults.FaultPlan(
+        {"host.loss": {"process": 1, "at": [2]}})
+    assert plan.fire("host.loss", process=0) is None
+    assert plan.fire("host.loss", process=1) is None   # arrival 1
+    payload = plan.fire("host.loss", process=1)        # arrival 2
+    assert payload is not None and payload["arrival"] == 2
+    # process-0 arrivals never consumed the ordinal stream
+    assert plan.fire("host.loss", process=0) is None
+
+
+def test_checkpoint_signal_corrupt_falls_back(tmp_path):
+    """The corrupted checkpoint-on-signal is rejected on digest
+    verification and resume lands on the older good snapshot."""
+    wf = _StubWorkflow()
+    snaps = tmp_path / "snaps"
+    wf.snapshotter = _StubSnapshotter(snaps)
+    good = Snapshotter.write({"good": True}, str(snaps), "stub", "e1")
+    time.sleep(0.02)
+    root.common.engine.faults = {"checkpoint.signal_corrupt": True}
+    supv = sup.WorkerSupervisor(wf, directory=str(tmp_path / "hb"),
+                                process_index=0, process_count=1)
+    supv.attach()
+    wf.on_step_boundary()
+    supv.request_preempt("preempt with corruption")
+    with pytest.raises(sup.Preempted) as err:
+        wf.on_step_boundary()
+    bad = err.value.snapshot_path
+    # the newest-good picker skips the corrupt file...
+    assert sup.newest_good_snapshot(str(snaps), "stub") == good
+    # ...and the digest-verified loader falls back to it too
+    assert Snapshotter.load(bad) == {"good": True}
+    supv.detach()
+
+
+# ----------------------------------------------------------------------
+# satellite: process-0-only snapshot/publish writes + sidecar fence
+# ----------------------------------------------------------------------
+def _patch_process_info(monkeypatch, local):
+    """Thread-keyed (index, count) so one test process can play both
+    gang members concurrently."""
+    from znicz_tpu.parallel import process_shard
+
+    def fake_process_info():
+        return getattr(local, "info", (0, 1))
+
+    monkeypatch.setattr(process_shard, "process_info", fake_process_info)
+    return fake_process_info
+
+
+def test_snapshot_write_single_writer_under_two_processes(
+        tmp_path, monkeypatch):
+    """ISSUE 14 satellite: a 2-process lockstep gang calling
+    ``Snapshotter.write`` everywhere produces EXACTLY ONE complete
+    artifact — process 1 fences on the sidecar and never writes."""
+    local = threading.local()
+    _patch_process_info(monkeypatch, local)
+    root.common.engine.snapshot_fence_timeout_s = 20.0
+    state = {"w": list(range(1000))}
+    results = {}
+
+    def nonmaster():
+        local.info = (1, 2)
+        t0 = time.monotonic()
+        results["path1"] = Snapshotter.write(
+            state, str(tmp_path), "gang", "e1")
+        results["fence_s"] = time.monotonic() - t0
+
+    fencer = threading.Thread(target=nonmaster)
+    fencer.start()
+    time.sleep(0.3)  # the fence must actually wait for the master
+    assert fencer.is_alive(), "non-master wrote without fencing"
+    local.info = (0, 2)
+    path0 = Snapshotter.write(state, str(tmp_path), "gang", "e1")
+    fencer.join(timeout=30)
+    assert not fencer.is_alive()
+    assert results["path1"] == path0
+    assert results["fence_s"] >= 0.25
+    # exactly one artifact, untorn: digest verifies, content loads
+    files = [f for f in os.listdir(tmp_path) if f.endswith(".pickle.gz")]
+    assert files == ["gang_e1.pickle.gz"]
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    digest = open(path0 + ".sha256").read().strip()
+    assert hashlib.sha256(open(path0, "rb").read()).hexdigest() == digest
+    assert Snapshotter.load(path0) == state
+
+
+def test_snapshot_fence_times_out_with_actionable_error(
+        tmp_path, monkeypatch):
+    local = threading.local()
+    _patch_process_info(monkeypatch, local)
+    local.info = (1, 2)
+    root.common.engine.snapshot_fence_timeout_s = 0.2
+    with pytest.raises(OSError, match="fence"):
+        Snapshotter.write({}, str(tmp_path), "gang", "never")
+
+
+def test_publish_bundle_single_writer_under_two_processes(
+        tmp_path, monkeypatch):
+    local = threading.local()
+    _patch_process_info(monkeypatch, local)
+    from znicz_tpu import export as export_mod
+    from znicz_tpu.resilience import publisher as pub
+
+    writes = []
+
+    def fake_export(workflow, path):
+        time.sleep(0.2)  # a real export is not instant — widen the race
+        with open(path, "wb") as fh:
+            fh.write(b"bundle-bytes-" + str(workflow).encode())
+        writes.append(path)
+
+    monkeypatch.setattr(export_mod, "export_forward", fake_export)
+    results = {}
+
+    def nonmaster():
+        local.info = (1, 2)
+        results["fence"] = pub.publish_bundle("wf", str(tmp_path),
+                                              prefix="m")
+
+    fencer = threading.Thread(target=nonmaster)
+    fencer.start()
+    time.sleep(0.05)
+    local.info = (0, 2)
+    version, path = pub.publish_bundle("wf", str(tmp_path), prefix="m")
+    fencer.join(timeout=30)
+    assert not fencer.is_alive()
+    assert (version, path) == results["fence"] == (
+        1, os.path.join(str(tmp_path), "m_v000001.npz"))
+    assert len(writes) == 1, "non-master exported a bundle"
+    digest = open(path + ".sha256").read().strip()
+    assert hashlib.sha256(open(path, "rb").read()).hexdigest() == digest
+
+
+# ----------------------------------------------------------------------
+# satellite: bounded jax.distributed bring-up
+# ----------------------------------------------------------------------
+def test_ensure_initialized_timeout_retry_backoff(monkeypatch):
+    import jax
+
+    from znicz_tpu.parallel import distributed
+    calls = []
+    sleeps = []
+
+    def fake_initialize(**kwargs):
+        calls.append(kwargs)
+        raise RuntimeError("connect to coordinator failed (injected)")
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_initialize)
+    monkeypatch.setattr(jax.distributed, "shutdown", lambda: None)
+    import time as time_mod
+    monkeypatch.setattr(time_mod, "sleep",
+                        lambda s: sleeps.append(s))
+    monkeypatch.setattr(distributed, "_initialized", False)
+    root.common.engine.dist_init_retries = 2
+    root.common.engine.dist_init_backoff_s = 0.5
+    with pytest.raises(RuntimeError) as err:
+        distributed.ensure_initialized(
+            coordinator="10.0.0.99:1", num_processes=2, process_id=1,
+            timeout_s=7)
+    msg = str(err.value)
+    # actionable: names the spec, the env contract and the knob
+    assert "10.0.0.99:1" in msg and "ZNICZ_COORDINATOR" in msg
+    assert "dist_init_timeout_s" in msg and "3 attempt" in msg
+    assert len(calls) == 3
+    assert all(c["initialization_timeout"] == 7 for c in calls)
+    assert sleeps == [0.5, 1.0]  # exponential backoff between retries
+    assert not distributed._initialized
+
+
+def test_ensure_initialized_no_spec_is_noop(monkeypatch):
+    from znicz_tpu.parallel import distributed
+    monkeypatch.setattr(distributed, "_initialized", False)
+    monkeypatch.delenv("ZNICZ_COORDINATOR", raising=False)
+    assert distributed.ensure_initialized() is False
+
+
+# ----------------------------------------------------------------------
+# gang supervisor on stub workers (no jax → fast tier)
+# ----------------------------------------------------------------------
+_STUB = """\
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+from znicz_tpu.resilience import supervisor as sup
+pid = int(os.environ["ZNICZ_PROCESS_ID"])
+attempt = int(os.environ["ZNICZ_ELASTIC_ATTEMPT"])
+hb_dir = os.environ["ZNICZ_HEARTBEAT_DIR"]
+mode = os.environ.get("STUB_MODE", "ok")
+w = sup.HeartbeatWriter(hb_dir, pid, interval_s=0.05).start()
+w.annotate(resumed_step=7 if attempt else 0)
+for step in range(1, 7):
+    w.beat(step)
+    time.sleep(0.05)
+    if mode == "die" and pid == 1 and step == 3:
+        os._exit(1)
+    if mode == "preempt" and step == 3:
+        sup.request_preempt_flag(hb_dir, step + 1, 1, "stub preempt")
+        w.annotate(checkpoint_on_signal=1)
+        w.stop()
+        os._exit(sup.EXIT_PREEMPTED)
+    if mode == "stall" and pid == 1:
+        w.freeze()
+        time.sleep(60)
+    if mode == "stall" and pid == 0 and step == 4:
+        # the victim: blocked in the dead peer's collective until its
+        # watchdog exits it
+        time.sleep(1.2)
+        os._exit(sup.EXIT_PEER_LOST)
+w.stop()
+"""
+
+
+def _stub_supervisor(tmp_path, mode, n=2, **kwargs):
+    stub = tmp_path / "stub_worker.py"
+    stub.write_text(_STUB.format(repo=REPO))
+
+    def argv_for(pid, n_procs, attempt):
+        return [sys.executable, str(stub)]
+
+    defaults = dict(
+        n_processes=n, work_dir=str(tmp_path / "work"),
+        snapshot_dir=str(tmp_path / "snaps"),
+        heartbeat_timeout_s=2.0, stall_timeout_s=1.0,
+        start_grace_s=30.0, poll_interval_s=0.05, drain_s=5.0,
+        max_restarts=2, fault_env={"STUB_MODE": mode})
+    defaults.update(kwargs)
+    return sup.ElasticSupervisor(argv_for, **defaults)
+
+
+def test_gang_clean_run_no_restarts(tmp_path):
+    summary = _stub_supervisor(tmp_path, "ok").run()
+    assert summary["ok"] and summary["restarts"] == 0
+    assert summary["losses"] == {} and summary["final_processes"] == 2
+
+
+def test_gang_host_loss_restarts_on_survivors(tmp_path):
+    before = obs_metrics.host_losses("loss").value
+    restarts_before = obs_metrics.elastic_restarts().value
+    summary = _stub_supervisor(tmp_path, "die").run()
+    assert summary["ok"] and summary["restarts"] == 1
+    assert summary["losses"] == {"loss": 1}
+    assert summary["final_processes"] == 1
+    # attempt-1 stubs annotated their resume position; the supervisor
+    # folded it into its own registry story
+    assert summary["resumed_step"] == 7
+    assert obs_metrics.host_losses("loss").value == before + 1
+    assert obs_metrics.elastic_restarts().value == restarts_before + 1
+
+
+def test_gang_preemption_only_requester_is_lost(tmp_path):
+    """Both gang members drain through the barrier and exit 75; ONLY
+    the requester host is gone — the drained peer rejoins the smaller
+    gang."""
+    before = obs_metrics.host_losses("preempt").value
+    cps_before = obs_metrics.checkpoint_on_signal().value
+    summary = _stub_supervisor(tmp_path, "preempt").run()
+    assert summary["ok"] and summary["restarts"] == 1
+    assert summary["losses"] == {"preempt": 1}
+    assert summary["final_processes"] == 1
+    assert obs_metrics.host_losses("preempt").value == before + 1
+    # both members checkpointed (fenced) — folded from the channel
+    assert obs_metrics.checkpoint_on_signal().value == cps_before + 2
+
+
+def test_gang_stall_culprit_detected_victim_rejoins(tmp_path):
+    before = obs_metrics.host_losses("stall").value
+    summary = _stub_supervisor(tmp_path, "stall").run()
+    assert summary["ok"] and summary["restarts"] == 1
+    assert summary["losses"] == {"stall": 1}
+    assert summary["final_processes"] == 1
+    assert obs_metrics.host_losses("stall").value == before + 1
+
+
+def test_readyz_folds_heartbeat_ages(tmp_path):
+    """Satellite: /readyz on process 0 folds per-process heartbeat
+    ages — report-only by default, not-ready past
+    ``engine.ready_max_heartbeat_s``."""
+    from znicz_tpu.web_status import WebStatusServer
+
+    mon = sup.HeartbeatMonitor(str(tmp_path), 2, timeout_s=5.0)
+    mon.register_gauges()
+    now = time.time()
+    sup._atomic_write_json(sup.heartbeat_path(str(tmp_path), 0),
+                           {"process": 0, "step": 9, "time": now})
+    sup._atomic_write_json(sup.heartbeat_path(str(tmp_path), 1),
+                           {"process": 1, "step": 4,
+                            "time": now - 120.0})
+    server = WebStatusServer(port=0)
+    try:
+        report = server.readiness()
+        assert report["processes"]["0"]["heartbeat_age_s"] < 5.0
+        assert report["processes"]["1"]["heartbeat_age_s"] > 100.0
+        # unset threshold = report-only: the stale peer adds no reason
+        assert not [r for r in report["reasons"] if "heartbeat" in r]
+        json.dumps(report)  # the body must stay JSON-serializable
+        root.common.engine.ready_max_heartbeat_s = 30.0
+        report = server.readiness()
+        assert not report["ready"]
+        assert any("heartbeat" in r and "process 1" in r
+                   for r in report["reasons"]), report["reasons"]
+    finally:
+        server.stop()
+
+
+def test_launcher_sigterm_routes_to_preempt_not_emergency():
+    """With a WorkerSupervisor attached, SIGTERM must request the
+    barriered checkpoint-on-signal (deferred to the next step
+    boundary) instead of the legacy immediate emergency snapshot; a
+    second signal still hard-exits."""
+    import signal as signal_mod
+
+    from znicz_tpu.launcher import Launcher
+
+    launcher = Launcher(backend="numpy")
+    preempts = []
+
+    class StubSup:
+        def request_preempt(self, reason):
+            preempts.append(reason)
+
+    class StubWf:
+        name = "stub"
+
+        def __init__(self):
+            self.stops = 0
+
+        def stop(self):
+            self.stops += 1
+
+    wf = StubWf()
+    launcher._worker_supervisor = StubSup()
+    launcher._install_signal_handlers(wf)
+    try:
+        os.kill(os.getpid(), signal_mod.SIGTERM)
+        time.sleep(0.05)  # delivery at the next bytecode boundary
+        assert preempts == [f"signal {int(signal_mod.SIGTERM)}"]
+        assert wf.stops == 0, "legacy emergency-stop path also ran"
+        with pytest.raises(KeyboardInterrupt):
+            os.kill(os.getpid(), signal_mod.SIGTERM)
+            time.sleep(1.0)
+    finally:
+        launcher._restore_signal_handlers()
+
+
+def test_newest_good_snapshot_skips_corrupt(tmp_path):
+    a = Snapshotter.write({"v": 1}, str(tmp_path), "s", "a")
+    time.sleep(0.02)
+    b = Snapshotter.write({"v": 2}, str(tmp_path), "s", "b")
+    assert sup.newest_good_snapshot(str(tmp_path), "s") == b
+    with open(b, "r+b") as fh:  # corrupt the newest post-digest
+        fh.write(b"XXXX")
+    assert sup.newest_good_snapshot(str(tmp_path), "s") == a

@@ -67,6 +67,11 @@ class Launcher(Logger):
                  n_seq: int = 1,
                  **kwargs) -> None:
         super().__init__(**kwargs)
+        if snapshot is None:
+            # elastic restart contract (round 18): the gang supervisor
+            # hands the relaunched workers the newest digest-verified
+            # snapshot through the env so the SAME command line resumes
+            snapshot = os.environ.get("ZNICZ_RESUME_SNAPSHOT") or None
         #: model-axis size for the global mesh (tensor parallelism over
         #: the distributed device grid; 1 = pure DP)
         self.n_model = int(n_model)
@@ -92,6 +97,9 @@ class Launcher(Logger):
         self._graphics = graphics
         self._interrupted = False
         self._old_handlers: dict[int, Any] = {}
+        #: round 18: in-process elastic supervision (attached by
+        #: run_workflow when the heartbeat channel is configured)
+        self._worker_supervisor = None
         # distributed mode ------------------------------------------------
         if listen and master:
             raise ValueError("--listen and --master are exclusive")
@@ -255,6 +263,18 @@ class Launcher(Logger):
         if self._snapshot_state is not None:
             workflow.load_state(self._snapshot_state)
             self._snapshot_state = None
+        # round 18: elastic supervision — ZNICZ_HEARTBEAT_DIR (or
+        # engine.heartbeat_dir) attaches the per-process heartbeat
+        # writer, the preemption handler (SIGTERM → barriered
+        # checkpoint-on-signal at the next step boundary) and the
+        # collective-hang self-watchdog; process 0 additionally feeds
+        # the peer-age gauges /metrics + /readyz expose
+        from znicz_tpu.resilience import supervisor as _supervisor
+        sup_cfg = _supervisor.worker_config()
+        if sup_cfg is not None and self._worker_supervisor is None:
+            self._worker_supervisor = _supervisor.WorkerSupervisor(
+                workflow, is_master=self.is_master, **sup_cfg)
+            self._worker_supervisor.attach()
         self._install_signal_handlers(workflow)
         try:
             if self.chunk > 1 and hasattr(workflow, "run_chunked"):
@@ -266,6 +286,9 @@ class Launcher(Logger):
             raise
         finally:
             self._restore_signal_handlers()
+            if self._worker_supervisor is not None:
+                self._worker_supervisor.detach()
+                self._worker_supervisor = None
         return workflow
 
     # ------------------------------------------------------------------
@@ -275,6 +298,16 @@ class Launcher(Logger):
         def handler(signum, frame):
             if self._interrupted:  # second signal: hard exit
                 raise KeyboardInterrupt
+            supervisor = self._worker_supervisor
+            if supervisor is not None and signum == signal.SIGTERM:
+                # round 18 preemption path: defer to the NEXT step
+                # boundary — the whole gang checkpoints at the same
+                # barrier step (master writes, others fence on the
+                # sidecar) and exits EXIT_PREEMPTED, losing at most
+                # the one in-flight step.  Signal-safe: one flag file.
+                self._interrupted = True
+                supervisor.request_preempt(f"signal {signum}")
+                return
             self._interrupted = True
             self.warning("signal %d: emergency snapshot + stop", signum)
             self._emergency_snapshot(workflow)

@@ -707,6 +707,20 @@ class StreamingLoader(Loader):
         # the in-flight prefetch belongs to the pre-restore trajectory;
         # the first post-resume take() restarts at the restored cursor
         self._stop_pipeline()
+        # round 18 (elastic restart): the global schedule is counter-
+        # based, so a snapshot written by an N-process gang restores
+        # onto ANY surviving process count — the 1/N slice is re-derived
+        # here from the LIVE topology, never from the snapshot.  The
+        # operator-facing log is what a restart drill greps for.
+        if self._pcount > 1 or os.environ.get("ZNICZ_HEARTBEAT_DIR"):
+            lb = self.local_batch
+            self.info(
+                "resumed at epoch %d cursor %d — re-sliced to rows "
+                "[%d, %d) of every %d-row global minibatch "
+                "(process %d/%d)", int(self.epoch_number),
+                int(self._cursor), self._pidx * lb,
+                (self._pidx + 1) * lb, self.max_minibatch_size,
+                self._pidx, self._pcount)
 
     def _stop_pipeline(self) -> None:
         self._held = None

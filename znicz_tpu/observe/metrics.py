@@ -961,3 +961,44 @@ def fleet_ladder_evictions(fleet: str, model: str) -> Counter:
         "znicz_fleet_ladder_evictions_total",
         "Bucket programs evicted by the shared fleet ladder budget",
         labels=("fleet", "model")).labels(fleet=fleet, model=model)
+
+
+# -- elastic multi-host supervision (round 18) -------------------------
+def heartbeat_age_seconds(process) -> Gauge:
+    """Seconds since process ``process`` last beat into the heartbeat
+    channel (callback gauge fed by the coordinator-side
+    ``HeartbeatMonitor`` — /metrics and /readyz read peer liveness
+    from the same series).  ``inf`` renders as ``+Inf`` when a peer
+    has never beaten."""
+    return REGISTRY.gauge(
+        "znicz_heartbeat_age_seconds",
+        "Seconds since each process's last heartbeat",
+        labels=("process",)).labels(process=process)
+
+
+def host_losses(kind: str) -> Counter:
+    """Processes the elastic supervisor declared gone, by kind:
+    ``loss`` (died / heartbeat stale), ``stall`` (wall-clock beats
+    flow, step counter frozen — hung collective), ``preempt``
+    (checkpoint-on-signal drain + EXIT_PREEMPTED)."""
+    return REGISTRY.counter(
+        "znicz_host_losses_total",
+        "Hosts lost to the elastic supervisor by kind",
+        labels=("kind",)).labels(kind=kind)
+
+
+def elastic_restarts() -> Counter:
+    """Gang relaunches onto the surviving host set (each one implies a
+    reshard-resume from the newest digest-verified snapshot)."""
+    return REGISTRY.counter(
+        "znicz_elastic_restarts_total",
+        "Elastic gang restarts onto the surviving mesh")._solo()
+
+
+def checkpoint_on_signal() -> Counter:
+    """Barriered preemption checkpoints completed (worker-side; the
+    gang supervisor folds worker heartbeat attestations into its own
+    registry under the same name)."""
+    return REGISTRY.counter(
+        "znicz_checkpoint_on_signal_total",
+        "Preemption-triggered barriered checkpoints")._solo()

@@ -95,6 +95,10 @@ class DecisionBase(Unit):
             return
         if _metrics.enabled():
             _metrics.last_step_timestamp(wf.name).set(time.time())
+        if getattr(wf, "_step_hooks", None):
+            # round 18: the elastic WorkerSupervisor's heartbeat /
+            # preemption service point — one list check when detached
+            wf.on_step_boundary()
         guard = getattr(wf, "anomaly_guard", None)
         if guard is None or not guard.is_initialized:
             return

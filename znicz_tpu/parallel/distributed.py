@@ -47,17 +47,33 @@ def env_spec() -> dict | None:
 
 def ensure_initialized(coordinator: str | None = None,
                        num_processes: int | None = None,
-                       process_id: int | None = None) -> bool:
-    """Idempotent ``jax.distributed.initialize``.
+                       process_id: int | None = None,
+                       timeout_s: float | None = None) -> bool:
+    """Idempotent ``jax.distributed.initialize`` with a bring-up
+    deadline.
 
     Explicit arguments win; otherwise the env contract above is
     consulted.  Returns True when this process is part of an
     initialized multi-process runtime (including when a caller
     already initialized it), False when nothing requested distributed
     mode — callers can branch mesh construction on the result.
+
+    Round 18 (elastic): bring-up is bounded instead of hanging
+    forever on a wrong ``ZNICZ_COORDINATOR`` or a missing peer —
+    ``engine.dist_init_timeout_s`` (default 300 s; the ``timeout_s``
+    argument overrides) caps each attempt,
+    ``engine.dist_init_retries`` (default 2) extra attempts run with
+    ``engine.dist_init_backoff_s`` (default 2 s, doubling) between
+    them, and final failure raises a RuntimeError naming the exact
+    spec and the usual causes.  An elastic restart re-invokes this in
+    the relaunched gang with the surviving host set (smaller
+    ``ZNICZ_NUM_PROCESSES``, renumbered ids) — the partition table
+    then re-resolves every placement onto the smaller mesh.
     """
     global _initialized
     import jax
+
+    from znicz_tpu.utils.config import root
 
     if _initialized:
         return True
@@ -77,9 +93,60 @@ def ensure_initialized(coordinator: str | None = None,
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except (AttributeError, ValueError):  # pragma: no cover - old jax
         pass
-    jax.distributed.initialize(**spec)
-    _initialized = True
-    return True
+    engine = root.common.engine
+    timeout = float(timeout_s if timeout_s is not None
+                    else engine.get("dist_init_timeout_s", 300.0))
+    retries = int(engine.get("dist_init_retries", 2))
+    backoff = float(engine.get("dist_init_backoff_s", 2.0))
+    last_exc: Exception | None = None
+    for attempt in range(retries + 1):
+        try:
+            try:
+                jax.distributed.initialize(
+                    initialization_timeout=max(1, int(timeout)), **spec)
+            except TypeError:  # pragma: no cover - jax without the kwarg
+                jax.distributed.initialize(**spec)
+            _initialized = True
+            return True
+        except (TypeError, ValueError):
+            raise  # a bad spec never fixes itself — fail loudly now
+        except Exception as exc:  # timeout / connection refused / ...
+            last_exc = exc
+            try:  # release a half-bound coordinator before retrying
+                jax.distributed.shutdown()
+            except Exception:
+                pass
+            if attempt < retries:
+                import time as _time
+                wait = backoff * (2 ** attempt)
+                _time.sleep(wait)
+    raise RuntimeError(
+        f"jax.distributed bring-up failed after {retries + 1} "
+        f"attempt(s) of {timeout:.0f}s each: {last_exc}.  Spec: "
+        f"coordinator={spec.get('coordinator_address')!r}, "
+        f"num_processes={spec.get('num_processes')}, "
+        f"process_id={spec.get('process_id')}.  Check that (a) every "
+        f"process exports the SAME {ENV_COORDINATOR} (host:port of "
+        f"process 0) and a distinct {ENV_PROCESS_ID} in "
+        f"[0, {ENV_NUM_PROCESSES}), (b) process 0 is actually running "
+        f"and its port is reachable from this host, and (c) no stale "
+        f"process from a previous gang still holds the port.  Raise "
+        f"engine.dist_init_timeout_s for slow pod bring-up."
+        ) from last_exc
+
+
+def shutdown() -> None:
+    """Tear down the distributed runtime (best effort) so a fresh
+    :func:`ensure_initialized` can bring up a new gang — the elastic
+    supervisor's relaunched workers are new OS processes, but tests
+    and notebook drivers re-enter in-process."""
+    global _initialized
+    import jax
+    try:
+        if _initialized:
+            jax.distributed.shutdown()
+    finally:
+        _initialized = False
 
 
 def process_info() -> tuple[int, int]:

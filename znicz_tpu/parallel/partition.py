@@ -247,6 +247,11 @@ class PartitionTable:
             default_rules() if defaults is None else defaults)
         #: bound leaves: path → ResolvedPartition (audit + metrics)
         self.leaves: dict[str, ResolvedPartition] = {}
+        #: axis sizes of the mesh the leaves last resolved against
+        #: (round 18: elastic restarts attest that the SAME table
+        #: re-resolved every placement onto the surviving — smaller —
+        #: mesh; the rules are mesh-independent, this record is not)
+        self.bound_mesh: dict[str, int] | None = None
 
     # -- authoring ------------------------------------------------------
     @property
@@ -313,6 +318,10 @@ class PartitionTable:
             self._publish()
             return prior
         n_data = getattr(device, "n_data_shards", 1)
+        mesh = getattr(device, "mesh", None)
+        if mesh is not None:
+            self.bound_mesh = {ax: int(n) for ax, n in
+                               zip(mesh.axis_names, mesh.devices.shape)}
         resolved = self.resolve(path, vec.shape, n_data=n_data)
         _validate_structure(vec, resolved)
         resolved.apply_to(vec)

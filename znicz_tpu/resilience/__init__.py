@@ -21,6 +21,15 @@ equivalent substrate, wired through every layer of the modern stack:
   :mod:`znicz_tpu.serving`;
 - snapshot retention + digest-verified load lives in
   :mod:`znicz_tpu.utils.snapshotter`;
+- :mod:`znicz_tpu.resilience.supervisor` — round 18: elastic
+  multi-host supervision — per-process heartbeats into a
+  coordinator-visible channel, the coordinator-side liveness monitor,
+  SIGTERM/preemption → barriered checkpoint-on-signal (master writes
+  the sha256-sidecar snapshot, peers fence on the sidecar), the
+  collective-hang self-watchdog, and the
+  :class:`~znicz_tpu.resilience.supervisor.ElasticSupervisor` gang
+  owner that restarts training on the surviving mesh from the newest
+  digest-verified snapshot;
 - :mod:`znicz_tpu.resilience.publisher` — round 13: the train-to-serve
   handoff control plane: digest-sidecar bundle publication, the
   serving-side :class:`~znicz_tpu.resilience.publisher.PublicationWatcher`
@@ -48,4 +57,15 @@ from znicz_tpu.resilience.publisher import (  # noqa: F401
     WeightPublisher,
     classifier_score,
     publish_bundle,
+)
+from znicz_tpu.resilience.supervisor import (  # noqa: F401
+    EXIT_PEER_LOST,
+    EXIT_PREEMPTED,
+    ElasticSupervisor,
+    HeartbeatMonitor,
+    HeartbeatWriter,
+    PeerLost,
+    Preempted,
+    WorkerSupervisor,
+    newest_good_snapshot,
 )

@@ -105,6 +105,29 @@ SITES = {
         "sha256 digest — the registry must QUARANTINE it and fall "
         "back to the newest older good version instead of handing "
         "corrupt bytes to a loader",
+    "host.loss":
+        "a training step boundary hard-kills this process (os._exit, "
+        "no drain, no snapshot) as if the host vanished — filter with "
+        "{'process': i}; the elastic supervisor must detect the loss "
+        "(child exit / heartbeat timeout), reap the stranded gang, and "
+        "restart on the surviving mesh from the newest good snapshot",
+    "host.preempt":
+        "a step boundary receives a simulated preemption notice "
+        "(SIGTERM semantics): the worker supervisor requests the "
+        "barriered checkpoint-on-signal and the whole gang exits "
+        "EXIT_PREEMPTED after process 0's sha256 sidecar lands — "
+        "filter with {'process': i}",
+    "heartbeat.stall":
+        "the heartbeat writer freezes its step counter while "
+        "wall-clock beats continue and the step blocks for payload "
+        "'sleep_s' (default 3600) — a hung collective's exact "
+        "signature; the monitor must declare the process stalled "
+        "within the stall timeout",
+    "checkpoint.signal_corrupt":
+        "the checkpoint-on-signal bytes are corrupted AFTER the "
+        "sidecar digest is computed — resume must reject the file on "
+        "digest verification and fall back to the newest older good "
+        "snapshot",
     "fleet.replica_loss":
         "FleetEngine.tick kills one live replica of payload 'model' "
         "(default the first model) mid-traffic — routing must steer "

@@ -102,6 +102,64 @@ def published_versions(directory: str,
     return out
 
 
+def _fence_publish(directory: str, prefix: str,
+                   entry: list[tuple[int, str]]) -> tuple[int, str]:
+    """Non-master half of a lockstep multi-process publish: wait for
+    process 0's new version to land COMPLETE (its ``.sha256`` sidecar
+    exists — the sidecar is written strictly after the atomic data
+    replace).  ``entry`` is the version listing at call entry; the
+    fence is satisfied by any complete version newer than the newest
+    complete one at entry, or by the entry-newest itself when its
+    sidecar is FRESH (process 0 finished before this process arrived
+    at the lockstep site).  Bounded by
+    ``engine.publish_fence_timeout_s`` (default 60 s); on timeout the
+    newest complete version is returned with a warning rather than
+    stranding the gang."""
+    import logging
+    import time as _time
+
+    from znicz_tpu.utils.config import root
+
+    log = logging.getLogger("publisher")
+    entry_wall = _time.time()
+    entry_complete = max(
+        (v for v, p in entry if os.path.exists(f"{p}.sha256")),
+        default=0)
+    timeout = float(root.common.engine.get("publish_fence_timeout_s",
+                                           60.0))
+    deadline = _time.monotonic() + timeout
+
+    def newest_complete() -> tuple[int, str] | None:
+        done = [(v, p) for v, p in published_versions(directory, prefix)
+                if os.path.exists(f"{p}.sha256")]
+        return done[-1] if done else None
+
+    while True:
+        got = newest_complete()
+        if got is not None:
+            version, path = got
+            try:
+                side_mtime = os.path.getmtime(f"{path}.sha256")
+            except OSError:
+                side_mtime = 0.0
+            if version > entry_complete \
+                    or side_mtime >= entry_wall - 2.0:
+                return version, path
+        if _time.monotonic() >= deadline:
+            if got is not None:
+                log.warning(
+                    "publish fence in %s timed out after %.0fs — "
+                    "returning the newest complete version v%d",
+                    directory, timeout, got[0])
+                return got
+            raise OSError(
+                f"publish fence in {directory} timed out after "
+                f"{timeout:.0f}s with no complete version — process 0 "
+                f"never published (shared filesystem not mounted on "
+                f"every host, or the master publish failed)")
+        _time.sleep(0.02)
+
+
 def publish_bundle(workflow, directory: str,
                    prefix: str = "model") -> tuple[int, str]:
     """Export ``workflow``'s trained forward chain into the handoff
@@ -115,9 +173,15 @@ def publish_bundle(workflow, directory: str,
     site flips bytes AFTER the digest is computed, producing exactly
     the torn-publish failure the watcher must reject."""
     from znicz_tpu.export import export_forward
+    from znicz_tpu.parallel.process_shard import process_info
     from znicz_tpu.utils.snapshotter import _sha256_file
     os.makedirs(directory, exist_ok=True)
     existing = published_versions(directory, prefix)
+    pidx, pcount = process_info()
+    if pcount > 1 and pidx != 0:
+        # round 18: only process 0 writes shared publish artifacts —
+        # the rest fence on the new version's digest sidecar appearing
+        return _fence_publish(directory, prefix, existing)
     version = (existing[-1][0] + 1) if existing else 1
     final = os.path.join(directory, f"{prefix}_v{version:06d}.npz")
     tmp = f"{final}.{os.getpid()}.staging"

@@ -193,14 +193,73 @@ class Snapshotter(Unit):
                        keep=path)
 
     @staticmethod
+    def _fence_on_sidecar(path: str, entry_mtime: float | None,
+                          timeout_s: float) -> str:
+        """Round-18 multi-process write discipline: non-zero processes
+        never write shared artifacts — they FENCE on process 0's
+        ``.sha256`` sidecar appearing (the sidecar lands strictly
+        after the data replace, so its arrival proves a complete
+        file).  ``entry_mtime`` is the sidecar's mtime before the
+        fence (None = absent): a pre-existing sidecar only satisfies
+        the fence once its mtime moves — or when it is FRESH (written
+        within 2 s of fence entry, i.e. process 0 simply finished
+        before this process arrived at the lockstep site) — so a
+        stale same-name artifact from an earlier run cannot fake
+        completion."""
+        log = logging.getLogger("Snapshotter")
+        sidecar = f"{path}.sha256"
+        entry_wall = time.time()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            try:
+                mtime = os.path.getmtime(sidecar)
+            except OSError:
+                mtime = None
+            if mtime is not None and (entry_mtime is None
+                                      or mtime > entry_mtime
+                                      or mtime >= entry_wall - 2.0):
+                return path
+            time.sleep(0.02)
+        if os.path.exists(sidecar) and os.path.exists(path):
+            log.warning(
+                "sidecar fence on %s timed out after %.0fs but the "
+                "artifact exists — accepting the (possibly stale) "
+                "file", path, timeout_s)
+            return path
+        raise OSError(
+            f"sidecar fence on {path} timed out after {timeout_s:.0f}s "
+            f"— process 0 never completed the write (shared filesystem "
+            f"not mounted on every host, or the master write failed)")
+
+    @staticmethod
     def write(state: dict, directory: str, prefix: str,
               suffix: str) -> str:
         """Atomic ``<prefix>_<suffix>.pickle.gz`` state write — the one
-        serialization point (the launcher's emergency snapshots and the
-        periodic unit both use it).  Leaves a ``.sha256`` sidecar whose
-        digest :meth:`load` verifies before trusting the file."""
+        serialization point (the launcher's emergency snapshots, the
+        periodic unit and the elastic checkpoint-on-signal all use
+        it).  Leaves a ``.sha256`` sidecar whose digest :meth:`load`
+        verifies before trusting the file.
+
+        Multi-process discipline (round 18): ONLY process 0 writes —
+        a call on any other process fences on the sidecar appearing
+        (``engine.snapshot_fence_timeout_s``, default 120 s) and
+        returns the same path, so a lockstep gang calling ``write``
+        everywhere can never produce a torn or double-written
+        snapshot."""
         os.makedirs(directory, exist_ok=True)
         path = os.path.join(directory, f"{prefix}_{suffix}.pickle.gz")
+        from znicz_tpu.parallel.process_shard import process_info
+        pidx, pcount = process_info()
+        if pcount > 1 and pidx != 0:
+            sidecar = f"{path}.sha256"
+            try:
+                entry_mtime = os.path.getmtime(sidecar)
+            except OSError:
+                entry_mtime = None
+            return Snapshotter._fence_on_sidecar(
+                path, entry_mtime,
+                float(root.common.engine.get(
+                    "snapshot_fence_timeout_s", 120.0)))
         # per-process tmp: concurrent writers on a shared fs (defense
         # in depth — run() already single-writes) must not truncate
         # each other's in-progress stream before the atomic replace

@@ -22,7 +22,11 @@ serving queue age, last-step staleness; 503 while any ENGINE sheds
 load — a fleet tenant's own breaker opening is NOT an engine outage:
 it sheds exactly that tenant and is reported per tenant, never
 flipping the process probe) so external supervisors can probe
-training and every resident serving engine at once.
+training and every resident serving engine at once.  Round 18:
+``/readyz`` on process 0 additionally folds per-process heartbeat
+ages from ``znicz_heartbeat_age_seconds`` (aggregate pod health —
+a stale peer makes the pod not ready past
+``engine.ready_max_heartbeat_s``, unset = report-only).
 """
 
 from __future__ import annotations
@@ -253,6 +257,25 @@ class WebStatusServer(Logger):
                 if max_stale is not None and stale > float(max_stale):
                     not_ready(f"workflow {workflow} last step "
                               f"{stale:.0f}s ago")
+        # round 18: aggregate pod health — per-process heartbeat ages
+        # (fed by the coordinator-side HeartbeatMonitor from the
+        # shared channel).  A stale peer makes the POD not ready when
+        # engine.ready_max_heartbeat_s is set (unset = report-only:
+        # single-host runs and gang supervisors that own restarts
+        # themselves must not flip this process's probe).
+        fam = metrics.REGISTRY.get("znicz_heartbeat_age_seconds")
+        max_hb = root.common.engine.get("ready_max_heartbeat_s", None)
+        if fam is not None:
+            out["processes"] = {}
+            for key, child in fam.items():
+                (process,) = key
+                age = float(child.value)
+                out["processes"][process] = {
+                    "heartbeat_age_s": (None if age == float("inf")
+                                        else round(age, 3))}
+                if max_hb is not None and age > float(max_hb):
+                    not_ready(f"process {process} heartbeat "
+                              f"{age:.0f}s stale")
         fam = metrics.REGISTRY.get("znicz_model_version")
         if fam is not None:
             for key, child in fam.items():

@@ -46,6 +46,12 @@ class Workflow(Container):
         self.stopped = Bool(False)
         self._finished = False
         self._max_fires: int | None = None  # safety valve for tests
+        #: step-boundary hooks (round 18): fired by the Decision unit
+        #: once per training step (per chunk under run_chunked) — the
+        #: elastic WorkerSupervisor beats its heartbeat and services
+        #: preemption requests here.  Exceptions propagate (Preempted
+        #: is a SystemExit and must unwind the run loop).
+        self._step_hooks: list = []
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -133,6 +139,25 @@ class Workflow(Container):
         self.stopped.value = True
         for unit in self.units:
             unit.stop()
+
+    # ------------------------------------------------------------------
+    # step-boundary hooks (round 18: elastic supervision)
+    # ------------------------------------------------------------------
+    def add_step_hook(self, fn) -> None:
+        if fn not in self._step_hooks:
+            self._step_hooks.append(fn)
+
+    def remove_step_hook(self, fn) -> None:
+        if fn in self._step_hooks:
+            self._step_hooks.remove(fn)
+
+    def on_step_boundary(self) -> None:
+        """Called by the Decision unit after every step's bookkeeping —
+        the one safe point to heartbeat, poll preemption flags and
+        take a barriered checkpoint (the whole gang reaches the same
+        boundary in lockstep)."""
+        for fn in list(self._step_hooks):
+            fn()
 
     def on_workflow_finished(self) -> None:
         """Hook: after the scheduler drains.  Logs the slowest units
