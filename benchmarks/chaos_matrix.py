@@ -1,0 +1,704 @@
+"""Chaos matrix: sweep EVERY fault site with a 1-event recipe.
+
+Round-19 satellite.  ``resilience.faults.SITES`` is the framework's
+fault vocabulary — and vocabularies rot: a renamed call site, a
+refactored recovery path or a typo'd recipe can turn a site into a
+silent no-op while its name keeps validating.  This tool is the
+anti-rot sweep: for every site it runs the *smallest real harness*
+that exercises the site's code path under a 1-event recipe and asserts
+that the event was (a) INJECTED (``znicz_faults_injected_total`` or,
+for the process-killing sites, the documented exit code) and (b)
+either RECOVERED (a recovery/quarantine/retry counter moved) or
+SURFACED as a counted error — no site may no-op.
+
+Usage::
+
+    python benchmarks/chaos_matrix.py            # sweep everything
+    python benchmarks/chaos_matrix.py loader.%   # glob filter
+    # exits 1 on any failed drill; writes CHAOS_MATRIX.json
+
+The registry below is COMPLETE by construction:
+``tests/test_chaos_matrix.py`` (fast tier) asserts ``DRILLS`` covers
+``SITES`` exactly and that every site name appears as a literal
+``fire("<site>"`` call in the package — adding a site without a drill
+or a call site fails CI immediately.
+
+Process-killing sites (``host.loss`` / ``host.preempt`` /
+``heartbeat.stall``) drill in a stub-worker subprocess (the documented
+exit code IS the surfaced evidence); everything else runs in-process
+against counter deltas.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _pin_cpu() -> None:
+    import jax
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=2").strip()
+    for opt, val in (("jax_platforms", "cpu"),
+                     ("jax_num_cpu_devices", 2)):
+        try:
+            jax.config.update(opt, val)
+        except (RuntimeError, AttributeError):
+            break
+
+
+# ----------------------------------------------------------------------
+# counter-delta helpers
+# ----------------------------------------------------------------------
+def _value(family: str, **labels) -> float:
+    from znicz_tpu.observe import metrics as obs
+    fam = obs.REGISTRY.get(family)
+    if fam is None:
+        return 0.0
+    want = tuple(str(labels[n]) for n in fam.labelnames)
+    for key, child in fam.items():
+        if key == want:
+            return float(child.value)
+    return 0.0
+
+
+class _Deltas:
+    """Snapshot of the counters a drill asserts on."""
+
+    def __init__(self, *specs) -> None:
+        self.specs = specs
+        self.base = [_value(fam, **labels) for fam, labels in specs]
+
+    def __getitem__(self, i: int) -> float:
+        fam, labels = self.specs[i]
+        return _value(fam, **labels) - self.base[i]
+
+
+def _recipe(recipe: dict) -> None:
+    from znicz_tpu.utils.config import root
+    root.common.engine.faults = recipe
+
+
+def _clear_recipe() -> None:
+    from znicz_tpu.utils.config import root
+    root.common.engine.faults = None
+
+
+# ----------------------------------------------------------------------
+# shared harness builders (kept tiny: the drill is the point, not the
+# model)
+# ----------------------------------------------------------------------
+def _tiny_workflow(name: str, snapshot_dir: str | None = None,
+                   max_epochs: int = 2):
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils import prng
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(96, 10)).astype(np.float32)
+    labels = (rng.random(96) * 3).astype(np.int32)
+    prng.seed_all(7)
+    snap = None if snapshot_dir is None else {
+        "directory": snapshot_dir, "prefix": "chaosm"}
+    wf = StandardWorkflow(
+        name=name,
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:72], train_labels=labels[:72],
+            valid_data=data[72:], valid_labels=labels[72:],
+            minibatch_size=12),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 16},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}}],
+        decision_config={"max_epochs": max_epochs},
+        snapshotter_config=snap)
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    return wf
+
+
+def _streaming_workflow(name: str, tmp: str):
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.loader.streaming import StreamingLoader, write_shards
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils import prng
+    from znicz_tpu.utils.config import root
+    root.common.engine.read_backoff_s = 0.01
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 255, size=(128, 8), dtype=np.uint8)
+    labels = (rng.random(128) * 4).astype(np.int32)
+    shards = os.path.join(tmp, "shards")
+    write_shards(shards, data[:96], labels[:96], valid_data=data[96:],
+                 valid_labels=labels[96:], rows_per_shard=24)
+    prng.seed_all(9)
+    wf = StandardWorkflow(
+        name=name,
+        loader_factory=lambda w: StreamingLoader(
+            w, shards, minibatch_size=12, prefetch_depth=2,
+            normalization_scale=1 / 127.5, normalization_bias=-1.0),
+        layers=[{"type": "softmax", "->": {"output_sample_shape": 4},
+                 "<-": {"learning_rate": 0.05}}],
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    return wf
+
+
+_SERVE_BUNDLE: str | None = None
+_PUB_WF = None
+
+
+def _serve_bundle() -> str:
+    """One shared tiny exported classifier for every serving drill
+    (input shape (16,), 5 classes — serve_bench's smoke model)."""
+    global _SERVE_BUNDLE
+    if _SERVE_BUNDLE is None:
+        from benchmarks.serve_bench import train_and_export
+        path = os.path.join(tempfile.mkdtemp(prefix="chaosm_"),
+                            "model.npz")
+        _SERVE_BUNDLE = train_and_export(path, epochs=1)
+    return _SERVE_BUNDLE
+
+
+def _pub_workflow():
+    """One shared TRAINED workflow for the publish/swap drills (the
+    publisher exports from a live workflow)."""
+    global _PUB_WF
+    if _PUB_WF is None:
+        wf = _tiny_workflow("cm_pub", max_epochs=1)
+        wf.run()
+        _PUB_WF = wf
+    return _PUB_WF
+
+
+# ----------------------------------------------------------------------
+# the drills (site → evidence dict; raise/assert on failure)
+# ----------------------------------------------------------------------
+def drill_train_nonfinite_loss() -> dict:
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "train.nonfinite_loss"}),
+                ("znicz_recoveries_total", {"kind": "anomaly_step"}))
+    _recipe({"train.nonfinite_loss": {"at": [3]}})
+    _tiny_workflow("cm_nfl").run()
+    assert d[0] == 1, f"injected {d[0]} != 1"
+    assert d[1] >= 1, "guard never skipped the poisoned step"
+    return {"injected": d[0], "recovered": d[1]}
+
+
+def drill_train_nonfinite_grad() -> dict:
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "train.nonfinite_grad"}),
+                ("znicz_recoveries_total", {"kind": "anomaly_step"}))
+    _recipe({"train.nonfinite_grad": {"at": [4]}})
+    _tiny_workflow("cm_nfg").run()
+    assert d[0] == 1 and d[1] >= 1, (d[0], d[1])
+    return {"injected": d[0], "recovered": d[1]}
+
+
+def drill_sdc_flip_param() -> dict:
+    from znicz_tpu.utils.config import root
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "sdc.flip_param"}),
+                ("znicz_sdc_votes_total",
+                 {"workflow": "cm_flip_p", "verdict": "divergent"}),
+                ("znicz_sdc_detected_total", {"kind": "vote"}))
+    root.common.engine.sdc_vote_interval = 4
+    _recipe({"sdc.flip_param": {"process": 0, "at": [5]}})
+    _tiny_workflow("cm_flip_p").run()
+    root.common.engine.sdc_vote_interval = 50
+    assert d[0] == 1 and d[1] >= 1 and d[2] >= 1, (d[0], d[1], d[2])
+    return {"injected": d[0], "divergent_votes": d[1], "detected": d[2]}
+
+
+def drill_sdc_flip_grad() -> dict:
+    from znicz_tpu.utils.config import root
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "sdc.flip_grad"}),
+                ("znicz_sdc_audits_total",
+                 {"workflow": "cm_flip_g", "verdict": "mismatch"}),
+                ("znicz_sdc_detected_total", {"kind": "audit"}))
+    root.common.engine.sdc_audit_interval = 3
+    _recipe({"sdc.flip_grad": {"process": 0, "after": 4,
+                               "factor": 64.0}})
+    _tiny_workflow("cm_flip_g").run()
+    root.common.engine.sdc_audit_interval = 0
+    assert d[0] == 1 and d[1] >= 1 and d[2] >= 1, (d[0], d[1], d[2])
+    return {"injected": d[0], "audit_mismatches": d[1],
+            "detected": d[2]}
+
+
+def drill_loader_corrupt_shard() -> dict:
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "loader.corrupt_shard"}),
+                ("znicz_recoveries_total",
+                 {"kind": "shard_quarantine"}))
+    _recipe({"loader.corrupt_shard": {"shard": 1, "after": 1}})
+    with tempfile.TemporaryDirectory() as tmp:
+        wf = _streaming_workflow("cm_corrupt", tmp)
+        wf.run()
+        rows = _value("znicz_loader_rows_quarantined_total",
+                      loader=wf.loader.name)
+        wf.loader.stop()
+    assert d[0] == 1 and d[1] >= 1, (d[0], d[1])
+    assert rows > 0, "zero-filled rows were not counted"
+    return {"injected": d[0], "quarantined": d[1],
+            "rows_counted": rows}
+
+
+def drill_loader_short_read() -> dict:
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "loader.short_read"}),
+                ("znicz_recoveries_total", {"kind": "shard_retry"}))
+    _recipe({"loader.short_read": {"at": [1]}})
+    with tempfile.TemporaryDirectory() as tmp:
+        wf = _streaming_workflow("cm_short", tmp)
+        wf.run()
+        wf.loader.stop()
+    assert d[0] == 1 and d[1] >= 1, (d[0], d[1])
+    return {"injected": d[0], "retried": d[1]}
+
+
+def drill_loader_reader_death() -> dict:
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "loader.reader_death"}),
+                ("znicz_recoveries_total", {"kind": "reader_restart"}))
+    _recipe({"loader.reader_death": {"at": [2]}})
+    with tempfile.TemporaryDirectory() as tmp:
+        wf = _streaming_workflow("cm_death", tmp)
+        wf.run()
+        restarts = wf.loader.pipeline_restarts
+        wf.loader.stop()
+    assert d[0] == 1, d[0]
+    assert d[1] >= 1 or restarts >= 1, "pipeline never restarted"
+    return {"injected": d[0], "restarts": restarts}
+
+
+def drill_serving_program_error() -> dict:
+    from znicz_tpu.serving import ServingEngine
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "serving.program_error"}),)
+    _recipe({"serving.program_error": {"at": [1]}})
+    with ServingEngine(_serve_bundle(), max_batch=8, max_delay_ms=1.0,
+                       retry_budget=2) as eng:
+        out = eng(np.random.default_rng(0).normal(
+            size=(2, 16)).astype(np.float32), timeout=60)
+        assert out.shape[0] == 2
+        retried = eng.stats()["resilience"]["retried"]
+    assert d[0] == 1 and retried >= 1, (d[0], retried)
+    return {"injected": d[0], "retried": retried}
+
+
+def drill_serving_latency_spike() -> dict:
+    from znicz_tpu.serving import ServingEngine
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "serving.latency_spike"}),)
+    _recipe({"serving.latency_spike": {"at": [1], "ms": 30}})
+    with ServingEngine(_serve_bundle(), max_batch=8,
+                       max_delay_ms=1.0) as eng:
+        t0 = time.monotonic()
+        out = eng(np.random.default_rng(0).normal(
+            size=(2, 16)).astype(np.float32), timeout=60)
+        took = time.monotonic() - t0
+    assert d[0] == 1 and out.shape[0] == 2, d[0]
+    assert took >= 0.03, f"spike not observed ({took * 1e3:.1f} ms)"
+    return {"injected": d[0], "latency_s": round(took, 3)}
+
+
+def drill_sdc_serving_bitflip() -> dict:
+    from znicz_tpu.export import ExportedModel
+    from znicz_tpu.serving import ServingEngine
+    from znicz_tpu.serving.fleet import ReplicaGroup
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "sdc.serving_bitflip"}),
+                ("znicz_sdc_quarantined_total", {"kind": "replica"}),
+                ("znicz_sdc_detected_total", {"kind": "serving"}))
+    _recipe({"sdc.serving_bitflip": {"replica": "cm@v#r0",
+                                     "after": 1}})
+    model = ExportedModel.load(_serve_bundle(), max_batch=8)
+    group = ReplicaGroup("cm", "cm", "v", lambda: ServingEngine(
+        model, max_batch=8, max_delay_ms=1.0,
+        shadow_audit_rate=1.0), target=1)
+    group.scale_to(1)
+    oracle = group.engines()[0]._shadow_oracle()
+    x = np.random.default_rng(1).normal(size=(2, 16)
+                                        ).astype(np.float32)
+    eng = group.pick()
+    out = eng.submit(x).result(timeout=60)
+    assert np.allclose(out, np.asarray(oracle(x)), rtol=0.05,
+                       atol=1e-5), "wrong answer served"
+    for _ in range(50):
+        if group.live() == 0:
+            break
+        time.sleep(0.05)
+    group.scale_to(0)
+    assert d[0] == 1 and d[1] >= 1 and d[2] >= 1, (d[0], d[1], d[2])
+    return {"injected": d[0], "replicas_quarantined": d[1],
+            "corrected_reply": True}
+
+
+def drill_snapshot_write_fail() -> dict:
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "snapshot.write_fail"}),
+                ("znicz_snapshot_failures_total", {"op": "write"}),
+                ("znicz_recoveries_total", {"kind": "snapshot_write"}))
+    _recipe({"snapshot.write_fail": {"at": [1]}})
+    with tempfile.TemporaryDirectory() as tmp:
+        wf = _tiny_workflow("cm_snap", snapshot_dir=tmp, max_epochs=3)
+        wf.run()  # first improved-epoch write fails, run continues
+    assert d[0] == 1 and d[1] >= 1 and d[2] >= 1, (d[0], d[1], d[2])
+    return {"injected": d[0], "absorbed_failures": d[1]}
+
+
+def drill_publish_corrupt() -> dict:
+    from znicz_tpu.resilience.publisher import (PublicationWatcher,
+                                                publish_bundle)
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "publish.corrupt"}),
+                ("znicz_snapshot_failures_total", {"op": "publish"}),
+                ("znicz_recoveries_total", {"kind": "publish_fallback"}))
+    wf = _pub_workflow()
+    with tempfile.TemporaryDirectory() as tmp:
+        publish_bundle(wf, tmp, "cm")           # v1, good
+        _recipe({"publish.corrupt": {"at": [1]}})
+        publish_bundle(wf, tmp, "cm")           # v2, corrupted
+        picked = PublicationWatcher(tmp, prefix="cm").poll()
+        assert picked is not None and picked[0] == 1, \
+            "watcher did not fall back to the good version"
+    assert d[0] == 1 and d[1] >= 1 and d[2] >= 1, (d[0], d[1], d[2])
+    return {"injected": d[0], "fallback_version": 1}
+
+
+def _swap_harness(recipe: dict, expect_outcome: str) -> dict:
+    from znicz_tpu.resilience.publisher import (PublicationWatcher,
+                                                SwapController,
+                                                publish_bundle)
+    from znicz_tpu.serving import ServingEngine
+    wf = _pub_workflow()
+    with tempfile.TemporaryDirectory() as tmp:
+        bundle = os.path.join(tmp, "engine.npz")
+        wf.export_forward(bundle)
+        with ServingEngine(bundle, max_batch=8,
+                           max_delay_ms=1.0) as eng:
+            _recipe(recipe)
+            publish_bundle(wf, tmp, "cm")
+            ctl = SwapController(
+                eng, PublicationWatcher(tmp, prefix="cm"),
+                score_fn=lambda m, p: 1.0, probation_steps=1)
+            for _ in range(8):
+                ctl.tick()
+                if eng.swap_counts.get(expect_outcome):
+                    break
+                eng(np.zeros((1, 10), dtype=np.float32), timeout=60)
+            counts = dict(eng.swap_counts)
+    assert counts.get(expect_outcome, 0) >= 1, counts
+    return counts
+
+
+def drill_swap_canary_regress() -> dict:
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "swap.canary_regress"}),)
+    counts = _swap_harness(
+        {"swap.canary_regress": {"at": [1], "penalty": 1.0}},
+        "rejected")
+    assert d[0] == 1, d[0]
+    return {"injected": d[0], **counts}
+
+
+def drill_swap_probation_fail() -> dict:
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "swap.probation_fail"}),)
+    counts = _swap_harness({"swap.probation_fail": {"at": [1]}},
+                           "rolled_back")
+    assert d[0] == 1, d[0]
+    return {"injected": d[0], **counts}
+
+
+def _fleet_harness(recipe: dict, deltas: "_Deltas",
+                   check) -> dict:
+    from znicz_tpu.serving.fleet import FleetEngine, TenantClass
+    fleet = FleetEngine(name="cm_fleet", tenants=[
+        TenantClass("hi", priority=0),
+        TenantClass("lo", priority=2, rate=50, burst=8,
+                    max_queue_rows=16)])
+    fleet.add_model("m", _serve_bundle(), max_batch=8,
+                    max_delay_ms=1.0, replicas=2)
+    fleet.start()
+    try:
+        _recipe(recipe)
+        x = np.zeros((1, 16), dtype=np.float32)
+        for _ in range(4):
+            fleet.tick()
+            fleet.submit("m", x, tenant="hi").result(timeout=60)
+        out = check(fleet, deltas)
+    finally:
+        fleet.shutdown()
+    return out
+
+
+def drill_fleet_tenant_flood() -> dict:
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "fleet.tenant_flood"}),)
+
+    def check(fleet, d):
+        shed = _value("znicz_fleet_requests_total", fleet="cm_fleet",
+                      tenant="lo", event="shed")
+        served = _value("znicz_fleet_requests_total",
+                        fleet="cm_fleet", tenant="lo", event="served")
+        assert d[0] == 1, d[0]
+        assert shed + served > 0, "flood requests vanished"
+        return {"injected": d[0], "lo_shed": shed,
+                "lo_served": served}
+
+    return _fleet_harness(
+        {"fleet.tenant_flood": {"at": [1], "n": 64}}, d, check)
+
+
+def drill_fleet_model_corrupt() -> dict:
+    from znicz_tpu.forge import ForgeRegistry, package
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "fleet.model_corrupt"}),
+                ("znicz_snapshot_failures_total", {"op": "forge"}),
+                ("znicz_recoveries_total", {"kind": "forge_fallback"}))
+    wf = _pub_workflow()
+    with tempfile.TemporaryDirectory() as tmp:
+        reg = ForgeRegistry(os.path.join(tmp, "reg"))
+        for version in ("1.0.0", "1.1.0"):
+            bundle = os.path.join(tmp, f"cm_{version}.forge.tar.gz")
+            package(wf, bundle, name="cm", version=version)
+            reg.upload(bundle)
+        _recipe({"fleet.model_corrupt": {"at": [1]}})
+        path = reg.fetch("cm")  # newest "corrupt" → quarantine → older
+        assert path and os.path.exists(path)
+    assert d[0] == 1 and d[1] >= 1 and d[2] >= 1, (d[0], d[1], d[2])
+    return {"injected": d[0], "quarantined_fallback": d[2]}
+
+
+def drill_fleet_replica_loss() -> dict:
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "fleet.replica_loss"}),)
+
+    def check(fleet, d):
+        assert d[0] == 1, d[0]
+        model = fleet._models["m"]
+        group = next(iter(model.versions.values())).group
+        for _ in range(6):
+            fleet.tick()  # autoscaler repair path
+            if group.live() >= group.target:
+                break
+        assert group.live() >= 1, "group never repaired"
+        return {"injected": d[0], "live_after_repair": group.live()}
+
+    return _fleet_harness({"fleet.replica_loss": {"at": [1]}}, d,
+                          check)
+
+
+# -- process-killing sites: stub-worker subprocess drills --------------
+_STUB = """\
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+from znicz_tpu.utils.config import root
+root.common.engine.faults = json.loads(os.environ["CM_RECIPE"])
+from znicz_tpu.resilience import supervisor as sup
+
+class _WF:  # minimal step-hook host for WorkerSupervisor
+    def __init__(self):
+        self._step_hooks = []
+        self.name = "cm_stub"
+    def add_step_hook(self, fn): self._step_hooks.append(fn)
+    def remove_step_hook(self, fn): self._step_hooks.remove(fn)
+    def state_dict(self, allow_collective=False): return {{"cm": 1}}
+    def stop(self): pass
+
+wf = _WF()
+w = sup.WorkerSupervisor(wf, directory=os.environ["CM_HB"],
+                         process_index=0, process_count=1,
+                         heartbeat_interval_s=0.05)
+w.attach()
+try:
+    for _ in range(8):
+        w.on_step()
+        time.sleep(0.02)
+except SystemExit as exc:
+    raise
+os._exit(0)
+"""
+
+
+def _stub_drill(site: str, recipe: dict, want_rc: int) -> dict:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with tempfile.TemporaryDirectory() as tmp:
+        stub = os.path.join(tmp, "stub.py")
+        with open(stub, "w") as fh:
+            fh.write(_STUB.format(repo=repo))
+        env = dict(os.environ,
+                   CM_RECIPE=json.dumps(recipe),
+                   CM_HB=os.path.join(tmp, "hb"))
+        proc = subprocess.run([sys.executable, stub], env=env,
+                              capture_output=True, timeout=60)
+        assert proc.returncode == want_rc, (
+            f"{site}: expected exit {want_rc}, got {proc.returncode}\n"
+            f"{proc.stdout.decode()[-500:]}"
+            f"{proc.stderr.decode()[-500:]}")
+    return {"exit_code": proc.returncode, "surfaced": True}
+
+
+def drill_host_loss() -> dict:
+    # the documented surfacing IS the hard exit (rc 1): a no-op'ing
+    # site would let the stub run to completion (rc 0)
+    return _stub_drill("host.loss",
+                       {"host.loss": {"process": 0, "at": [3]}}, 1)
+
+
+def drill_host_preempt() -> dict:
+    from znicz_tpu.resilience.supervisor import EXIT_PREEMPTED
+    return _stub_drill(
+        "host.preempt", {"host.preempt": {"process": 0, "at": [2]}},
+        EXIT_PREEMPTED)
+
+
+def drill_heartbeat_stall() -> dict:
+    # payload sleep_s keeps the drill fast; the frozen step counter is
+    # asserted through the writer's own behavior (step stops at 2)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from znicz_tpu.resilience import supervisor as sup
+    with tempfile.TemporaryDirectory() as tmp:
+        stub = os.path.join(tmp, "stub.py")
+        with open(stub, "w") as fh:
+            fh.write(_STUB.format(repo=repo))
+        hb = os.path.join(tmp, "hb")
+        env = dict(os.environ,
+                   CM_RECIPE=json.dumps({"heartbeat.stall": {
+                       "process": 0, "at": [2], "sleep_s": 0.3}}),
+                   CM_HB=hb)
+        proc = subprocess.run([sys.executable, stub], env=env,
+                              capture_output=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr.decode()[-500:]
+        beat = sup.HeartbeatMonitor(hb, 1).read(0)
+        assert beat is not None and int(beat["step"]) == 2, (
+            f"step counter did not freeze at the stall: {beat}")
+    return {"frozen_step": 2, "surfaced": True}
+
+
+def drill_checkpoint_signal_corrupt() -> dict:
+    from znicz_tpu.resilience import supervisor as sup
+    from znicz_tpu.utils.snapshotter import Snapshotter
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "checkpoint.signal_corrupt"}),)
+    with tempfile.TemporaryDirectory() as tmp:
+        good = Snapshotter.write({"good": True}, tmp, "cm", "e1")
+        time.sleep(0.05)  # mtime ordering for newest_good_snapshot
+
+        class _WF:
+            name = "cm_ckpt"
+            snapshotter = None
+            _step_hooks: list = []
+
+            def state_dict(self, allow_collective=False):
+                return {"x": 1}
+
+            def stop(self):
+                pass
+
+        from znicz_tpu.utils.config import root
+        root.common.dirs.snapshots = tmp
+        _recipe({"checkpoint.signal_corrupt": {"at": [1]}})
+        w = sup.WorkerSupervisor(_WF(), directory=None,
+                                 process_index=0, process_count=1)
+        w.request_preempt("chaos-matrix")
+        w.step = 10 ** 6  # past the barrier
+        try:
+            w.checkpoint_on_signal()
+            raise AssertionError("Preempted was not raised")
+        except sup.Preempted:
+            pass
+        # the corrupted checkpoint must FAIL digest verification, so
+        # resume falls back to the older good snapshot
+        assert sup.newest_good_snapshot(tmp, "cm") == good, \
+            "corrupt checkpoint was not rejected on digest"
+    assert d[0] == 1, d[0]
+    return {"injected": d[0], "fallback": os.path.basename(good)}
+
+
+#: the COMPLETE site → drill registry (test_chaos_matrix pins
+#: coverage against resilience.faults.SITES)
+DRILLS = {
+    "train.nonfinite_loss": drill_train_nonfinite_loss,
+    "train.nonfinite_grad": drill_train_nonfinite_grad,
+    "loader.reader_death": drill_loader_reader_death,
+    "loader.corrupt_shard": drill_loader_corrupt_shard,
+    "loader.short_read": drill_loader_short_read,
+    "serving.program_error": drill_serving_program_error,
+    "serving.latency_spike": drill_serving_latency_spike,
+    "snapshot.write_fail": drill_snapshot_write_fail,
+    "publish.corrupt": drill_publish_corrupt,
+    "swap.canary_regress": drill_swap_canary_regress,
+    "swap.probation_fail": drill_swap_probation_fail,
+    "fleet.tenant_flood": drill_fleet_tenant_flood,
+    "fleet.model_corrupt": drill_fleet_model_corrupt,
+    "fleet.replica_loss": drill_fleet_replica_loss,
+    "host.loss": drill_host_loss,
+    "host.preempt": drill_host_preempt,
+    "heartbeat.stall": drill_heartbeat_stall,
+    "checkpoint.signal_corrupt": drill_checkpoint_signal_corrupt,
+    "sdc.flip_param": drill_sdc_flip_param,
+    "sdc.flip_grad": drill_sdc_flip_grad,
+    "sdc.serving_bitflip": drill_sdc_serving_bitflip,
+}
+
+
+def main(argv: list[str]) -> int:
+    _pin_cpu()
+    from znicz_tpu.resilience.faults import SITES
+    missing = sorted(set(SITES) - set(DRILLS))
+    extra = sorted(set(DRILLS) - set(SITES))
+    if missing or extra:
+        print(f"chaos matrix OUT OF DATE: missing drills {missing}, "
+              f"stale drills {extra}")
+        return 1
+    patterns = argv or ["*"]
+    selected = [s for s in DRILLS
+                if any(fnmatch.fnmatch(s, p) for p in patterns)]
+    results: dict = {}
+    failed = []
+    for site in selected:
+        t0 = time.monotonic()
+        try:
+            evidence = DRILLS[site]()
+            results[site] = {"ok": True, **evidence,
+                             "seconds": round(time.monotonic() - t0, 2)}
+            print(f"  ok    {site:32s} {evidence}")
+        except Exception as exc:  # noqa: BLE001 — report, keep going
+            failed.append(site)
+            results[site] = {"ok": False, "error": str(exc)[:500]}
+            print(f"  FAIL  {site:32s} {exc}")
+        finally:
+            _clear_recipe()
+    out = {"sites": len(SITES), "ran": len(selected),
+           "failed": failed, "results": results}
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "CHAOS_MATRIX.json")
+    if len(selected) == len(DRILLS):
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=1)
+        print(f"wrote {path}")
+    print(f"chaos matrix: {len(selected) - len(failed)}/{len(selected)}"
+          f" sites injected + recovered-or-counted"
+          + (f"; FAILED: {failed}" if failed else ""))
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,130 @@
+"""SDC-sentinel overhead A/B: fingerprints on vs off (round 19).
+
+The sentinel's per-step cost is the in-region fingerprint fold (one
+sub-sampled position-weighted sum per parameter tensor — the vote and
+the audit are interval-cadence host work), so the acceptance bar is a
+step-time ratio: fingerprint-on / fingerprint-off ≤ 1.05 at default
+intervals on the CPU microbench.  Writes INTEGRITY_BENCH.json and
+exits 1 when the bound is violated.
+
+``INTEGRITY_TPU=1`` runs the same A/B on the ambient device — the
+chip arm queued in CHIP_QUEUE.md (a TPU's fold cost is relatively
+smaller: the sums fuse into the update fusions that are already
+bandwidth-bound).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ON_TPU = os.environ.get("INTEGRITY_TPU") == "1"
+
+
+def _pin_platform() -> None:
+    if ON_TPU:
+        return
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
+
+def _build(name: str):
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils import prng
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(512, 64)).astype(np.float32)
+    labels = (rng.random(512) * 8).astype(np.int32)
+    prng.seed_all(11)
+    wf = StandardWorkflow(
+        name=name,
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data, train_labels=labels,
+            valid_data=data[:64], valid_labels=labels[:64],
+            minibatch_size=64),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 128},
+                 "<-": {"learning_rate": 0.02, "gradient_moment": 0.9}},
+                {"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 64},
+                 "<-": {"learning_rate": 0.02, "gradient_moment": 0.9}},
+                {"type": "softmax", "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": 0.02, "gradient_moment": 0.9}}],
+        decision_config={"max_epochs": 10 ** 6})
+    wf._max_fires = 10 ** 9
+    wf.initialize(device=XLADevice())
+    return wf
+
+
+def _steptime(wf, n: int = 400, warmup: int = 60) -> float:
+    for _ in range(warmup):  # both region variants + caches warm
+        wf.loader._fire()
+        wf._region_unit._fire()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        wf.loader._fire()
+        wf._region_unit._fire()
+    return (time.perf_counter() - t0) / n
+
+
+def main() -> int:
+    _pin_platform()
+    from znicz_tpu.utils.config import root
+
+    # defaults: fingerprints on, vote every 50 steps, audits off —
+    # exactly the sentinel's shipping configuration
+    passes = []
+    for _ in range(3):  # median-of-3 (steady-pass protocol)
+        root.common.engine.sdc_fingerprints = True
+        on = _steptime(_build("integrity_on"))
+        root.common.engine.sdc_fingerprints = False
+        off = _steptime(_build("integrity_off"))
+        passes.append((on, off))
+    root.common.engine.sdc_fingerprints = True
+    passes.sort(key=lambda p: p[0] / p[1])
+    on, off = passes[len(passes) // 2]
+    ratio = on / off
+
+    import jax
+    row = {
+        "bench": "integrity_overhead",
+        "platform": jax.devices()[0].platform,
+        "step_ms_fingerprints_on": round(on * 1e3, 4),
+        "step_ms_fingerprints_off": round(off * 1e3, 4),
+        "ratio": round(ratio, 4),
+        "bound": 1.05,
+        "vote_interval": 50,
+        "audit_interval": 0,
+        "passes": [{"on_ms": round(a * 1e3, 4),
+                    "off_ms": round(b * 1e3, 4)} for a, b in passes],
+        "note": ("per-step cost is the in-region sub-sampled fold "
+                 "only; vote (d2h + host recompute) and audit "
+                 "(shadow replay) are interval-cadence host work off "
+                 "the step path"),
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "INTEGRITY_BENCH.json")
+    with open(path, "w") as fh:
+        json.dump(row, fh, indent=1)
+    print(f"integrity bench: on={on * 1e3:.3f} ms/step "
+          f"off={off * 1e3:.3f} ms/step ratio={ratio:.3f} "
+          f"(bound 1.05) → {path}")
+    if ratio > 1.05:
+        print("FAIL: fingerprint overhead exceeds the 1.05 bound")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""Chaos-matrix coverage pins (round-19 satellite, fast tier).
+
+The runtime sweep lives in ``benchmarks/chaos_matrix.py`` (a verify
+step — it trains/serves real harnesses per site).  These tests are the
+anti-rot guard that runs on every CI pass: a fault site added without
+a drill, a drill for a site that no longer exists, or a site whose
+``fire("<name>"`` call site was refactored away all fail HERE, not
+three rounds later when someone reads a recipe that silently no-ops.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+from znicz_tpu.resilience.faults import SITES, FaultPlan
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "znicz_tpu")
+
+
+def test_every_site_has_a_drill():
+    from benchmarks.chaos_matrix import DRILLS
+    assert sorted(DRILLS) == sorted(SITES), (
+        f"chaos matrix out of date: missing drills "
+        f"{sorted(set(SITES) - set(DRILLS))}, stale drills "
+        f"{sorted(set(DRILLS) - set(SITES))}")
+
+
+def test_every_site_has_a_live_fire_call():
+    """Every name in SITES must appear as a literal ``fire("<site>"``
+    somewhere in the package — the typo'd-recipe / refactored-away
+    failure mode caught at the source."""
+    fired: set[str] = set()
+    pattern = re.compile(r"""fire\(\s*['"]([a-z_.]+)['"]""")
+    for dirpath, _dirs, files in os.walk(PKG):
+        if "__pycache__" in dirpath:
+            continue
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as fh:
+                fired.update(pattern.findall(fh.read()))
+    missing = sorted(set(SITES) - fired)
+    assert not missing, (
+        f"fault sites with NO fire() call site in znicz_tpu/ "
+        f"(rotted vocabulary): {missing}")
+    unknown = sorted(fired - set(SITES))
+    assert not unknown, (
+        f"fire() call sites not declared in SITES: {unknown}")
+
+
+def test_every_site_accepts_a_one_event_recipe():
+    """The 1-event recipe form the matrix sweeps with must validate
+    for every site (and an unknown site must still be rejected)."""
+    for site in SITES:
+        plan = FaultPlan({site: {"at": [1]}})
+        assert plan.configured_sites() == {site}
+    try:
+        FaultPlan({"no.such_site": {"at": [1]}})
+        raise AssertionError("unknown site accepted")
+    except ValueError:
+        pass
+
+
+def test_every_site_is_documented():
+    for site, help_ in SITES.items():
+        assert len(help_) > 30, f"{site}: help text too thin"

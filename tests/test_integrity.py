@@ -1,0 +1,401 @@
+"""Round-19 SDC sentinel tests: fingerprints, votes, audits,
+quarantine — plus the satellite series (rows-quarantined, build_info).
+
+The multi-process gang drill (vote localizes a flipped process,
+culprit blocklisted, pre-divergence resume, bitwise parity) runs as
+the ``GRAFT_CHAOS=1 __graft_entry__.py sdc`` dryrun; these tests pin
+every layer the drill composes, fast and in-process.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+from znicz_tpu.backends import XLADevice
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.observe import metrics as obs_metrics
+from znicz_tpu.resilience import integrity
+from znicz_tpu.utils import prng
+from znicz_tpu.utils.config import root
+
+pytestmark = pytest.mark.usefixtures("reset_engine_config")
+
+
+@pytest.fixture()
+def reset_engine_config():
+    yield
+    root.common.engine.faults = None
+    root.common.engine.sdc_fingerprints = True
+    root.common.engine.sdc_vote_interval = 50
+    root.common.engine.sdc_audit_interval = 0
+    root.common.engine.sdc_suspect_threshold = 1
+
+
+def _counter(family: str, **labels) -> float:
+    fam = obs_metrics.REGISTRY.get(family)
+    if fam is None:
+        return 0.0
+    want = tuple(str(labels[n]) for n in fam.labelnames)
+    for key, child in fam.items():
+        if key == want:
+            return float(child.value)
+    return 0.0
+
+
+def _build(name: str, snapshot_dir: str | None = None,
+           max_epochs: int = 2, seed: int = 17):
+    rng = np.random.default_rng(0)
+    data = rng.normal(size=(96, 10)).astype(np.float32)
+    labels = (rng.random(96) * 3).astype(np.int32)
+    prng.seed_all(seed)
+    snap = None if snapshot_dir is None else {
+        "directory": snapshot_dir, "prefix": "sdc"}
+    wf = StandardWorkflow(
+        name=name,
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:72], train_labels=labels[:72],
+            valid_data=data[72:], valid_labels=labels[72:],
+            minibatch_size=12),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 16},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+                {"type": "softmax", "->": {"output_sample_shape": 3},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}}],
+        decision_config={"max_epochs": max_epochs},
+        snapshotter_config=snap)
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    return wf
+
+
+# ----------------------------------------------------------------------
+# fingerprint algebra
+# ----------------------------------------------------------------------
+def test_tensor_fingerprint_numpy_jax_agree():
+    import jax.numpy as jnp
+    rng = np.random.default_rng(3)
+    for shape in ((5,), (16, 16), (3, 4, 5), (1000,)):
+        arr = rng.normal(size=shape).astype(np.float32)
+        a = float(integrity.tensor_fingerprint(np, arr))
+        b = float(integrity.tensor_fingerprint(jnp, jnp.asarray(arr)))
+        assert abs(a - b) <= 1e-4 * max(abs(a), 1.0), (shape, a, b)
+
+
+def test_tensor_fingerprint_samples_element_zero():
+    """The drill's flip target (element 0) must ALWAYS be sampled."""
+    arr = np.zeros(10_000, dtype=np.float32)
+    base = float(integrity.tensor_fingerprint(np, arr))
+    arr[0] = 1000.0
+    assert float(integrity.tensor_fingerprint(np, arr)) != base
+
+
+def test_tensor_fingerprint_position_sensitive():
+    a = np.zeros(128, dtype=np.float32)
+    b = np.zeros(128, dtype=np.float32)
+    a[0], a[2] = 1.0, 2.0   # both sampled at stride 2
+    b[0], b[2] = 2.0, 1.0   # swapped values must not cancel
+    assert float(integrity.tensor_fingerprint(np, a)) \
+        != float(integrity.tensor_fingerprint(np, b))
+
+
+def test_vote_verdict_clean_selfbad_majority_tie():
+    v = integrity.vote_verdict([1.0, 1.0, 1.0], [1.0, 1.0, 1.0], 1e-3)
+    assert v == {"divergent": False, "culprits": [], "self_bad": []}
+    # self-evident culprit (claimed != its own host recompute)
+    v = integrity.vote_verdict([1.0, 5.0], [1.0, 1.0], 1e-3)
+    assert v["divergent"] and v["culprits"] == [1]
+    # sticky on-device self-check localizes even when claimed == host
+    v = integrity.vote_verdict([1.0, 5.0], [1.0, 5.0], 1e-3,
+                               self_flags=[0.0, 2.0])
+    assert v["divergent"] and v["culprits"] == [1]
+    # majority vote with >= 3 voters
+    v = integrity.vote_verdict([1.0, 1.0, 7.0], [1.0, 1.0, 7.0], 1e-3)
+    assert v["culprits"] == [2]
+    # 2-process tie with no self-evidence: everyone is suspect
+    v = integrity.vote_verdict([1.0, 5.0], [1.0, 5.0], 1e-3)
+    assert v["divergent"] and v["culprits"] == [0, 1]
+
+
+# ----------------------------------------------------------------------
+# in-region fold + host recompute
+# ----------------------------------------------------------------------
+def test_device_fold_matches_host_recompute_and_numpy_oracle():
+    root.common.engine.sdc_vote_interval = 4
+    wf = _build("fp_parity")
+    wf.run()
+    fp = wf.integrity.read_device_fingerprint()
+    assert fp is not None and fp[0] != 0.0 and fp[3] == 0.0
+    host = integrity.host_param_fingerprint(wf)
+    assert abs(fp[0] - host) <= 1e-3 * max(abs(host), 1.0)
+    assert _counter("znicz_sdc_votes_total", workflow="fp_parity",
+                    verdict="clean") >= 2
+    assert _counter("znicz_sdc_votes_total", workflow="fp_parity",
+                    verdict="divergent") == 0
+
+    # numpy backend folds the same algebra (the oracle path)
+    from znicz_tpu.backends import NumpyDevice
+    np_wf = _build("fp_parity_np")
+    # rebuild on the numpy oracle backend instead
+    prng.seed_all(17)
+    np_wf2 = StandardWorkflow(
+        name="fp_parity_np2",
+        loader_factory=np_wf._loader_factory,
+        layers=np_wf.layers_config,
+        decision_config={"max_epochs": 1})
+    np_wf2._max_fires = 10 ** 6
+    np_wf2.initialize(device=NumpyDevice())
+    np_wf2.run()
+    fp_np = np_wf2.integrity.read_device_fingerprint()
+    assert fp_np is not None and fp_np[0] != 0.0
+    host_np = integrity.host_param_fingerprint(np_wf2)
+    assert abs(fp_np[0] - host_np) <= 1e-3 * max(abs(host_np), 1.0)
+
+
+# ----------------------------------------------------------------------
+# detection: flip_param (sticky self-check + vote), flip_grad (audit)
+# ----------------------------------------------------------------------
+def test_flip_param_trips_sticky_selfcheck_and_vote(tmp_path):
+    root.common.engine.sdc_vote_interval = 4
+    root.common.engine.faults = {
+        "sdc.flip_param": {"process": 0, "at": [6]}}
+    wf = _build("flip_param", snapshot_dir=str(tmp_path))
+    wf.run()
+    fp = wf.integrity.read_device_fingerprint()
+    assert fp is not None and fp[3] >= 1.0, \
+        "on-device self-check never tripped"
+    assert _counter("znicz_sdc_votes_total", workflow="flip_param",
+                    verdict="divergent") >= 1
+    assert _counter("znicz_sdc_detected_total", kind="vote") >= 1
+    assert _counter("znicz_sdc_suspect_total", process="0",
+                    device="-") >= 1
+
+
+def test_flip_param_quarantine_rolls_back_to_pre_divergence(tmp_path):
+    """Unsupervised single-process quarantine: the sentinel reloads
+    the last-known-good (pre-divergence) snapshot and the run keeps
+    going with finite, clean weights."""
+    root.common.engine.sdc_vote_interval = 3
+    root.common.engine.faults = {
+        "sdc.flip_param": {"process": 0, "at": [14],
+                           "factor": 2.0 ** 16}}
+    rollbacks = _counter("znicz_recoveries_total", kind="sdc_rollback")
+    wf = _build("flip_rollback", snapshot_dir=str(tmp_path),
+                max_epochs=4)
+    wf.run()
+    assert _counter("znicz_recoveries_total", kind="sdc_rollback") \
+        >= rollbacks + 1, "no pre-divergence rollback happened"
+    assert _counter("znicz_sdc_quarantined_total", kind="host") >= 1
+    wf.forwards[0].weights.map_read()
+    w = np.asarray(wf.forwards[0].weights.mem)
+    assert np.isfinite(w).all()
+    assert np.abs(w).max() < 100.0, \
+        "corrupted magnitude survived the rollback"
+
+
+def test_flip_grad_caught_by_shadow_audit():
+    root.common.engine.sdc_audit_interval = 3
+    root.common.engine.faults = {
+        "sdc.flip_grad": {"process": 0, "after": 4, "factor": 64.0}}
+    wf = _build("flip_grad")
+    wf.run()
+    assert _counter("znicz_sdc_audits_total", workflow="flip_grad",
+                    verdict="mismatch") >= 1
+    assert _counter("znicz_sdc_audits_total", workflow="flip_grad",
+                    verdict="match") >= 1, "no clean audits before"
+    assert _counter("znicz_sdc_detected_total", kind="audit") >= 1
+
+
+def test_clean_audits_do_not_false_alarm():
+    root.common.engine.sdc_audit_interval = 2
+    before = _counter("znicz_sdc_detected_total", kind="audit")
+    wf = _build("audit_clean")
+    wf.run()
+    assert _counter("znicz_sdc_audits_total", workflow="audit_clean",
+                    verdict="match") >= 3
+    assert _counter("znicz_sdc_audits_total", workflow="audit_clean",
+                    verdict="mismatch") == 0
+    assert _counter("znicz_sdc_detected_total", kind="audit") == before
+
+
+def test_audit_does_not_perturb_the_training_trajectory():
+    """Audit-on ≡ audit-off weights bitwise (the shadow replay must
+    not advance the live PRNG or touch live buffers)."""
+    def weights(wf):
+        out = []
+        for fwd in wf.forwards:
+            for vec in (fwd.weights, fwd.bias):
+                vec.map_read()
+                out.append(np.array(vec.mem, copy=True))
+        return out
+
+    root.common.engine.sdc_audit_interval = 3
+    on_wf = _build("audit_on")
+    on_wf.run()
+    on = weights(on_wf)
+    root.common.engine.sdc_audit_interval = 0
+    off_wf = _build("audit_off")
+    off_wf.run()
+    off = weights(off_wf)
+    for a, b in zip(on, off):
+        np.testing.assert_array_equal(a, b)
+
+
+# ----------------------------------------------------------------------
+# anomaly-guard composition
+# ----------------------------------------------------------------------
+def test_guard_skip_does_not_false_alarm_selfcheck():
+    """A NaN step (update skipped by the anomaly guard) keeps the
+    claimed fingerprint consistent with the stored params — the SDC
+    self-check must not fire on a guard skip."""
+    root.common.engine.sdc_vote_interval = 4
+    root.common.engine.faults = {
+        "train.nonfinite_loss": {"at": [5]}}
+    wf = _build("guard_mix")
+    wf.run()
+    assert _counter("znicz_recoveries_total", kind="anomaly_step") >= 1
+    fp = wf.integrity.read_device_fingerprint()
+    assert fp is not None and fp[3] == 0.0, \
+        f"self-check false alarm on a guard-skipped step: {fp}"
+    assert _counter("znicz_sdc_votes_total", workflow="guard_mix",
+                    verdict="divergent") == 0
+
+
+# ----------------------------------------------------------------------
+# satellites: rows-quarantined counter + /readyz fold, build_info
+# ----------------------------------------------------------------------
+def test_rows_quarantined_counted_and_on_readyz(tmp_path):
+    from znicz_tpu.loader.streaming import StreamingLoader, write_shards
+    from znicz_tpu.web_status import WebStatusServer
+    root.common.engine.read_backoff_s = 0.01
+    root.common.engine.faults = {
+        "loader.corrupt_shard": {"shard": 1, "after": 1}}
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 255, size=(128, 8), dtype=np.uint8)
+    labels = (rng.random(128) * 4).astype(np.int32)
+    shards = str(tmp_path / "shards")
+    write_shards(shards, data[:96], labels[:96], valid_data=data[96:],
+                 valid_labels=labels[96:], rows_per_shard=24)
+    prng.seed_all(9)
+    wf = StandardWorkflow(
+        name="rows_quar",
+        loader_factory=lambda w: StreamingLoader(
+            w, shards, minibatch_size=12, prefetch_depth=2,
+            normalization_scale=1 / 127.5, normalization_bias=-1.0),
+        layers=[{"type": "softmax", "->": {"output_sample_shape": 4},
+                 "<-": {"learning_rate": 0.05}}],
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    wf.loader.stop()
+    rows = _counter("znicz_loader_rows_quarantined_total",
+                    loader=wf.loader.name)
+    assert rows > 0, "zero-filled rows were not counted"
+    server = WebStatusServer(port=0)
+    try:
+        report = server.readiness()
+    finally:
+        server.stop()
+    assert report["loaders"][wf.loader.name]["rows_quarantined"] \
+        == int(rows)
+    # REPORT-ONLY: quarantined rows never flip the probe by themselves
+    assert not any("quarantin" in r for r in report["reasons"])
+
+
+def test_build_info_exported_on_metrics():
+    import urllib.request
+
+    from znicz_tpu.web_status import WebStatusServer
+    XLADevice()  # full-label registration (platform/mesh/processes)
+    server = WebStatusServer(port=0)
+    try:
+        scrape = urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=30
+        ).read().decode()
+    finally:
+        server.stop()
+    live = [line for line in scrape.splitlines()
+            if line.startswith("znicz_build_info")
+            and line.rstrip().endswith(" 1")]
+    assert len(live) == 1, f"expected exactly one live build_info " \
+                           f"row, got {live}"
+    import znicz_tpu
+    assert f'version="{znicz_tpu.__version__}"' in live[0]
+    assert 'jax="' in live[0] and 'platform="cpu"' in live[0]
+
+
+# ----------------------------------------------------------------------
+# supervisor: sdc loss kind, blocklist, pre-divergence resume
+# ----------------------------------------------------------------------
+_STUB = """\
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+from znicz_tpu.resilience import supervisor as sup
+pid = int(os.environ["ZNICZ_PROCESS_ID"])
+attempt = int(os.environ["ZNICZ_ELASTIC_ATTEMPT"])
+hb_dir = os.environ["ZNICZ_HEARTBEAT_DIR"]
+w = sup.HeartbeatWriter(hb_dir, pid, interval_s=0.05).start()
+w.annotate(resumed_step=9 if attempt else 0)
+for step in range(1, 7):
+    w.beat(step)
+    time.sleep(0.05)
+    if attempt == 0 and step == 3:
+        # the gang's symmetric SDC verdict: everyone annotates, the
+        # culprit (pid 1) exits EXIT_SDC, the healthy peer exits
+        # EXIT_PEER_LOST (its next collective can never complete)
+        w.annotate(sdc_culprits=[1],
+                   sdc_last_good=os.environ["SDC_GOOD"],
+                   sdc_detected={{"vote": 1}},
+                   faults_injected=(
+                       {{"sdc.flip_param": 1}} if pid == 1 else {{}}))
+        w.stop()
+        os._exit(sup.EXIT_SDC if pid == 1 else sup.EXIT_PEER_LOST)
+w.stop()
+"""
+
+
+def test_gang_sdc_exit_blocklists_and_resumes_pre_divergence(tmp_path):
+    import sys
+
+    from znicz_tpu.resilience import supervisor as sup
+    from znicz_tpu.utils.snapshotter import Snapshotter
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    snaps = tmp_path / "snaps"
+    good = Snapshotter.write({"good": True}, str(snaps), "sdc", "e1")
+    # a NEWER snapshot exists (written after the divergence) — the
+    # supervisor must prefer the gang-attested pre-divergence one
+    import time as _time
+    _time.sleep(0.05)
+    Snapshotter.write({"post": True}, str(snaps), "sdc", "e2")
+    stub = tmp_path / "stub.py"
+    stub.write_text(_STUB.format(repo=repo))
+
+    def argv_for(pid, n_procs, attempt):
+        return [sys.executable, str(stub)]
+
+    before = _counter("znicz_host_losses_total", kind="sdc")
+    det_before = _counter("znicz_sdc_detected_total", kind="vote")
+    supv = sup.ElasticSupervisor(
+        argv_for, n_processes=2, work_dir=str(tmp_path / "work"),
+        snapshot_dir=str(snaps), snapshot_prefix="sdc",
+        heartbeat_timeout_s=2.0, start_grace_s=30.0,
+        poll_interval_s=0.05, drain_s=5.0, max_restarts=2,
+        env={"SDC_GOOD": good})
+    summary = supv.run()
+    assert summary["ok"] and summary["restarts"] == 1
+    assert summary["losses"] == {"sdc": 1}
+    assert summary["final_processes"] == 1
+    assert summary["blocklisted"] == [1]
+    assert summary["sdc_culprits"] == [1]
+    assert summary["resumed"] == "pre-divergence"
+    assert summary["resume_snapshots"][1] == good, \
+        "restart did not resume from the pre-divergence snapshot"
+    assert _counter("znicz_host_losses_total", kind="sdc") \
+        == before + 1
+    assert _counter("znicz_sdc_detected_total", kind="vote") \
+        == det_before + 1, "worker attestations not folded"
+    assert summary["resumed_step"] == 9

@@ -168,6 +168,63 @@ def test_warmed_anomaly_guard_chaos_steps_zero_new_compiles():
                                       "loss").value >= 1
 
 
+def test_warmed_sdc_sentinel_zero_new_compiles_and_bitwise_parity():
+    """Round 19: the SDC sentinel's fingerprints ride the SAME region
+    program (fold = part of the step; vote + shadow audit = pure host
+    work), so a warmed loop with fingerprints ON and an audit firing
+    adds ZERO new XLA compiles — and because the fold only READS
+    params, a clean run's weights are bitwise identical with the
+    sentinel on or off."""
+    from znicz_tpu.utils.config import root
+
+    def weights_of(wf):
+        out = []
+        for fwd in wf.forwards:
+            for vec in (fwd.weights, fwd.bias):
+                vec.map_read()
+                out.append(np.array(vec.mem, copy=True))
+        return out
+
+    root.common.engine.sdc_vote_interval = 4
+    root.common.engine.sdc_audit_interval = 5
+    try:
+        wf = _build_wf("retrace_sdc_on")
+        assert wf.integrity is not None
+        compiles = obs_metrics.xla_compiles(
+            f"region:{wf._region_unit.name}")
+        wf.run()  # votes + at least one shadow audit fire in here
+        assert obs_metrics.REGISTRY.get("znicz_sdc_audits_total") \
+            .labels(workflow="retrace_sdc_on", verdict="match").value \
+            >= 1, "no shadow audit fired during the warmup run"
+        warmed = compiles.value
+        for _ in range(8):  # audits + votes keep firing, zero compiles
+            wf.loader.run()
+            wf._region_unit.run()
+            wf.integrity.on_step()
+        assert compiles.value == warmed, (
+            f"sentinel-on warmed steps recompiled: "
+            f"{compiles.value - warmed} new XLA programs")
+        on = weights_of(wf)
+        # clean-run bitwise parity: fingerprints only READ params
+        root.common.engine.sdc_fingerprints = False
+        wf_off = _build_wf("retrace_sdc_off")
+        assert wf_off.integrity is None
+        wf_off.run()
+        for _ in range(8):
+            wf_off.loader.run()
+            wf_off._region_unit.run()
+            wf_off.decision.run()
+        off = weights_of(wf_off)
+        for a, b in zip(on, off):
+            np.testing.assert_array_equal(
+                a, b, err_msg="fingerprint-on != fingerprint-off "
+                              "weights on a clean run")
+    finally:
+        root.common.engine.sdc_fingerprints = True
+        root.common.engine.sdc_vote_interval = 50
+        root.common.engine.sdc_audit_interval = 0
+
+
 def test_warmed_serving_deadline_path_zero_new_compiles(served_bundle):
     """Round 11: deadline eviction reshapes the COALESCED batch, but
     buckets absorb it — mixed deadlined/expired traffic on a warmed

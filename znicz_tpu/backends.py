@@ -146,6 +146,17 @@ class XLADevice(Device):
                    None if mesh is None else dict(mesh.shape))
         if _metrics.enabled():
             _metrics.backend_info(self.backend, device.platform).set(1)
+            # round 19: build-identity gauge with the full label set
+            # (the backend is necessarily initialized here, so the
+            # platform/process queries cannot wedge a cold tunnel)
+            try:
+                _metrics.set_build_info(
+                    platform=device.platform,
+                    mesh=("-" if mesh is None else "x".join(
+                        str(n) for n in mesh.devices.shape)),
+                    processes=jax.process_count())
+            except Exception:  # noqa: BLE001 — telemetry only
+                pass
 
     @property
     def supports_donation(self) -> bool:

@@ -186,6 +186,11 @@ class ShardReader:
 
     def __init__(self, directory: str) -> None:
         self.directory = directory
+        #: observability label for the rows-quarantined counter; the
+        #: owning StreamingLoader stamps its unit name here (fallback:
+        #: the shard directory's basename)
+        self.obs_label = os.path.basename(
+            os.path.normpath(directory)) or directory
         path = os.path.join(directory, MANIFEST_NAME)
         with open(path) as fh:
             self.manifest = json.load(fh)
@@ -277,7 +282,12 @@ class ShardReader:
             s = int(s)
             mask = shard_of == s
             if s in self._quarantined:
+                # round-19 satellite: zero-filled rows are silent data
+                # loss — count every one so /metrics (and /readyz,
+                # report-only) make the loss loud
                 out[mask] = 0
+                _metrics.loader_rows_quarantined(self.obs_label).inc(
+                    int(mask.sum()))
                 continue
             if _faults.fire("loader.corrupt_shard", shard=s) is not None:
                 raise ShardReadError(s, f"injected corrupt shard {s}")
@@ -627,6 +637,9 @@ class StreamingLoader(Loader):
     # -- dataset ---------------------------------------------------------
     def load_data(self) -> None:
         self._reader = ShardReader(self.shard_dir)
+        # rows-quarantined attribution under THIS loader's name (the
+        # canonical per-loader label every other loader series uses)
+        self._reader.obs_label = self.name
         self.class_lengths = list(self._reader.class_lengths)
 
     @property

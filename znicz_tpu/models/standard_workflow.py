@@ -136,6 +136,10 @@ class StandardWorkflow(AcceleratedWorkflow):
             raise ValueError("loader_factory is required")
         self.layers_config = list(layers)
         self.loss = loss
+        # kept for the SDC sentinel's shadow-oracle clone (round 19)
+        self._loader_factory = loader_factory
+        self._evaluator_config = dict(evaluator_config or {})
+        self._decision_config = dict(decision_config or {})
 
         self.repeater = Repeater(self, name="repeater")
         self.loader = loader_factory(self)
@@ -143,6 +147,7 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.forwards: list[Forward] = []
         self.gds: list = []
         self.anomaly_guard = None
+        self.integrity = None  # the round-19 SDC sentinel
         self.link_forwards()
         self.link_evaluator(**(evaluator_config or {}))
         self.link_decision(**(decision_config or {}))
@@ -275,6 +280,18 @@ class StandardWorkflow(AcceleratedWorkflow):
         for gd_unit in self.gds:
             gd_unit.link_attrs(guard, ("anomaly_flag", "step_flags"),
                                two_way=False)
+        if guard.sdc_fingerprint is not None:
+            # round 19: the SDC fingerprint rides the same region —
+            # evaluator zero-seeds it per train step, every weighted
+            # GD folds its checksums in, the sentinel reads it at
+            # vote/audit cadence (resilience.integrity)
+            from znicz_tpu.resilience.integrity import IntegritySentinel
+            self.evaluator.link_attrs(guard, "sdc_fingerprint",
+                                      two_way=False)
+            for gd_unit in self.gds:
+                gd_unit.link_attrs(guard, "sdc_fingerprint",
+                                   "sdc_inject", two_way=False)
+            self.integrity = IntegritySentinel(self)
 
     def rollback_to_snapshot(self, streak: int) -> bool:
         """Anomaly-streak recovery (called by the Decision unit after
@@ -293,6 +310,7 @@ class StandardWorkflow(AcceleratedWorkflow):
         path = snap.destination if snap is not None else None
         if self.anomaly_guard is not None:
             self.anomaly_guard.reset_streak()
+            self.anomaly_guard.reset_sdc_fingerprint()
         if not path or not _os.path.exists(path):
             self.warning(
                 "anomaly streak %d with no snapshot to roll back to — "
@@ -552,6 +570,30 @@ class StandardWorkflow(AcceleratedWorkflow):
                 raise RuntimeError(
                     f"workflow '{self.name}' exceeded max_fires="
                     f"{self._max_fires} chunks (runaway loop?)")
+
+    def build_shadow(self) -> "StandardWorkflow":
+        """A numpy-backend clone for the SDC sentinel's
+        redundant-compute audit: same declarative config (identical
+        construction order ⇒ identical unit/vector names, so
+        ``load_state`` restores the clone leaf-for-leaf), no guard
+        (the shadow IS the trusted oracle), no snapshots/side-chains.
+        The audit drives it one minibatch at a time after a
+        ``load_state`` of the live workflow's pre-step state."""
+        from znicz_tpu.backends import NumpyDevice
+        shadow = StandardWorkflow(
+            name=f"{self.name}_shadow",
+            loader_factory=self._loader_factory,
+            layers=self.layers_config,
+            loss=self.loss,
+            evaluator_config=self._evaluator_config,
+            decision_config={**self._decision_config,
+                             "max_epochs": None,
+                             "fail_iterations": 10 ** 9},
+            snapshotter_config=None,
+            anomaly_guard=False)
+        shadow._max_fires = 10 ** 9
+        shadow.initialize(device=NumpyDevice())
+        return shadow
 
     def export_forward(self, path: str) -> str:
         """Serialize the trained forward chain for serving

@@ -963,6 +963,116 @@ def fleet_ladder_evictions(fleet: str, model: str) -> Counter:
         labels=("fleet", "model")).labels(fleet=fleet, model=model)
 
 
+# ----------------------------------------------------------------------
+# round 19: silent-data-corruption sentinel — fingerprint votes,
+# redundant-compute audits and quarantine verdicts are scrapeable so
+# the sdc dryrun attests detection from the same /metrics feed
+# ----------------------------------------------------------------------
+def sdc_votes(workflow: str, verdict: str) -> Counter:
+    """Cross-replica fingerprint votes by verdict: ``clean`` (every
+    process's post-update param fingerprint agreed) vs ``divergent``
+    (at least one chip/host computed different params — the silent-
+    data-corruption signature none of the isfinite/digest layers can
+    see)."""
+    return REGISTRY.counter(
+        "znicz_sdc_votes_total",
+        "Cross-replica fingerprint votes (clean/divergent)",
+        labels=("workflow", "verdict")).labels(workflow=workflow,
+                                               verdict=verdict)
+
+
+def sdc_audits(workflow: str, verdict: str) -> Counter:
+    """Redundant-compute audits by verdict: the last microbatch's step
+    replayed on the shadow oracle either ``match``ed the device's
+    post-update fingerprints or caught a ``mismatch``."""
+    return REGISTRY.counter(
+        "znicz_sdc_audits_total",
+        "Redundant-compute shadow audits (match/mismatch)",
+        labels=("workflow", "verdict")).labels(workflow=workflow,
+                                               verdict=verdict)
+
+
+def sdc_detected(kind: str) -> Counter:
+    """Confirmed silent-data-corruption detections by detector:
+    ``vote`` (cross-replica fingerprint compare), ``audit``
+    (redundant-compute replay), ``serving`` (sampled shadow re-score
+    of live replies)."""
+    return REGISTRY.counter(
+        "znicz_sdc_detected_total",
+        "Confirmed SDC detections by detector (vote/audit/serving)",
+        labels=("kind",)).labels(kind=kind)
+
+
+def sdc_suspects(process, device: str) -> Counter:
+    """SDC suspicion events attributed to a process/device pair —
+    ``device`` is ``-`` for host-level attributions (training votes /
+    audits) or the serving replica id for shadow-audit catches."""
+    return REGISTRY.counter(
+        "znicz_sdc_suspect_total",
+        "SDC suspicion events by process and device/replica",
+        labels=("process", "device")).labels(process=process,
+                                             device=device)
+
+
+def sdc_quarantined(kind: str) -> Counter:
+    """Corrupt compute units removed from service: ``host`` (elastic
+    gang restarted without the culprit, blocklisted) or ``replica``
+    (serving replica removed via the ReplicaGroup repair path)."""
+    return REGISTRY.counter(
+        "znicz_sdc_quarantined_total",
+        "Corrupt hosts/replicas quarantined after confirmed SDC",
+        labels=("kind",)).labels(kind=kind)
+
+
+def loader_rows_quarantined(loader: str) -> Counter:
+    """Minibatch rows served as ZEROS because their shard is
+    quarantined — the silent-data-loss that used to be invisible:
+    ``_gather_retry`` kept the run alive but nothing counted the
+    zero-filled rows.  Report-only on /readyz."""
+    return REGISTRY.counter(
+        "znicz_loader_rows_quarantined_total",
+        "Rows zero-filled from quarantined shards (silent data loss, "
+        "now loud)", labels=("loader",)).labels(loader=loader)
+
+
+#: the currently-live build_info child's label key (previous children
+#: are zeroed when richer info arrives, so scrapes read the ==1 row)
+_build_info_live: tuple | None = None
+
+
+def set_build_info(*, platform: str = "?", mesh: str = "?",
+                   processes: str = "?", fallback: bool = False) -> None:
+    """Register/refresh the ``znicz_build_info`` gauge: package
+    version, jax version, platform, mesh shape and process count as
+    labels, value 1 — fleet debugging can tell which build a scrape
+    came from.  Called from device creation (full info) and from
+    ``WebStatusServer`` (``fallback=True`` — registers only when
+    nothing richer did, so supervisor-only processes export it too).
+    Richer info supersedes: the previous child is zeroed so exactly
+    one row reads 1."""
+    global _build_info_live
+    if fallback and _build_info_live is not None:
+        return
+    import jax
+
+    import znicz_tpu
+    fam = REGISTRY.gauge(
+        "znicz_build_info",
+        "Build identity (value 1; read the labels): package version, "
+        "jax version, platform, mesh shape, process count",
+        labels=("version", "jax", "platform", "mesh", "processes"))
+    key = {"version": znicz_tpu.__version__, "jax": jax.__version__,
+           "platform": str(platform), "mesh": str(mesh),
+           "processes": str(processes)}
+    key_t = tuple(sorted(key.items()))
+    if _build_info_live == key_t:
+        return
+    if _build_info_live is not None:
+        fam.labels(**dict(_build_info_live)).set(0)
+    fam.labels(**key).set(1)
+    _build_info_live = key_t
+
+
 # -- elastic multi-host supervision (round 18) -------------------------
 def heartbeat_age_seconds(process) -> Gauge:
     """Seconds since process ``process`` last beat into the heartbeat
@@ -980,7 +1090,10 @@ def host_losses(kind: str) -> Counter:
     """Processes the elastic supervisor declared gone, by kind:
     ``loss`` (died / heartbeat stale), ``stall`` (wall-clock beats
     flow, step counter frozen — hung collective), ``preempt``
-    (checkpoint-on-signal drain + EXIT_PREEMPTED)."""
+    (checkpoint-on-signal drain + EXIT_PREEMPTED), ``sdc`` (round 19:
+    a confirmed silent-data-corruption culprit exited EXIT_SDC and is
+    blocklisted — the restart resumes from the PRE-divergence
+    snapshot, not the newest one)."""
     return REGISTRY.counter(
         "znicz_host_losses_total",
         "Hosts lost to the elastic supervisor by kind",

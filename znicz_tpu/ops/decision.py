@@ -99,6 +99,11 @@ class DecisionBase(Unit):
             # round 18: the elastic WorkerSupervisor's heartbeat /
             # preemption service point — one list check when detached
             wf.on_step_boundary()
+        sentinel = getattr(wf, "integrity", None)
+        if sentinel is not None:
+            # round 19: the SDC sentinel's vote/audit cadence — one
+            # counter increment per step until an interval fires
+            sentinel.on_step()
         guard = getattr(wf, "anomaly_guard", None)
         if guard is None or not guard.is_initialized:
             return

@@ -42,6 +42,12 @@ class EvaluatorBase(AcceleratedUnit):
         # unless a fault plan configures a train site)
         self.step_flags: Vector | None = None
         self.fault_inject: Vector | None = None
+        # round 19: the guard-hosted [param_fp, grad_fp] SDC
+        # fingerprint — zero-seeded here on TRAIN steps only (the
+        # static minibatch_class is already part of the region key),
+        # so validation steps keep the last train step's fingerprint
+        # for the sentinel's vote to read
+        self.sdc_fingerprint: Vector | None = None
 
     def _valid_mask(self, xp, n_rows):
         valid = self.minibatch_valid.devmem if xp is jnp \
@@ -69,6 +75,22 @@ class EvaluatorBase(AcceleratedUnit):
         else:
             f = np.float32(1.0 if loss_ok else 0.0)
             flags.mem[...] = [f, f]
+        self._seed_fingerprint(xp)
+
+    def _seed_fingerprint(self, xp) -> None:
+        """Zero the SDC fingerprint's per-step slots (claimed param
+        fp, grad fp, pre-update refold) at the top of a TRAIN step so
+        the GD units fold this step's checksums into a fresh slate;
+        the sticky self-check count and the previous claimed fp (slots
+        3/4) persist.  The branch is static: ``minibatch_class`` is in
+        the region key."""
+        fp = self.sdc_fingerprint
+        if fp is None or not fp or int(self.minibatch_class) != TRAIN:
+            return
+        if xp is jnp:
+            fp.devmem = fp.devmem.at[:3].set(0.0)
+        else:
+            fp.mem[:3] = 0.0
 
 
 class EvaluatorSoftmax(EvaluatorBase):

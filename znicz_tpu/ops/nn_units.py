@@ -229,6 +229,19 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         #: a non-finite step leaves weights and momentum untouched.
         #: None (the default for standalone units) = exact seed path.
         self.anomaly_flag: Vector | None = None
+        #: round 19 SDC sentinel hooks (linked by StandardWorkflow to
+        #: the guard's vectors): ``sdc_fingerprint`` receives this
+        #: unit's sub-sampled gradient + post-update parameter
+        #: checksums; ``sdc_inject`` is the chaos leaf arming the
+        #: ``sdc.flip_param`` / ``sdc.flip_grad`` corruptions (an
+        #: exact ×1.0 identity when disarmed — never recompiles).
+        self.sdc_fingerprint: Vector | None = None
+        self.sdc_inject: Vector | None = None
+        #: exact Vector set the fingerprint fold covered, in fold
+        #: order — the sentinel's host recompute and the shadow audit
+        #: enumerate the SAME tensors from this (populated on both
+        #: backends whether or not the fingerprint vector is linked)
+        self._fp_folded: dict[int, Vector] = {}
         # linked from the paired forward unit by StandardWorkflow:
         self.input: Vector | None = None
         self.output: Vector | None = None
@@ -394,6 +407,36 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         scale = xp.minimum(1.0, clip / xp.maximum(norm, 1e-30))
         return grad * scale
 
+    # -- round 19: SDC fingerprint fold + seeded corruption ------------
+    def _fp_register(self, vec: Vector) -> None:
+        """Record that ``vec`` is covered by the fingerprint fold (the
+        sentinel's host recompute and the shadow audit enumerate
+        exactly this set, in this order)."""
+        self._fp_folded.setdefault(id(vec), vec)
+
+    def _sdc_scales(self, xla: bool):
+        """The armed ``(param_scale, grad_scale)`` multiplier deltas,
+        or None when the chaos leaf is absent (the common case)."""
+        inj = self.sdc_inject
+        if inj is None or not inj:
+            return None
+        return inj.devmem if xla else inj.mem
+
+    def _fold_fingerprint(self, xp, slot: int, value) -> None:
+        """Fold one tensor's sub-sampled checksum into the guard's
+        shared fingerprint (slot 0 = post-update params, slot 1 =
+        folded gradients).  A no-op unless StandardWorkflow linked the
+        vector — standalone units keep the exact seed path."""
+        fpv = self.sdc_fingerprint
+        if fpv is None or not fpv:
+            return
+        from znicz_tpu.resilience.integrity import tensor_fingerprint
+        contrib = tensor_fingerprint(xp, value)
+        if xp is np:
+            fpv.mem[slot] += np.float32(contrib)
+        else:
+            fpv.devmem = fpv.devmem.at[slot].add(contrib)
+
     def _np_grad_ok(self, grad: np.ndarray) -> bool:
         """Numpy-path mirror of the guard's on-device finite check:
         AND this gradient's ‖g‖² finiteness into the shared flag and
@@ -416,7 +459,17 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         vec = vec if vec is not None else self.weights
         acc_vec = acc_vec if acc_vec is not None \
             else self.accumulated_gradient_weights
+        self._fp_register(vec)
+        self._fold_fingerprint(np, 2, vec.mem)
+        sdc = self._sdc_scales(xla=False)
+        if sdc is not None:
+            grad_w = grad_w.copy()
+            grad_w.ravel()[0] *= 1.0 + sdc[1]
+        self._fold_fingerprint(np, 1, grad_w)
         if not self._np_grad_ok(grad_w):
+            # skipped update: the claimed fp still covers the (kept)
+            # value, or the next step's refold would false-alarm
+            self._fold_fingerprint(np, 0, vec.mem)
             return  # anomaly guard: skip, don't poison
         w = vec.mem
         g = self._regularized(np, self._clipped(np, grad_w), w,
@@ -429,6 +482,7 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             w += acc
         else:
             w -= lr * g
+        self._fold_fingerprint(np, 0, w)
 
     def _apply_bias_np(self, grad_b: np.ndarray, vec=None,
                        acc_vec=None) -> None:
@@ -437,7 +491,11 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             else self.accumulated_gradient_bias
         if vec is None or not vec:
             return
+        self._fp_register(vec)
+        self._fold_fingerprint(np, 2, vec.mem)
+        self._fold_fingerprint(np, 1, grad_b)
         if not self._np_grad_ok(grad_b):
+            self._fold_fingerprint(np, 0, vec.mem)
             return  # anomaly guard: skip, don't poison
         b = vec.mem
         g = self._regularized(np, self._clipped(np, grad_b), b,
@@ -450,6 +508,7 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             b += acc
         else:
             b -= lr * g
+        self._fold_fingerprint(np, 0, b)
 
     def _apply_weights_xla(self, grad_w, vec=None, acc_vec=None) -> None:
         vec = vec if vec is not None else self.weights
@@ -494,6 +553,23 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         """
         from znicz_tpu.parallel.axis import current_data_axis
         grad = maybe_pmean(grad)
+        self._fp_register(vec)
+        # round 19: refold the STORED parameter before the update
+        # (slot 2) — the guard compares it against last step's
+        # post-update claimed fp, so a between-step memory mutation
+        # (sdc.flip_param) self-identifies on the corrupting chip
+        self._fold_fingerprint(jnp, 2, vec.devmem)
+        # seeded gradient corruption (sdc.flip_grad) rides a device
+        # leaf — ``×(1 + scale)`` is an exact identity when disarmed,
+        # an exponent-scale flip of one element when armed; applied
+        # to the unit's main weight gradient only.  (sdc.flip_param
+        # is injected host-side between dispatches — see
+        # AnomalyGuard._host_flip_param.)
+        sdc = self._sdc_scales(xla=True)
+        if sdc is not None and vec is self.weights:
+            idx = (0,) * grad.ndim
+            grad = grad.at[idx].multiply(1.0 + sdc[1])
+        self._fold_fingerprint(jnp, 1, grad)
         guard = self.anomaly_flag \
             if self.anomaly_flag is not None and self.anomaly_flag else None
         if guard is not None:
@@ -526,6 +602,12 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             if acc_before is not None:
                 acc_vec.devmem = jnp.where(step_ok, acc_vec.devmem,
                                            acc_before)
+        # the param fingerprint folds the COMMITTED value — a
+        # between-step memory mutation (sdc.flip_param, host-injected)
+        # makes the NEXT step's pre-update refold disagree with this
+        # claimed checksum, which is what the guard's sticky
+        # self-check detects
+        self._fold_fingerprint(jnp, 0, vec.devmem)
 
     def _apply_param_zero1(self, grad, vec: Vector, acc_vec,
                            decay: float, lr, moment: float) -> None:

@@ -210,7 +210,7 @@ _REPLICATED_SLOTS = (
     r"weights_batch", r"acc_\w+", r"lr_state", r"rng_state",
     r"sched_\w+", r"epoch_\w+", r"n_err", r"confusion", r"coords",
     r"h_mean", r"v_mean", r"step_flags", r"anomaly_state",
-    r"fault_inject", r"zero_mask", r"original_data",
+    r"fault_inject", r"sdc_\w+", r"zero_mask", r"original_data",
     r"original_labels", r"minibatch_valid",
     r"pos_table", r"hits", r"metrics", r"time", r"histogram",
 )

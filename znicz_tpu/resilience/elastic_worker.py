@@ -115,7 +115,17 @@ def main() -> None:
             ("ZNICZ_HEARTBEAT_TIMEOUT_S", "heartbeat_timeout_s", float),
             ("ZNICZ_DIST_INIT_TIMEOUT_S", "dist_init_timeout_s", float),
             ("ZNICZ_PREEMPT_BARRIER_STEPS", "preempt_barrier_steps",
-             int)):
+             int),
+            # round 19: the SDC sentinel's drill knobs.  The vote
+            # drill turns ZeRO-1 OFF: pure-DP replicas maintain params
+            # independently, so a flipped copy STAYS divergent (ZeRO-1
+            # re-derives params from shared collectives every step,
+            # healing per-host divergence into globally-consistent
+            # poison — the audit's territory, not the vote's)
+            ("ZNICZ_SDC_VOTE_INTERVAL", "sdc_vote_interval", int),
+            ("ZNICZ_SDC_SUSPECT_THRESHOLD", "sdc_suspect_threshold",
+             int),
+            ("ZNICZ_ZERO1", "zero1", lambda v: bool(int(v)))):
         val = os.environ.get(env)
         if val:
             setattr(root.common.engine, knob, cast(val))
@@ -158,9 +168,17 @@ def main() -> None:
             sha.update(arr.tobytes())
             sums.append(float(np.asarray(arr, dtype=np.float64).sum()))
 
+    from znicz_tpu.resilience import faults as _faults
+    plan = _faults.active()
     digest = {
         "process_id": int(jax.process_index()),
         "n_processes": int(jax.process_count()),
+        "faults_injected": dict(plan.counts()) if plan else {},
+        "sdc_fingerprint": (
+            None if wf.anomaly_guard is None
+            or wf.anomaly_guard.read_sdc_fingerprint() is None
+            else [float(v) for v in
+                  wf.anomaly_guard.read_sdc_fingerprint()]),
         "n_global_devices": len(jax.devices()),
         "attempt": int(os.environ.get("ZNICZ_ELASTIC_ATTEMPT", "0")),
         "resumed_from": os.environ.get("ZNICZ_RESUME_SNAPSHOT") or None,

@@ -133,6 +133,33 @@ SITES = {
         "(default the first model) mid-traffic — routing must steer "
         "around the loss and the autoscaler must repair the group "
         "with zero high-priority request failures",
+    "sdc.flip_param":
+        "one element of a parameter tensor is silently multiplied by "
+        "payload 'factor' (default 2^16) in THIS process's stored "
+        "copy, HOST-SIDE between dispatches (an in-program scatter "
+        "would be re-sharded by GSPMD onto the element's owner device "
+        "and silently no-op on other processes) — the mutation lands "
+        "between one step's post-update fingerprint fold and the next "
+        "step's pre-update refold, exactly the memory-corruption "
+        "signature the guard's sticky self-check localizes; filter "
+        "with {'process': i} so ONE gang member diverges and the "
+        "cross-replica vote must quarantine it",
+    "sdc.flip_grad":
+        "one element of the folded weight gradient is multiplied by "
+        "payload 'factor' (default 2^16) BEFORE the update (rides the "
+        "guard's sdc_inject device leaf — no recompile) — finite, "
+        "plausible, wrong: the isfinite guard passes while the "
+        "device's update diverges from the shadow oracle; the "
+        "redundant-compute audit must catch the mismatch.  Drill "
+        "single-process: under multi-process ZeRO-1 GSPMD may assign "
+        "the scatter to the element's owner device",
+    "sdc.serving_bitflip":
+        "a serving replica's reply rows are corrupted post-program "
+        "(column 0 scaled by payload 'factor') — plausible-but-wrong "
+        "scores; the sampled shadow audit must re-score against the "
+        "compile-free numpy oracle, correct the reply, and remove the "
+        "replica via the ReplicaGroup repair path; filter with "
+        "{'replica': id}",
 }
 
 #: spec keys that steer firing rather than ride the payload

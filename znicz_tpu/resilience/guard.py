@@ -62,10 +62,12 @@ class AnomalyGuard(AcceleratedUnit):
     # per-step transients + process-lifetime totals: neither belongs in
     # a checkpoint (restoring old totals would run the host-side metric
     # deltas backwards)
-    SNAPSHOT_EXCLUDE = ("step_flags", "anomaly_state", "fault_inject")
+    SNAPSHOT_EXCLUDE = ("step_flags", "anomaly_state", "fault_inject",
+                        "sdc_fingerprint", "sdc_inject")
 
     def __init__(self, workflow, name: str | None = None, **kwargs) -> None:
         super().__init__(workflow, name=name, **kwargs)
+        from znicz_tpu.resilience import integrity as _integrity
         #: [running_ok, loss_ok] — seeded by the evaluator each step,
         #: ANDed by each GD unit, read+committed here
         self.step_flags = Vector(name=f"{self.name}.step_flags")
@@ -76,10 +78,34 @@ class AnomalyGuard(AcceleratedUnit):
         self.fault_inject: Vector | None = (
             Vector(name=f"{self.name}.fault_inject")
             if _faults.site_configured(*TRAIN_SITES) else None)
+        #: round 19 SDC fingerprint state, committed by this unit —
+        #: f32[5]: [0]=param fp claimed this step (each weighted GD
+        #: folds its POST-update checksum in), [1]=gradient fp,
+        #: [2]=pre-update refold of the STORED params, [3]=sticky
+        #: self-check mismatch count (a param that mutated BETWEEN
+        #: step k's post-update fold and step k+1's pre-update refold
+        #: was corrupted by THIS chip's memory — the flip_param
+        #: signature, detectable at any later vote), [4]=previous
+        #: step's claimed fp (the self-check's reference).  Slots
+        #: 0..2 are zero-seeded by the evaluator per train step; see
+        #: resilience.integrity.
+        self.sdc_fingerprint: Vector | None = (
+            Vector(name=f"{self.name}.sdc_fingerprint")
+            if _integrity.enabled() else None)
+        #: [param_flip_scale, grad_flip_scale] — 0.0 normally; on an
+        #: injected step a large multiplier delta the GD units apply
+        #: to one element (``value * (1 + scale)`` — exact identity at
+        #: scale 0, an exponent-scale corruption when armed).  Only
+        #: allocated when a fault plan configures an sdc train site.
+        self.sdc_inject: Vector | None = (
+            Vector(name=f"{self.name}.sdc_inject")
+            if _faults.site_configured(*_integrity.SDC_TRAIN_SITES)
+            else None)
         #: host mirror of the last totals the Decision translated into
         #: registry counters (delta base)
         self._metric_base = (0, 0)
         self._last_inject = (False, False)
+        self._last_sdc = (False, False)
 
     def initialize(self, device=None, **kwargs) -> None:
         super().initialize(device=device, **kwargs)
@@ -89,34 +115,107 @@ class AnomalyGuard(AcceleratedUnit):
         if self.fault_inject is not None:
             self.fault_inject.reset(np.zeros(2, dtype=np.float32))
             self.init_vectors(self.fault_inject)
+        if self.sdc_fingerprint is not None:
+            self.sdc_fingerprint.reset(np.zeros(5, dtype=np.float32))
+            self.init_vectors(self.sdc_fingerprint)
+        if self.sdc_inject is not None:
+            self.sdc_inject.reset(np.zeros(2, dtype=np.float32))
+            self.init_vectors(self.sdc_inject)
         self._metric_base = (0, 0)
         self._last_inject = (False, False)
+        self._last_sdc = (False, False)
 
     # ------------------------------------------------------------------
     # host control plane: arm/disarm the injection leaf per step
     # ------------------------------------------------------------------
     def host_run(self) -> None:
-        inj = self.fault_inject
-        if inj is None or not inj:
-            return
         loader = getattr(self.workflow, "loader", None)
         on_train = (loader is None
                     or loader.minibatch_class == TRAIN)
-        want = ((bool(_faults.fire("train.nonfinite_loss")),
-                 bool(_faults.fire("train.nonfinite_grad")))
-                if on_train else (False, False))
-        if want == self._last_inject:
-            return  # leaf value unchanged: no host write, no upload
-        self._last_inject = want
-        inj.map_invalidate()
-        inj.mem[...] = [np.nan if want[0] else 0.0,
-                        np.nan if want[1] else 0.0]
-        if self.device is not None and not self.device.is_host_only:
-            inj.unmap()
+        inj = self.fault_inject
+        if inj is not None and inj:
+            want = ((bool(_faults.fire("train.nonfinite_loss")),
+                     bool(_faults.fire("train.nonfinite_grad")))
+                    if on_train else (False, False))
+            if want != self._last_inject:
+                # leaf value unchanged: no host write, no upload
+                self._last_inject = want
+                inj.map_invalidate()
+                inj.mem[...] = [np.nan if want[0] else 0.0,
+                                np.nan if want[1] else 0.0]
+                if self.device is not None \
+                        and not self.device.is_host_only:
+                    inj.unmap()
+        sdc = self.sdc_inject
+        if sdc is not None and sdc:
+            from znicz_tpu.parallel.process_shard import process_info
+            pidx = process_info()[0]
+            pay_p = (_faults.fire("sdc.flip_param", process=pidx)
+                     if on_train else None)
+            if pay_p is not None:
+                # the param flip happens HOST-SIDE between dispatches:
+                # a partition-proof, strictly process-local mutation of
+                # the stored buffer (an in-program scatter would be
+                # re-sharded by GSPMD onto the element's OWNER device,
+                # silently no-opping a flip targeted at any other
+                # process).  Landing between step k's post-update fold
+                # and step k+1's pre-update refold is exactly the
+                # memory-corruption signature the sticky self-check
+                # localizes.
+                self._host_flip_param(
+                    float(pay_p.get("factor", 2.0 ** 16)))
+            pay_g = (_faults.fire("sdc.flip_grad", process=pidx)
+                     if on_train else None)
+            want_sdc = (False, pay_g is not None)
+            if want_sdc != self._last_sdc:
+                self._last_sdc = want_sdc
+                sdc.map_invalidate()
+                # ``value * (1 + scale)``: an exponent-scale flip when
+                # armed, an exact float identity (×1.0) when not
+                sdc.mem[...] = [
+                    0.0,
+                    float(pay_g.get("factor", 2.0 ** 16)) - 1.0
+                    if pay_g is not None else 0.0]
+                if self.device is not None \
+                        and not self.device.is_host_only:
+                    sdc.unmap()
+
+    def _host_flip_param(self, factor: float) -> None:
+        """Multiply element 0 of the first weighted GD's parameter
+        tensor in THIS process's stored copy (d2h of the local shard,
+        host mutate, per-process re-upload — no collective, no
+        recompile: the leaf keeps its shape/sharding)."""
+        for gd_unit in getattr(self.workflow, "gds", []):
+            vec = getattr(gd_unit, "weights", None)
+            if vec is None or not vec:
+                continue
+            vec.map_write()
+            flat = vec.mem.reshape(-1)
+            flat[0] = flat[0] * factor
+            if self.device is not None \
+                    and not self.device.is_host_only:
+                vec.unmap()
+            self.warning("sdc.flip_param injected: %s[0] ×%g "
+                         "(process-local memory corruption)",
+                         vec.name, factor)
+            return
 
     # ------------------------------------------------------------------
     # the per-step commit (inside the region on XLA; eager on numpy)
     # ------------------------------------------------------------------
+    def region_key(self) -> tuple:
+        # the SDC self-check only runs on TRAIN steps (eval steps skip
+        # the GD folds, so the per-step slots are stale there); the
+        # evaluator already keys the region on minibatch_class, so
+        # this adds zero NEW program variants
+        loader = getattr(self.workflow, "loader", None)
+        return (int(loader.minibatch_class)
+                if loader is not None else -1,)
+
+    def _on_train(self) -> bool:
+        loader = getattr(self.workflow, "loader", None)
+        return loader is None or loader.minibatch_class == TRAIN
+
     def xla_run(self) -> None:
         import jax.numpy as jnp
         flags = self.step_flags.devmem
@@ -129,6 +228,21 @@ class AnomalyGuard(AcceleratedUnit):
             jnp.where(ok, zero, st[0] + 1),
             st[1] + jnp.where(loss_ok, zero, one),
             st[2] + jnp.where(loss_ok & ~ok, one, zero)])
+        fpv = self.sdc_fingerprint
+        if fpv is not None and fpv and self._on_train():
+            # self-check: last step's POST-update claimed fp vs this
+            # step's PRE-update refold of the stored params — a
+            # mutation between the two happened in THIS chip's memory
+            # outside any computation (sdc.flip_param's signature);
+            # the sticky count localizes the culprit at any later vote
+            fp = fpv.devmem
+            prev, pre = fp[4], fp[2]
+            bad = (prev != 0.0) & (jnp.abs(pre - prev)
+                                   > 1e-5 * jnp.maximum(jnp.abs(prev),
+                                                        1.0))
+            fpv.devmem = jnp.stack([
+                fp[0], fp[1], fp[2],
+                fp[3] + jnp.where(bad, 1.0, 0.0), fp[0]])
 
     def numpy_run(self) -> None:
         flags = self.step_flags.mem
@@ -140,6 +254,14 @@ class AnomalyGuard(AcceleratedUnit):
             st[1] += 1
         elif not ok:
             st[2] += 1
+        fpv = self.sdc_fingerprint
+        if fpv is not None and fpv and self._on_train():
+            fp = fpv.mem
+            prev, pre = float(fp[4]), float(fp[2])
+            if prev != 0.0 and abs(pre - prev) \
+                    > 1e-5 * max(abs(prev), 1.0):
+                fp[3] += 1.0
+            fp[4] = fp[0]
 
     # ------------------------------------------------------------------
     # host-side readers (Decision unit / rollback)
@@ -155,3 +277,27 @@ class AnomalyGuard(AcceleratedUnit):
         the monotone totals the metric deltas ride on."""
         self.anomaly_state.map_write()
         self.anomaly_state.mem[0] = 0
+
+    def read_sdc_fingerprint(self) -> np.ndarray | None:
+        """Host copy of the f32[5] fingerprint state (one tiny d2h at
+        the sentinel's vote/audit cadence); None when absent or not
+        the expected shape (population-stacked state)."""
+        fpv = self.sdc_fingerprint
+        if fpv is None or not fpv:
+            return None
+        fpv.map_read()
+        arr = np.asarray(fpv.mem, dtype=np.float64).ravel()
+        return arr if arr.size == 5 else None
+
+    def reset_sdc_fingerprint(self) -> None:
+        """Zero the fingerprint state after ANY in-process restore of
+        older weights (anomaly rollback, SDC rollback): the previous
+        claimed fp no longer describes the live buffers, so the next
+        self-check must start from scratch instead of false-alarming."""
+        fpv = self.sdc_fingerprint
+        if fpv is None or not fpv:
+            return
+        fpv.map_write()
+        fpv.mem[...] = 0.0
+        if self.device is not None and not self.device.is_host_only:
+            fpv.unmap()

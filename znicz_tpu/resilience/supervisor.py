@@ -71,6 +71,11 @@ EXIT_PREEMPTED = 75
 #: past ``engine.collective_timeout_s`` — a peer is gone and the
 #: in-flight collective will never complete
 EXIT_PEER_LOST = 113
+#: round 19: the SDC sentinel confirmed THIS process's chip computes
+#: wrong values — the gang supervisor must blocklist this host and
+#: restart the survivors from the PRE-divergence snapshot (the
+#: sentinel annotated its path into the heartbeat channel)
+EXIT_SDC = 97
 
 #: env channel shared by Launcher / workers / gang supervisor
 ENV_HEARTBEAT_DIR = "ZNICZ_HEARTBEAT_DIR"
@@ -408,6 +413,9 @@ class WorkerSupervisor(Logger):
         if self._attached:
             return self
         self.workflow.add_step_hook(self.on_step)
+        # the SDC sentinel reaches the heartbeat channel through this
+        # back-reference (quarantine annotations + EXIT_SDC)
+        self.workflow._worker_supervisor = self
         if self.writer is not None:
             # resume-position attestation: attach runs after any
             # snapshot restore, so the loader's position IS where this
@@ -438,6 +446,8 @@ class WorkerSupervisor(Logger):
         if not self._attached:
             return
         self.workflow.remove_step_hook(self.on_step)
+        if getattr(self.workflow, "_worker_supervisor", None) is self:
+            self.workflow._worker_supervisor = None
         self._watchdog_stop.set()
         if self._watchdog is not None:
             self._watchdog.join(timeout=5)
@@ -632,7 +642,13 @@ class ElasticSupervisor(Logger):
     - ``stall`` — heartbeats flow but a step counter froze past the
       stall timeout (hung collective / seized host);
     - ``loss`` — a child died (any other nonzero exit) or its
-      heartbeat went stale/missing.
+      heartbeat went stale/missing;
+    - ``sdc`` (round 19) — a child exited :data:`EXIT_SDC` after the
+      integrity sentinel confirmed its chip computes wrong values:
+      the culprit is BLOCKLISTED and the restart resumes from the
+      gang-attested PRE-divergence snapshot (heartbeat annotation
+      ``sdc_last_good``), not the newest one — snapshots written
+      after the divergence may already carry the corruption.
 
     Every restart shrinks the gang by the lost processes and relaunches
     on the surviving host set (``znicz_elastic_restarts_total``)."""
@@ -671,6 +687,12 @@ class ElasticSupervisor(Logger):
         #: run() summary (also returned): attempts, restarts, losses by
         #: kind, resume snapshots, checkpoint-on-signal folds, ...
         self.summary: dict = {}
+        #: round 19: process indices confirmed corrupt by the SDC
+        #: sentinel — never relaunched (the "corrupt-chip quarantine")
+        self.blocklist: set[int] = set()
+        #: pre-divergence snapshot annotated by the gang at an SDC
+        #: quarantine — overrides newest_good_snapshot for the restart
+        self._sdc_resume: str | None = None
         os.makedirs(work_dir, exist_ok=True)
 
     # -- one attempt ----------------------------------------------------
@@ -734,7 +756,10 @@ class ElasticSupervisor(Logger):
     def _fold_heartbeats(self, hb_dir: str, n: int) -> None:
         """Worker-side attestations ride the heartbeat channel; fold
         them into THIS process's registry so the dryrun scrape sees one
-        coherent story (checkpoint-on-signal counts, resume steps)."""
+        coherent story (checkpoint-on-signal counts, resume steps,
+        SDC verdicts)."""
+        sdc_detected: dict[str, float] = {}
+        sdc_injected: dict[str, float] = {}
         for i in range(n):
             hb = _read_json(heartbeat_path(hb_dir, i))
             if not hb:
@@ -744,6 +769,27 @@ class ElasticSupervisor(Logger):
                     float(hb["checkpoint_on_signal"]))
             if hb.get("resumed_step") is not None:
                 self.summary["resumed_step"] = int(hb["resumed_step"])
+            # round 19: SDC quarantine attestations — every gang
+            # member annotates the SAME verdict (the vote is
+            # symmetric), so detection counts fold as a MAX across
+            # members while injected-fault counts (which fired only on
+            # the culprit) fold as written
+            if hb.get("sdc_last_good"):
+                self._sdc_resume = str(hb["sdc_last_good"])
+            if hb.get("sdc_culprits"):
+                self.summary.setdefault("sdc_culprits", sorted(
+                    int(p) for p in hb["sdc_culprits"]))
+            for kind, count in (hb.get("sdc_detected") or {}).items():
+                sdc_detected[kind] = max(sdc_detected.get(kind, 0.0),
+                                         float(count))
+            for site, count in (hb.get("faults_injected")
+                                or {}).items():
+                sdc_injected[site] = sdc_injected.get(site, 0.0) \
+                    + float(count)
+        for kind, count in sdc_detected.items():
+            _metrics.sdc_detected(kind).inc(count)
+        for site, count in sdc_injected.items():
+            _metrics.faults_injected(site).inc(count)
 
     def _tail(self, proc: subprocess.Popen, n: int = 2000) -> str:
         path = getattr(proc, "_znicz_log", None)
@@ -764,8 +810,16 @@ class ElasticSupervisor(Logger):
             os.makedirs(hb_dir, exist_ok=True)
             resume = self.initial_snapshot
             if attempt > 0:
-                resume = newest_good_snapshot(self.snapshot_dir,
-                                              self.snapshot_prefix)
+                if self._sdc_resume:
+                    # SDC restart: snapshots written AFTER the
+                    # divergence may already carry the corruption —
+                    # resume from the gang-attested PRE-divergence one
+                    resume = self._sdc_resume
+                    self._sdc_resume = None
+                    self.summary["resumed"] = "pre-divergence"
+                else:
+                    resume = newest_good_snapshot(self.snapshot_dir,
+                                                  self.snapshot_prefix)
             resume_snapshots.append(resume)
             self.monitor = HeartbeatMonitor(
                 hb_dir, n, timeout_s=self.heartbeat_timeout_s,
@@ -791,6 +845,7 @@ class ElasticSupervisor(Logger):
                     for i, rc in enumerate(rcs):
                         if rc is not None and rc != 0 and i not in dead:
                             dead[i] = ("preempt" if rc == EXIT_PREEMPTED
+                                       else "sdc" if rc == EXIT_SDC
                                        else "loss")
                             self.warning(
                                 "worker %d exited rc=%d (%s)\n%s", i,
@@ -819,6 +874,30 @@ class ElasticSupervisor(Logger):
                         dead.setdefault(i, kind)
                     if dead:
                         break
+                # 113-only observation: every exit seen so far is a
+                # watchdog/SDC-peer victim — the ROOT CAUSE (a dead
+                # host, or an EXIT_SDC culprit racing its peers to the
+                # exit) may surface within a short settle window
+                if dead and all(
+                        k == "loss"
+                        and procs[i].poll() == EXIT_PEER_LOST
+                        for i, k in dead.items()):
+                    deadline = time.time() + min(self.drain_s, 5.0)
+                    while time.time() < deadline:
+                        found_root = False
+                        for i, proc in enumerate(procs):
+                            rc = proc.poll()
+                            if rc is not None and rc != 0 \
+                                    and i not in dead:
+                                dead[i] = (
+                                    "preempt" if rc == EXIT_PREEMPTED
+                                    else "sdc" if rc == EXIT_SDC
+                                    else "loss")
+                                if rc != EXIT_PEER_LOST:
+                                    found_root = True
+                        if found_root:
+                            break
+                        time.sleep(self.poll_interval_s)
                 # a stall needs a settle window to tell culprit from
                 # victim: the hung peer's watchdog exits it
                 # EXIT_PEER_LOST while the seized host stays alive
@@ -852,11 +931,15 @@ class ElasticSupervisor(Logger):
                                  if procs[i].poll() in (None, -15, -9)}
                 if alive_stalled and alive_stalled != stalled:
                     stalled = alive_stalled
+            # round 19: an EXIT_SDC child is a sentinel-confirmed
+            # corrupt chip — quarantined (blocklisted), never a victim
+            sdc_hosts = {i for i, k in dead.items() if k == "sdc"}
             hard_lost = {i for i, k in dead.items()
                          if k == "loss"
                          and procs[i].poll() != EXIT_PEER_LOST} | stalled
-            n_lost = max(1, len(hard_lost) + len(preempted))
-            if not hard_lost and not preempted:
+            n_lost = max(1, len(hard_lost) + len(preempted)
+                         + len(sdc_hosts))
+            if not hard_lost and not preempted and not sdc_hosts:
                 # every observed exit was a watchdog victim — the root
                 # cause never even reached the channel; one host is
                 # gone all the same
@@ -869,6 +952,13 @@ class ElasticSupervisor(Logger):
             for i in sorted(preempted):
                 losses["preempt"] = losses.get("preempt", 0) + 1
                 _metrics.host_losses("preempt").inc()
+            for i in sorted(sdc_hosts):
+                losses["sdc"] = losses.get("sdc", 0) + 1
+                self.blocklist.add(i)
+                _metrics.host_losses("sdc").inc()
+                _metrics.sdc_quarantined("host").inc()
+            if self.blocklist:
+                self.summary["blocklisted"] = sorted(self.blocklist)
             survivors = n - n_lost
             if survivors < 1:
                 # preemption of the LAST host: the checkpoint survives,

@@ -123,7 +123,8 @@ class ServingEngine(Logger):
                  breaker_failure_rate: float = 0.5,
                  breaker_window: int = 8,
                  breaker_cooldown_ms: float = 1000.0,
-                 max_queue_age_ms: float | None = 10_000.0) -> None:
+                 max_queue_age_ms: float | None = 10_000.0,
+                 shadow_audit_rate: float | None = None) -> None:
         super().__init__()
         from znicz_tpu.export import ExportedModel  # deferred: cycle
         if max_batch < 1:
@@ -187,6 +188,28 @@ class ServingEngine(Logger):
         self.swap_counts = {"promoted": 0, "rejected": 0,
                             "rolled_back": 0}
         self._swap_pauses: list[float] = []  # seconds, per swap
+        # round 19: sampled SDC shadow audit — a fraction of batches
+        # is re-scored against the COMPILE-FREE numpy oracle; a
+        # mismatching reply marks this replica SUSPECT (every later
+        # batch audits + the reply is corrected from the oracle) and
+        # fires on_sdc_suspect so a ReplicaGroup can quarantine it.
+        from znicz_tpu.utils.config import root as _root
+        self.shadow_audit_rate = float(
+            _root.common.serving.get("sdc_audit_rate", 0.0)
+            if shadow_audit_rate is None else shadow_audit_rate)
+        self.sdc_audit_rtol = float(
+            _root.common.serving.get("sdc_audit_rtol", 0.05))
+        #: replica identity for sdc.serving_bitflip context filters
+        #: and suspect attribution (a ReplicaGroup stamps its own)
+        self.sdc_replica = self._obs_id
+        #: callable(engine) invoked once on the first confirmed
+        #: mismatch — the ReplicaGroup repair hook
+        self.on_sdc_suspect = None
+        self.sdc_suspect = False
+        self._audit_acc = 0.0
+        self._audit_stats = {"audited": 0, "mismatched": 0}
+        self._oracle = None
+        self._oracle_version = None
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -409,6 +432,7 @@ class ServingEngine(Logger):
         params = self.model.live_params or None
         out = np.asarray(self.model.program_for(size)(
             buf, _params=params))
+        out = self._shadow_audit(buf, out, row)
         now = time.monotonic()
         row = 0
         for req in batch:
@@ -428,6 +452,77 @@ class ServingEngine(Logger):
                 lat = now - req.t_submit
                 self._lat.append(lat)
                 self._m_latency.observe(lat)
+
+    # ------------------------------------------------------------------
+    # round 19: sampled SDC shadow audit
+    # ------------------------------------------------------------------
+    def _shadow_oracle(self):
+        """The compile-free numpy oracle over the CURRENT weights
+        (rebuilt lazily after a hot-swap — cached K/V-free forward on
+        the host, never a serving-AOT compile)."""
+        if self._oracle is None \
+                or self._oracle_version != self.model.weights_version:
+            from znicz_tpu.backends import NumpyDevice
+            from znicz_tpu.export import ExportedModel
+            manifest, params = self.current_bundle()
+            host = {k: np.asarray(v) for k, v in params.items()}
+            self._oracle = ExportedModel(dict(manifest), host,
+                                         device=NumpyDevice())
+            self._oracle_version = self.model.weights_version
+        return self._oracle
+
+    def _shadow_audit(self, buf: np.ndarray, out: np.ndarray,
+                      rows: int) -> np.ndarray:
+        """Scheduler-thread tail of a dispatch: apply the seeded
+        ``sdc.serving_bitflip`` (chaos), then — for the sampled
+        fraction (``shadow_audit_rate``, every batch once suspect) —
+        re-score the real rows on the numpy oracle.  A mismatch marks
+        this replica suspect, CORRECTS the reply from the oracle (the
+        caller never receives the wrong answer), and fires
+        ``on_sdc_suspect`` exactly once so the owning ReplicaGroup
+        can remove the replica via its repair path."""
+        flip = _faults.fire("sdc.serving_bitflip",
+                            replica=self.sdc_replica)
+        if flip is not None:
+            out = np.array(out, copy=True)
+            out[:, 0] = out[:, 0] * float(flip.get("factor", 2.0 ** 14))
+        rate = self.shadow_audit_rate
+        if rate <= 0.0 and not self.sdc_suspect:
+            return out
+        self._audit_acc += rate
+        audit = self.sdc_suspect or self._audit_acc >= 1.0
+        if self._audit_acc >= 1.0:
+            self._audit_acc -= 1.0
+        if not audit or rows == 0:
+            return out
+        ref = np.asarray(self._shadow_oracle()(
+            np.asarray(buf[:rows], dtype=np.float32)))
+        got = np.asarray(out[:rows], dtype=np.float32)
+        self._audit_stats["audited"] += 1
+        scale = np.maximum(np.abs(ref), 1.0)
+        if np.all(np.abs(got - ref) <= self.sdc_audit_rtol * scale):
+            return out
+        self._audit_stats["mismatched"] += 1
+        first = not self.sdc_suspect
+        self.sdc_suspect = True
+        from znicz_tpu.parallel.process_shard import process_info
+        _metrics.sdc_suspects(process_info()[0],
+                              self.sdc_replica).inc()
+        if first:
+            _metrics.sdc_detected("serving").inc()
+            self.error(
+                "SDC shadow audit: replica %s returned wrong scores "
+                "(max dev %.3g) — reply corrected from the oracle, "
+                "replica marked suspect", self.sdc_replica,
+                float(np.max(np.abs(got - ref))))
+        out = np.array(out, copy=True)
+        out[:rows] = ref.astype(out.dtype)
+        if first and self.on_sdc_suspect is not None:
+            try:
+                self.on_sdc_suspect(self)
+            except Exception as exc:  # noqa: BLE001 — audit must not
+                self.error("on_sdc_suspect hook failed: %s", exc)
+        return out
 
     # ------------------------------------------------------------------
     # telemetry
@@ -489,6 +584,9 @@ class ServingEngine(Logger):
                 "shed": b.shed_total if b else 0,
                 "queue_age_ms": round(1e3 * b.oldest_age_s(), 1)
                 if b else 0.0,
+                "sdc": {"audit_rate": self.shadow_audit_rate,
+                        "suspect": self.sdc_suspect,
+                        **self._audit_stats},
             }
         if lat:
             out["latency_ms"] = {

@@ -329,9 +329,13 @@ class ReplicaGroup(Logger):
         self.max_replicas = int(max_replicas)
         self._replicas: list = []
         self._rr = itertools.count()
+        self._replica_seq = itertools.count()
         self._lock = threading.Lock()
         self._m_replicas = _metrics.fleet_replicas(
             fleet_id, f"{model_id}@{version}")
+        #: replica ids removed by the round-19 SDC shadow audit (the
+        #: "corrupt-chip quarantine", serving side)
+        self.sdc_quarantined: list[str] = []
 
     def live(self) -> int:
         return len(self._replicas)
@@ -349,6 +353,11 @@ class ReplicaGroup(Logger):
         with self._lock:
             while len(self._replicas) < n:
                 eng = self._factory()
+                # round 19: replica identity + quarantine hook for the
+                # SDC shadow audit (no-ops on engines without it)
+                eng.sdc_replica = (f"{self.model_id}@{self.version}"
+                                   f"#r{next(self._replica_seq)}")
+                eng.on_sdc_suspect = self.quarantine_replica
                 self._replicas.append(eng)
                 started.append(eng)
             while len(self._replicas) > n:
@@ -379,9 +388,37 @@ class ReplicaGroup(Logger):
                      self.model_id, self.version, self.live())
         return True
 
+    def quarantine_replica(self, eng) -> bool:
+        """Round 19: remove a shadow-audit-confirmed corrupt replica
+        from the routing set (``znicz_sdc_quarantined_total{kind=
+        replica}``) — the serving-side corrupt-chip quarantine.
+        Shutdown drains on a helper thread because this is invoked
+        from the suspect engine's OWN scheduler thread (its remaining
+        queued batches serve oracle-corrected replies — zero wrong
+        answers after detection); the autoscaler's existing
+        live-below-target repair path (or an explicit
+        ``scale_to(target, reason="repair")``) restores capacity
+        compile-free."""
+        with self._lock:
+            if eng not in self._replicas:
+                return False
+            self._replicas.remove(eng)
+            self.sdc_quarantined.append(
+                getattr(eng, "sdc_replica", "?"))
+        self._m_replicas.set(self.live())
+        _metrics.sdc_quarantined("replica").inc()
+        self.warning(
+            "replica %s of %s@%s QUARANTINED by the SDC shadow audit "
+            "— %d live", getattr(eng, "sdc_replica", "?"),
+            self.model_id, self.version, self.live())
+        threading.Thread(target=eng.shutdown, name="sdc-quarantine",
+                         daemon=True).start()
+        return True
+
     def pick(self):
-        """Next live replica (round-robin), skipping breaker-open
-        replicas; None when the group is empty or fully shedding."""
+        """Next live replica (round-robin), skipping breaker-open and
+        SDC-suspect replicas; None when the group is empty or fully
+        shedding."""
         with self._lock:
             replicas = list(self._replicas)
         if not replicas:
@@ -389,7 +426,8 @@ class ReplicaGroup(Logger):
         start = next(self._rr)
         for i in range(len(replicas)):
             eng = replicas[(start + i) % len(replicas)]
-            if getattr(eng, "breaker_state", "closed") != "open":
+            if getattr(eng, "breaker_state", "closed") != "open" \
+                    and not getattr(eng, "sdc_suspect", False):
                 return eng
         return None
 

@@ -142,6 +142,16 @@ class WebStatusServer(Logger):
                 self.end_headers()
                 self.wfile.write(body)
 
+        # round-19 satellite: every /metrics endpoint exports
+        # znicz_build_info (fleet debugging must tell which build a
+        # scrape came from).  Fallback registration only — device
+        # creation refreshes with platform/mesh/process labels; no
+        # backend query here (the TPU tunnel can wedge on one).
+        try:
+            from znicz_tpu.observe import metrics as _metrics
+            _metrics.set_build_info(fallback=True)
+        except Exception:  # noqa: BLE001 — never block the dashboard
+            pass
         self._httpd = ThreadingHTTPServer((host, port), Handler)
         self.host = host
         self.port = self._httpd.server_address[1]
@@ -208,7 +218,11 @@ class WebStatusServer(Logger):
           GOOD artifact; not-ready when it exceeds
           ``engine.ready_max_snapshot_age_s`` (default unset =
           report-only), so a stalled trainer that stopped publishing
-          shows up on the serving probe.
+          shows up on the serving probe;
+        - ``znicz_loader_rows_quarantined_total`` (round 19) — rows a
+          quarantined shard delivered as zeros, per loader.
+          REPORT-ONLY: quarantine-and-continue is degraded, not dead
+          — restarting would lose more progress than the zeros cost.
         """
         from znicz_tpu.observe import metrics
         from znicz_tpu.utils.config import root
@@ -276,6 +290,18 @@ class WebStatusServer(Logger):
                 if max_hb is not None and age > float(max_hb):
                     not_ready(f"process {process} heartbeat "
                               f"{age:.0f}s stale")
+        # round 19: silent data loss made loud — rows a quarantined
+        # shard delivered as ZEROS.  REPORT-ONLY by design: a run that
+        # chose quarantine-and-continue is degraded, not dead, and an
+        # external supervisor restarting it would lose MORE progress;
+        # the row count here (and on /metrics) is the operator signal.
+        fam = metrics.REGISTRY.get("znicz_loader_rows_quarantined_total")
+        if fam is not None:
+            out["loaders"] = {}
+            for key, child in fam.items():
+                (loader,) = key
+                out["loaders"][loader] = {
+                    "rows_quarantined": int(child.value)}
         fam = metrics.REGISTRY.get("znicz_model_version")
         if fam is not None:
             for key, child in fam.items():
