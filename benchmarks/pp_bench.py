@@ -1,0 +1,222 @@
+"""Pipeline-parallelism + gradient-accumulation A/B (round 20).
+
+Two questions, two arms, one PP_BENCH.json:
+
+1. **Memory** — gradient accumulation's whole point: at equal global
+   batch, the accumulated arm (M microbatches through the scan) must
+   hold fewer live device bytes than the fused arm (the full batch's
+   activations at once).  Measured as the live-buffer byte census
+   after a warmed step (CPU: ``jax.live_arrays``; ``PP_TPU=1`` also
+   reads ``device.memory_stats`` on the ambient chip).  The f32
+   ``acc_micro_*`` gradient bank is part of the accumulation arm's
+   bill — the win must survive it.
+2. **Schedule** — 1F1B vs GPipe over the same 4-stage chain: both
+   run the identical tick count (synchronous schedules share the
+   (K−1)/(M+K−1) bubble), but 1F1B caps live microbatch contexts at
+   ``min(K−s, M)`` per stage vs GPipe's M.  The temporal executor
+   reports its measured makespan/bubble seconds; the schedule sim
+   reports the context peaks the spatial deployment would bank on.
+
+Exits 1 when the accumulation arm fails to reduce live bytes.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+ON_TPU = os.environ.get("PP_TPU") == "1"
+
+
+def _pin_platform() -> None:
+    if ON_TPU:
+        return
+    import jax
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
+
+N, D, HIDDEN = 512, 256, 256
+GLOBAL_BATCH = 256
+MICRO = 8  # accumulation arm: 8 microbatches of 32
+
+
+def _build(name: str, minibatch_size: int, grad_accum: int,
+           n_layers: int = 4):
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils import prng
+    from znicz_tpu.utils.config import root
+    root.common.engine.grad_accum = grad_accum
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(N, D)).astype(np.float32)
+    prng.seed_all(11)
+    wf = StandardWorkflow(
+        name=name,
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data, minibatch_size=minibatch_size),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": HIDDEN},
+                 "<-": {"learning_rate": 0.02,
+                        "gradient_moment": 0.9}}] * (n_layers - 1)
+               + [{"type": "all2all",
+                   "->": {"output_sample_shape": D},
+                   "<-": {"learning_rate": 0.02,
+                          "gradient_moment": 0.9}}],
+        loss="mse",
+        decision_config={"max_epochs": 10 ** 6})
+    wf._max_fires = 10 ** 9
+    wf.initialize(device=XLADevice())
+    return wf
+
+
+def _live_bytes() -> int:
+    import jax
+    gc.collect()
+    return sum(int(np.prod(a.shape)) * a.dtype.itemsize
+               for a in jax.live_arrays())
+
+
+def _device_stats_bytes() -> int | None:
+    import jax
+    stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+    if not stats:
+        return None
+    return int(stats.get("bytes_in_use", 0)) or None
+
+
+def _memory_arm() -> dict:
+    """Fused global batch vs M accumulated microbatches: live device
+    bytes + step time after one warmed optimizer step each."""
+    results = {}
+    for tag, mb, accum in (("fused", GLOBAL_BATCH, 1),
+                           ("accum", GLOBAL_BATCH // MICRO, MICRO)):
+        base = _live_bytes()
+        wf = _build(f"pp_mem_{tag}", mb, accum)
+        region = wf._region_unit.region
+
+        def step():
+            if accum > 1:
+                for _ in range(accum):
+                    wf.loader.run()
+                region.run_accum(accum)
+            else:
+                wf.loader.run()
+                region.run()
+
+        step()  # compile + warm
+        t0 = time.perf_counter()
+        step()
+        dt = time.perf_counter() - t0
+        results[tag] = {
+            "minibatch": mb,
+            "microbatches": accum,
+            "global_batch": mb * accum,
+            "live_bytes": _live_bytes() - base,
+            "device_bytes_in_use": _device_stats_bytes(),
+            "optimizer_step_ms": round(dt * 1e3, 3),
+        }
+        del wf, region
+        gc.collect()
+    fused, acc = results["fused"], results["accum"]
+    results["live_bytes_ratio"] = round(
+        acc["live_bytes"] / max(fused["live_bytes"], 1), 4)
+    return results
+
+
+def _schedule_arm() -> dict:
+    """1F1B vs GPipe over 4 stages × MICRO microbatches: measured
+    makespan/bubble on the temporal executor + the schedule sim's
+    per-stage live-context peaks."""
+    from znicz_tpu.parallel import pipeline as pp
+    n_stages = 4
+    out: dict = {
+        "n_stages": n_stages,
+        "n_micro": MICRO,
+        "bubble_fraction_analytic": round(
+            pp.bubble_fraction(n_stages, MICRO), 4),
+    }
+    for kind in ("1f1b", "gpipe"):
+        ticks = pp.build_schedule(n_stages, MICRO, kind)
+        peaks = []
+        for stage in range(n_stages):
+            live = peak = 0
+            for tick in ticks:
+                for op_kind, s, _ in tick:
+                    if s == stage:
+                        live += 1 if op_kind == "F" else -1
+                        peak = max(peak, live)
+            peaks.append(peak)
+        wf = _build(f"pp_sched_{kind}", GLOBAL_BATCH // MICRO, MICRO)
+        ex = pp.PipelineExecutor(wf, n_stages, MICRO, schedule=kind)
+        for _ in range(MICRO):
+            wf.loader.run()
+        ex.run_step()  # compile + warm every stage/phase program
+        spans = []
+        for _ in range(3):
+            for _ in range(MICRO):
+                wf.loader.run()
+            spans.append(ex.run_step())
+        best = min(spans, key=lambda s: s["makespan"])
+        out[kind] = {
+            "ticks": len(ticks),
+            "peak_live_contexts_per_stage": peaks,
+            "makespan_ms": round(best["makespan"] * 1e3, 3),
+            "bubble_seconds_ms": round(best["bubble_seconds"] * 1e3, 3),
+            "bubble_fraction_measured": round(
+                best["bubble_seconds"]
+                / max(n_stages * best["makespan"], 1e-9), 4),
+        }
+        del wf, ex
+        gc.collect()
+    return out
+
+
+def main() -> int:
+    _pin_platform()
+    import jax
+
+    memory = _memory_arm()
+    schedule = _schedule_arm()
+    row = {
+        "bench": "pipeline_parallelism",
+        "platform": jax.devices()[0].platform,
+        "memory": memory,
+        "schedule": schedule,
+        "note": ("temporal executor: stages time-multiplex one device "
+                 "set, so makespan measures dispatch order not "
+                 "speedup; the memory arm and the live-context peaks "
+                 "are the numbers a spatial pipe-axis deployment "
+                 "banks on (PP_TPU=1 row in CHIP_QUEUE.md)"),
+    }
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PP_BENCH.json")
+    with open(path, "w") as fh:
+        json.dump(row, fh, indent=1)
+    ratio = memory["live_bytes_ratio"]
+    print(f"pp bench: accum/fused live bytes ratio={ratio} "
+          f"(fused={memory['fused']['live_bytes']}, "
+          f"accum={memory['accum']['live_bytes']}), "
+          f"1f1b peak contexts="
+          f"{schedule['1f1b']['peak_live_contexts_per_stage']} vs "
+          f"gpipe={schedule['gpipe']['peak_live_contexts_per_stage']} "
+          f"→ {path}")
+    if ratio >= 1.0:
+        print("FAIL: accumulation arm did not reduce live bytes")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

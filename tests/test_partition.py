@@ -105,6 +105,47 @@ def test_member_model_dim_zero_rejected():
         t.resolve("pop/x.y", (8, 4), n_data=4)
 
 
+def test_stage_tag_composes_with_fallthrough_to_defaults():
+    """A ``Stage(k)`` rule with no inner placement decides WHICH stage
+    owns the leaf and falls through to the next matching rule for the
+    actual placement — the default tail keeps its say."""
+    t = table()
+    t.declare(r"^fc1/", partition.Stage(1))
+    res = t.resolve("fc1/output", (8, 16), n_data=4)
+    assert res.stage == 1
+    assert res.batch_major and tuple(res.spec) == (DATA_AXIS, None)
+    res = t.resolve("fc1/weights", (12, 16), n_data=4)
+    assert res.stage == 1 and tuple(res.spec) == ()
+    # unstaged leaves resolve with no tag
+    assert t.resolve("fc2/weights", (12, 16), n_data=4).stage is None
+
+
+def test_stage_tag_composes_with_inner_placement():
+    t = table()
+    t.declare(r"^fc1/weights$",
+              partition.Stage(2, inner=P(None, MODEL_AXIS)))
+    res = t.resolve("fc1/weights", (8, 16), n_data=4)
+    assert res.stage == 2
+    assert tuple(res.spec) == (None, MODEL_AXIS)
+    assert res.model_shard_dim == 1
+
+
+def test_stage_rule_keeps_unmatched_leaf_hard_error():
+    """Staging a leaf must not silence the no-placement hard error:
+    a Stage tag is not a placement."""
+    t = table()
+    t.declare(r"^fc1/", partition.Stage(0))
+    with pytest.raises(partition.UnmatchedLeafError, match="no rule"):
+        t.resolve("fc1/definitely_not_a_slot", (4, 4))
+
+
+def test_stage_scalars_short_circuit_untagged():
+    t = table()
+    t.declare(r"^fc1/", partition.Stage(3))
+    res = t.resolve("fc1/n_err", ())
+    assert res.rule == "<scalar>" and res.stage is None
+
+
 def test_default_tail_covers_canonical_slots():
     t = table()
     batch = t.resolve("fc1/output", (8, 16), n_data=4)
@@ -310,6 +351,9 @@ def _assert_covered(wf):
         audit = t.audit(path)
         assert len(audit["overrides"]) <= 1, audit
         assert audit["overrides"] or len(audit["defaults"]) == 1, audit
+        # round 20: a leaf may carry at most one pipeline-stage tag, and
+        # a Stage tag never substitutes for a placement
+        assert len(audit.get("stages", ())) <= 1, audit
     return len(t.leaves)
 
 
@@ -416,6 +460,74 @@ def test_ring_rides_seq_axis_on_3d_mesh():
     wf.run()
     # 24 valid samples, 3 classes: chance ≈ 16 — must beat it clearly
     assert wf.decision.min_validation_n_err <= 8
+
+
+def test_stage_tags_and_linter_with_pipe_axis_mesh():
+    """Round 20: a mesh with a leading ``pipe`` axis plus ``Stage(k)``
+    overrides keeps every linter invariant — ≤1 placement override,
+    exactly-one-default, ≤1 stage tag per leaf — and the unused pipe
+    axis (leaves replicate across it) trains BITWISE identically to
+    the same table on the pipe-less mesh."""
+    from znicz_tpu.parallel import mesh_for_stage
+    from znicz_tpu.parallel.axis import PIPE_AXIS
+
+    mesh = make_mesh(n_data=4, n_pipe=2)
+    assert mesh.axis_names[0] == PIPE_AXIS
+    assert dict(mesh.shape) == {PIPE_AXIS: 2, DATA_AXIS: 4, MODEL_AXIS: 1}
+    sub = mesh_for_stage(mesh, 1)
+    assert PIPE_AXIS not in sub.axis_names
+    assert dict(sub.shape) == {DATA_AXIS: 4, MODEL_AXIS: 1}
+    plain = make_mesh(n_data=4)
+    assert mesh_for_stage(plain, 0) is plain  # no pipe axis → identity
+
+    data, labels = make_blobs(40, N_CLASSES, DIM, seed=13)
+
+    def arm(mesh, staged):
+        prng.seed_all(77)
+        wf = StandardWorkflow(
+            name="partition_pipe_axis",
+            loader_factory=lambda w: ArrayLoader(
+                w, train_data=data[:96], train_labels=labels[:96],
+                valid_data=data[96:], valid_labels=labels[96:],
+                minibatch_size=24),
+            layers=[
+                {"type": "all2all_tanh", "->": {"output_sample_shape": 8},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": N_CLASSES},
+                 "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            ],
+            decision_config={"max_epochs": 3})
+        wf.initialize(device=XLADevice(mesh=mesh))
+        if staged:
+            # tag each forward chain with a stage the way
+            # PipelineExecutor._declare_stage_rules does
+            for s, fwd in enumerate(wf.forwards):
+                pat = rf"^{re.escape(fwd.name)}/"
+                wf.partition.declare(pat, partition.Stage(s))
+                for path, leaf in wf.partition.leaves.items():
+                    if re.match(pat, path) and leaf.rule != "<scalar>":
+                        leaf.stage = s
+        _assert_covered(wf)
+        wf.run()
+        return gather_state(wf), wf
+
+    piped, wf = arm(mesh, staged=True)
+    tags = {r.stage for r in wf.partition.leaves.values()
+            if r.stage is not None}
+    assert tags == {0, 1}, tags
+    # a staged leaf still resolves a real placement through fall-through
+    fc = wf.forwards[0]
+    audit = wf.partition.audit(f"{fc.name}/weights")
+    assert len(audit["stages"]) == 1
+    assert audit["overrides"] or len(audit["defaults"]) == 1
+
+    flat, _ = arm(plain, staged=False)
+    assert piped.keys() == flat.keys()
+    for key in piped:
+        np.testing.assert_array_equal(
+            piped[key], flat[key],
+            err_msg=f"pipe-axis mesh perturbed {key}")
 
 
 def test_no_unit_module_sets_shard_attributes_directly():

@@ -168,6 +168,69 @@ def test_warmed_anomaly_guard_chaos_steps_zero_new_compiles():
                                       "loss").value >= 1
 
 
+def test_warmed_grad_accum_scan_zero_new_compiles():
+    """Round 20: the accumulate-then-apply step is ONE cache entry
+    (``lax.scan`` of M−1 accum bodies + 1 apply body) — after the
+    first accumulated step compiles it, further steps and the
+    unaccumulated eval variant must all hit the program cache."""
+    from znicz_tpu.utils.config import root
+    root.common.engine.grad_accum = 4
+    wf = _build_wf("retrace_accum")
+    region = wf._region_unit.region
+    compiles = obs_metrics.xla_compiles(f"region:{wf._region_unit.name}")
+
+    def one_epoch():
+        # 48 train @ 12 = 4 TRAIN microbatches = ONE accumulated
+        # step, then the 2 valid minibatches run unaccumulated
+        for _ in range(4):
+            wf.loader.run()
+        region.run_accum(4)
+        for _ in range(2):
+            wf.loader.run()
+            region.run()
+
+    one_epoch()  # warmup: the accum scan + the eval variant compile
+    warmed = compiles.value
+    assert warmed >= 2
+    one_epoch()
+    one_epoch()
+    assert compiles.value == warmed, (
+        f"warmed accumulation steps recompiled: "
+        f"{compiles.value - warmed} new XLA programs")
+
+
+def test_warmed_pipeline_1f1b_zero_new_compiles():
+    """Round 20: every (stage, phase) pair is its own non-donated
+    cache entry — 2 stages × (fwd + accum-bwd + apply-bwd) programs
+    compile during the first 1F1B step; repeat steps across epoch
+    boundaries must add ZERO new XLA programs in any stage region."""
+    from znicz_tpu.parallel.pipeline import PipelineExecutor
+    from znicz_tpu.utils.config import root
+    root.common.engine.grad_accum = 4
+    wf = _build_wf("retrace_pipe")
+    ex = PipelineExecutor(wf, n_stages=2, n_micro=4)
+    counters = [obs_metrics.xla_compiles(f"region:{r.name}")
+                for r in ex.fwd_regions + ex.bwd_regions]
+    counters.append(
+        obs_metrics.xla_compiles(f"region:{wf._region_unit.name}"))
+
+    def one_epoch():
+        for _ in range(4):
+            wf.loader.run()
+        ex.run_step()
+        for _ in range(2):  # valid minibatches stay on the unstaged
+            wf.loader.run()  # region program
+            wf._region_unit.region.run()
+
+    one_epoch()  # warmup: every stage/phase program compiles here
+    warmed = sum(c.value for c in counters)
+    assert warmed >= 2 * 2 + 1  # ≥ per-stage fwd+bwd, + eval variant
+    one_epoch()
+    one_epoch()
+    assert sum(c.value for c in counters) == warmed, \
+        "warmed 1F1B pipeline steps recompiled"
+
+
 def test_warmed_sdc_sentinel_zero_new_compiles_and_bitwise_parity():
     """Round 19: the SDC sentinel's fingerprints ride the SAME region
     program (fold = part of the step; vote + shadow audit = pure host

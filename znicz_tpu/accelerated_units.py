@@ -45,6 +45,26 @@ from znicz_tpu.utils import prng
 from znicz_tpu.utils.logger import Logger
 from znicz_tpu.workflow import Workflow
 
+#: the gradient-accumulation phase active for the CURRENT region trace
+#: (round 20).  ``None`` outside accumulation (the historical single
+#: fused-batch step); ``("accum", M)`` while tracing a microbatch body
+#: whose gradients must be summed into the micro-accumulation buffers
+#: without touching parameters; ``("apply", M)`` while tracing the
+#: final microbatch, whose body folds the buffered sum into one
+#: optimizer step.  Tracing is synchronous and single-threaded inside
+#: ``JitRegion.build_callable``, so a module global (set/reset in the
+#: traced function body, i.e. AT TRACE TIME) is sufficient — the value
+#: never needs to survive into the compiled program, it only steers
+#: which ops get traced (``GradientDescentBase._apply_param_xla``,
+#: the evaluator's flag seeding, the anomaly guard's commit).
+_ACCUM_PHASE: "tuple[str, int] | None" = None
+
+
+def current_accum_phase() -> "tuple[str, int] | None":
+    """The accumulation phase of the region body currently being
+    traced (``None`` / ``("accum", M)`` / ``("apply", M)``)."""
+    return _ACCUM_PHASE
+
 
 class AcceleratedUnit(Unit):
     """Base class for compute units with oracle + XLA paths."""
@@ -306,12 +326,21 @@ class JitRegion(Logger):
         for vec, leaf in zip(vectors, out):
             vec.devmem = leaf
 
-    def build_callable(self, skips: tuple[bool, ...]):
+    def build_callable(self, skips: tuple[bool, ...],
+                       accum_phase: "tuple[str, int] | None" = None):
         """The pure (un-jitted) region function ``leaves -> leaves``,
         wrapping member ``xla_run``s in the Vector tracing harness.
         Single home of the tracing invariant — external jittable entry
         points (``__graft_entry__.entry``) reuse it instead of
-        re-threading ``Vector._tracing`` by hand."""
+        re-threading ``Vector._tracing`` by hand.
+
+        ``accum_phase`` (round 20) selects the gradient-accumulation
+        variant of the body: ``("accum", M)`` traces a
+        buffer-the-gradients microbatch, ``("apply", M)`` the final
+        microbatch that commits one optimizer step from the buffered
+        sum.  The phase is installed while the body traces (see
+        :data:`_ACCUM_PHASE`), so phase-aware units branch statically
+        — each phase is its own compiled program variant."""
         if self._vectors is None:
             self._vectors = self._collect_vectors()
         vectors = self._vectors
@@ -324,6 +353,9 @@ class JitRegion(Logger):
         named = _metrics.enabled()
 
         def fn(*leaves):
+            global _ACCUM_PHASE
+            prev_phase = _ACCUM_PHASE
+            _ACCUM_PHASE = accum_phase
             for vec, leaf in zip(vectors, leaves):
                 vec._tracing = True
                 vec._devmem = leaf
@@ -339,6 +371,7 @@ class JitRegion(Logger):
                             unit.xla_run()
                 return tuple(vec._devmem for vec in vectors)
             finally:
+                _ACCUM_PHASE = prev_phase
                 for vec in vectors:
                     vec._tracing = False
                 for unit in units:
@@ -387,44 +420,13 @@ class JitRegion(Logger):
             self.debug("region '%s': compiling %d-step scan chunk",
                        self.name, n_steps)
             _metrics.xla_compiles(f"region:{self.name}").inc()
-            body = self.build_callable(skips)
-            # Loop-invariant analysis: leaves the body never writes
-            # (datasets, schedule tables) must NOT ride the scan carry
-            # — XLA copies carries it cannot alias across iterations,
-            # which for a device-resident dataset means re-copying the
-            # whole table every step (measured 3.1 ms/step on a 1 GB
-            # table — PERF.md round 5).  A jaxpr outvar that IS the
-            # corresponding invar was passed through untouched; such
-            # leaves become closed-over scan-body inputs instead.
-            jaxpr = jax.make_jaxpr(body)(*leaves)
-            invariant = tuple(
-                ov is iv for ov, iv in zip(jaxpr.jaxpr.outvars,
-                                           jaxpr.jaxpr.invars))
-            # the probe jaxpr IS the step body — reuse it so the
-            # region is traced once, not once per analysis + once
-            # per jit
-            from jax.extend import core as jex_core
-            body = jex_core.jaxpr_as_fun(jaxpr)
+            body, invariant = self._analyzed_body(
+                self.build_callable(skips), leaves)
 
             def chunk_fn(*leaves):
-                ro = [l for l, inv in zip(leaves, invariant) if inv]
-
-                def step(carry, _):
-                    full, it_c, it_r = [], iter(carry), iter(ro)
-                    for inv in invariant:
-                        full.append(next(it_r) if inv else next(it_c))
-                    out = body(*full)
-                    return tuple(o for o, inv in zip(out, invariant)
-                                 if not inv), None
-
-                carry0 = tuple(l for l, inv in zip(leaves, invariant)
-                               if not inv)
-                out_rw, _ = jax.lax.scan(step, carry0, xs=None,
-                                         length=n_steps)
-                merged, it_w, it_r = [], iter(out_rw), iter(ro)
-                for inv in invariant:
-                    merged.append(next(it_r) if inv else next(it_w))
-                return tuple(merged)
+                scanned = self._scan_body(body, invariant, leaves,
+                                          n_steps)
+                return tuple(scanned)
 
             fn = self._cache[key] = jax.jit(
                 chunk_fn, donate_argnums=tuple(range(len(vectors))))
@@ -439,6 +441,160 @@ class JitRegion(Logger):
                                       cat="region", steps=n_steps):
                 out = fn(*leaves)
         _metrics.region_steps(self.name).inc(n_steps)
+        for vec, leaf in zip(vectors, out):
+            vec.devmem = leaf
+
+    # -- shared scan machinery (run_chunk / run_accum) ------------------
+    def _analyzed_body(self, body, leaves):
+        """Trace ``body`` once and split its leaves into loop-carried
+        vs loop-invariant.
+
+        Loop-invariant analysis: leaves the body never writes
+        (datasets, schedule tables) must NOT ride a scan carry — XLA
+        copies carries it cannot alias across iterations, which for a
+        device-resident dataset means re-copying the whole table every
+        step (measured 3.1 ms/step on a 1 GB table — PERF.md round 5).
+        A jaxpr outvar that IS the corresponding invar was passed
+        through untouched; such leaves become closed-over scan-body
+        inputs instead.  Returns ``(body_fn, invariant)`` where the
+        probe jaxpr IS the step body — the region is traced once, not
+        once per analysis + once per jit."""
+        jaxpr = jax.make_jaxpr(body)(*leaves)
+        invariant = tuple(
+            ov is iv for ov, iv in zip(jaxpr.jaxpr.outvars,
+                                       jaxpr.jaxpr.invars))
+        from jax.extend import core as jex_core
+        return jex_core.jaxpr_as_fun(jaxpr), invariant
+
+    @staticmethod
+    def _scan_body(body, invariant, leaves, length: int) -> list:
+        """``lax.scan`` the analyzed ``body`` ``length`` times over
+        ``leaves``: invariant leaves close over the scan, the rest ride
+        the carry; returns the full merged leaf list."""
+        ro = [l for l, inv in zip(leaves, invariant) if inv]
+
+        def step(carry, _):
+            full, it_c, it_r = [], iter(carry), iter(ro)
+            for inv in invariant:
+                full.append(next(it_r) if inv else next(it_c))
+            out = body(*full)
+            return tuple(o for o, inv in zip(out, invariant)
+                         if not inv), None
+
+        carry0 = tuple(l for l, inv in zip(leaves, invariant)
+                       if not inv)
+        out_rw, _ = jax.lax.scan(step, carry0, xs=None, length=length)
+        merged, it_w, it_r = [], iter(out_rw), iter(ro)
+        for inv in invariant:
+            merged.append(next(it_r) if inv else next(it_w))
+        return merged
+
+    def run_accum(self, n_micro: int) -> None:
+        """One ACCUMULATED optimizer step in ONE dispatch (round 20):
+        ``n_micro`` consecutive microbatches from the device-resident
+        loader schedule run accumulate-then-apply —
+
+        - microbatches ``0 .. n_micro-2`` trace in ``("accum", M)``
+          phase: forwards + backward gradients only, each weighted
+          GD summing its gradient into a float32 micro-accumulation
+          buffer (``acc_micro_*``) while parameters, momentum and the
+          anomaly/SDC state stay untouched;
+        - microbatch ``n_micro-1`` traces in ``("apply", M)`` phase:
+          its gradient joins the buffered sum, the mean
+          ``(Σ grads)/M`` flows through the UNCHANGED update path
+          (ZeRO-1, bf16 opt-state, anomaly gate, SDC fingerprints all
+          compose), and the buffers are zeroed for the next step.
+
+        The accum microbatches ride a ``lax.scan`` with the same
+        loop-invariance analysis as :meth:`run_chunk` (weights,
+        momentum and dataset tables close over the scan — they are
+        read-only in accum phase), so the whole accumulated step is
+        one donated-buffer program: per-chip batch/activation memory
+        stays at MICRObatch scale while the effective (optimizer)
+        batch is ``n_micro`` times larger.
+
+        Caller contract matches :meth:`run_chunk`, plus: all
+        ``n_micro`` schedule entries must be same-class (TRAIN) FULL
+        minibatches — ``StandardWorkflow.run_accumulated`` validates
+        divisibility and advances the host-side loader mirror.
+        """
+        if n_micro == 1:
+            return self.run()
+        if self._vectors is None:
+            self._vectors = self._collect_vectors()
+        vectors = self._vectors
+        for vec in vectors:
+            vec.unmap()
+        skips = tuple(bool(unit.gate_skip) for unit in self.units)
+        if self.debug_checks:
+            raise NotImplementedError(
+                "engine.debug_checks does not compose with "
+                "run_accum (checkify cannot thread the accumulation "
+                "scan); disable one of them")
+        key = tuple(unit.region_key() for unit in self.units) \
+            + (skips, "accum", n_micro)
+        fn = self._cache.get(key)
+        leaves = [vec._devmem for vec in vectors]
+        if fn is None:
+            self.debug("region '%s': compiling %d-microbatch "
+                       "accumulate-then-apply step", self.name, n_micro)
+            _metrics.xla_compiles(f"region:{self.name}").inc()
+            accum_body, invariant = self._analyzed_body(
+                self.build_callable(skips,
+                                    accum_phase=("accum", n_micro)),
+                leaves)
+            apply_body = self.build_callable(
+                skips, accum_phase=("apply", n_micro))
+
+            def accum_fn(*leaves):
+                merged = self._scan_body(accum_body, invariant, leaves,
+                                         n_micro - 1)
+                return apply_body(*merged)
+
+            fn = self._cache[key] = jax.jit(
+                accum_fn, donate_argnums=tuple(range(len(vectors))))
+            with _tracing.TRACER.span(f"compile:{self.name}",
+                                      cat="compile", accum=n_micro):
+                out = fn(*leaves)  # first dispatch = trace+compile
+        else:
+            with _tracing.TRACER.span(f"accum:{self.name}",
+                                      cat="region", micro=n_micro):
+                out = fn(*leaves)
+        _metrics.region_steps(self.name).inc(n_micro)
+        for vec, leaf in zip(vectors, out):
+            vec.devmem = leaf
+
+    def run_undonated(self,
+                      accum_phase: "tuple[str, int] | None" = None,
+                      ) -> None:
+        """One region step compiled WITHOUT buffer donation, optionally
+        in a gradient-accumulation phase — the pipeline executor's
+        dispatch primitive (``parallel.pipeline``): its per-microbatch
+        activation store holds references to leaf buffers across
+        dispatches, which donation would invalidate.  Programs cache
+        alongside the donated variants under a distinct key."""
+        if self._vectors is None:
+            self._vectors = self._collect_vectors()
+        vectors = self._vectors
+        for vec in vectors:
+            vec.unmap()
+        skips = tuple(bool(unit.gate_skip) for unit in self.units)
+        key = tuple(unit.region_key() for unit in self.units) \
+            + (skips, "nodonate", accum_phase)
+        fn = self._cache.get(key)
+        leaves = [vec._devmem for vec in vectors]
+        if fn is None:
+            self.debug("region '%s': compiling undonated variant "
+                       "(phase=%s)", self.name, accum_phase)
+            _metrics.xla_compiles(f"region:{self.name}").inc()
+            with _tracing.TRACER.span(f"compile:{self.name}",
+                                      cat="compile"):
+                fn = self._cache[key] = jax.jit(
+                    self.build_callable(skips, accum_phase=accum_phase))
+                out = fn(*leaves)
+        else:
+            out = fn(*leaves)
+        _metrics.region_steps(self.name).inc()
         for vec, leaf in zip(vectors, out):
             vec.devmem = leaf
 

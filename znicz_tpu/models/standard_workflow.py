@@ -148,6 +148,7 @@ class StandardWorkflow(AcceleratedWorkflow):
         self.gds: list = []
         self.anomaly_guard = None
         self.integrity = None  # the round-19 SDC sentinel
+        self._pipeline = None  # round-20 pipeline executor (lazy)
         self.link_forwards()
         self.link_evaluator(**(evaluator_config or {}))
         self.link_decision(**(decision_config or {}))
@@ -570,6 +571,162 @@ class StandardWorkflow(AcceleratedWorkflow):
                 raise RuntimeError(
                     f"workflow '{self.name}' exceeded max_fires="
                     f"{self._max_fires} chunks (runaway loop?)")
+
+    def run_accumulated(self, microbatches: int | None = None) -> None:
+        """Gradient-accumulation training driver (round 20): every
+        optimizer step consumes ``M = engine.grad_accum`` consecutive
+        TRAIN minibatches through ONE device program
+        (:meth:`JitRegion.run_accum` — a ``lax.scan`` of M−1
+        accumulate-only bodies feeding one apply body), so the global
+        batch is ``M × minibatch_size`` while per-step activation
+        memory stays at one microbatch.
+
+        Semantics: the applied update is bitwise-equal to a fused
+        batch of ``M × minibatch_size`` whenever the arithmetic is
+        exact (each microbatch gradient is normalized by its own
+        minibatch size; the apply body divides the accumulated sum by
+        M).  Anomaly verdicts AND across the M microbatches — one NaN
+        anywhere skips the whole accumulated step — and the SDC
+        fingerprints fold once, at apply.  Eval/validation minibatches
+        run unaccumulated through the regular region program.
+        """
+        region_unit = self._region_unit
+        loader = self.loader
+        if microbatches is None:
+            from znicz_tpu.utils.config import root
+            microbatches = int(root.common.engine.get("grad_accum", 1) or 1)
+        n_micro = int(microbatches)
+        if n_micro <= 1:
+            return self.run()
+        if region_unit is None or not loader._on_device_schedule():
+            raise RuntimeError(
+                f"workflow '{self.name}': run_accumulated requires the "
+                f"XLA region + a device-schedule loader (accumulation "
+                f"is an on-device scan; there is no meaningful host "
+                f"fallback)")
+        span = loader.max_minibatch_size * n_micro
+        n_train = int(loader.class_lengths[TRAIN])
+        if n_train % span != 0:
+            raise RuntimeError(
+                f"workflow '{self.name}': TRAIN set of {n_train} does "
+                f"not divide into accumulated steps of "
+                f"{loader.max_minibatch_size} × {n_micro} microbatches — "
+                f"a ragged tail microbatch would break the fixed "
+                f"accumulation program")
+        region = region_unit.region
+        assert region is not None
+        decision = self.decision
+        side_units = [u for u in decision.links_to
+                      if u is not self.repeater and u is not self.end_point]
+        guard = getattr(self, "anomaly_guard", None)
+        from znicz_tpu.observe import metrics as _metrics
+        _metrics.grad_accum_microbatches(self.name).set(n_micro)
+        import time as _time
+        self.run_started_at = _time.time()
+        self.stopped.value = False
+        steps = 0
+        while not decision.complete and not self.stopped:
+            loader.run()  # host bookkeeping (+ schedule upload if stale)
+            cls = loader.minibatch_class
+            if cls == TRAIN:
+                for _ in range(n_micro - 1):
+                    loader.run()  # advance the index stream M−1 more
+                if guard is not None:
+                    guard.host_run()  # arm fault/SDC injections
+                region.run_accum(n_micro)
+                if self.lr_adjuster is not None:
+                    # ONE optimizer step happened, whatever M is
+                    self.lr_adjuster.run()
+            else:
+                region.run()
+            decision.run()
+            if decision.epoch_ended or decision.complete:
+                for unit in side_units:
+                    if unit is self.lr_adjuster:
+                        continue  # handled above
+                    if not unit.gate_block and not unit.gate_skip:
+                        unit._fire()
+            steps += 1
+            if self._max_fires is not None and steps > self._max_fires:
+                raise RuntimeError(
+                    f"workflow '{self.name}' exceeded max_fires="
+                    f"{self._max_fires} accumulated steps "
+                    f"(runaway loop?)")
+
+    def run_pipelined(self, n_stages: int,
+                      microbatches: int | None = None,
+                      schedule: str = "1f1b") -> None:
+        """Pipeline-parallel training driver (round 20): split the
+        forward/backward chain into ``n_stages`` contiguous stages and
+        drive each TRAIN optimizer step through the
+        :class:`~znicz_tpu.parallel.pipeline.PipelineExecutor`'s
+        merged 1F1B (or GPipe) schedule over ``M = engine.grad_accum``
+        microbatches.  Riding the accumulation phases keeps the
+        trained trajectory identical to :meth:`run_accumulated` —
+        each stage buffers M−1 microbatch gradients and applies once —
+        while per-stage live activations stay at ONE microbatch.
+        Eval/validation minibatches run through the unstaged region
+        program unchanged.
+        """
+        from znicz_tpu.parallel.pipeline import PipelineExecutor
+        region_unit = self._region_unit
+        loader = self.loader
+        if microbatches is None:
+            from znicz_tpu.utils.config import root
+            microbatches = int(root.common.engine.get("grad_accum", 1) or 1)
+        n_micro = int(microbatches)
+        if region_unit is None or not loader._on_device_schedule():
+            raise RuntimeError(
+                f"workflow '{self.name}': run_pipelined requires the "
+                f"XLA region + a device-schedule loader")
+        span = loader.max_minibatch_size * n_micro
+        n_train = int(loader.class_lengths[TRAIN])
+        if n_train % span != 0:
+            raise RuntimeError(
+                f"workflow '{self.name}': TRAIN set of {n_train} does "
+                f"not divide into pipelined steps of "
+                f"{loader.max_minibatch_size} × {n_micro} microbatches")
+        executor = self._pipeline
+        if (executor is None or executor.n_stages != int(n_stages)
+                or executor.n_micro != n_micro
+                or executor.schedule_kind != schedule):
+            executor = self._pipeline = PipelineExecutor(
+                self, n_stages, n_micro, schedule=schedule)
+        region = region_unit.region
+        assert region is not None
+        decision = self.decision
+        side_units = [u for u in decision.links_to
+                      if u is not self.repeater and u is not self.end_point]
+        guard = getattr(self, "anomaly_guard", None)
+        import time as _time
+        self.run_started_at = _time.time()
+        self.stopped.value = False
+        steps = 0
+        while not decision.complete and not self.stopped:
+            loader.run()
+            cls = loader.minibatch_class
+            if cls == TRAIN:
+                for _ in range(n_micro - 1):
+                    loader.run()
+                if guard is not None:
+                    guard.host_run()
+                executor.run_step()
+                if self.lr_adjuster is not None:
+                    self.lr_adjuster.run()
+            else:
+                region.run()
+            decision.run()
+            if decision.epoch_ended or decision.complete:
+                for unit in side_units:
+                    if unit is self.lr_adjuster:
+                        continue
+                    if not unit.gate_block and not unit.gate_skip:
+                        unit._fire()
+            steps += 1
+            if self._max_fires is not None and steps > self._max_fires:
+                raise RuntimeError(
+                    f"workflow '{self.name}' exceeded max_fires="
+                    f"{self._max_fires} pipelined steps (runaway loop?)")
 
     def build_shadow(self) -> "StandardWorkflow":
         """A numpy-backend clone for the SDC sentinel's

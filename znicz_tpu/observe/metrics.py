@@ -464,6 +464,35 @@ def partition_leaves(workflow: str) -> Gauge:
         labels=("workflow",)).labels(workflow=workflow)
 
 
+def pipeline_stages(workflow: str) -> Gauge:
+    """Pipeline-parallel stage count K the workflow's unit chain was
+    split into (round 20) — 0/absent means unstaged execution."""
+    return REGISTRY.gauge(
+        "znicz_pipeline_stages",
+        "Pipeline-parallel stages the forward/backward chain spans",
+        labels=("workflow",)).labels(workflow=workflow)
+
+
+def pipeline_bubble_seconds(workflow: str) -> Counter:
+    """Cumulative pipeline bubble time: per optimizer step, the sum
+    over stages of (schedule makespan − that stage's busy time).  With
+    the 1F1B schedule the steady-state fraction is (K−1)/(M+K−1);
+    divide by wall time to read the realized fraction from /metrics."""
+    return REGISTRY.counter(
+        "znicz_pipeline_bubble_seconds_total",
+        "Stage idle (bubble) seconds summed over pipeline stages",
+        labels=("workflow",)).labels(workflow=workflow)
+
+
+def grad_accum_microbatches(workflow: str) -> Gauge:
+    """Microbatches accumulated on device per optimizer step
+    (``engine.grad_accum``; round 20) — 1 means fused batches."""
+    return REGISTRY.gauge(
+        "znicz_grad_accum_microbatches",
+        "Gradient-accumulation microbatches per optimizer step",
+        labels=("workflow",)).labels(workflow=workflow)
+
+
 def snapshot_seconds(op: str) -> Histogram:
     return REGISTRY.histogram(
         "znicz_snapshot_seconds",

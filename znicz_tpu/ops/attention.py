@@ -634,6 +634,15 @@ class GDMultiHeadAttention(GradientDescentBase):
                           self.accumulated_gradient_weights_out,
                           self.accumulated_gradient_bias_out)
 
+    def _micro_accum_params(self):
+        # round 20: the output projection pair accumulates too — the
+        # base enumeration only covers the fused QKV weights/bias
+        pairs = super()._micro_accum_params()
+        fwd = self.forward_unit
+        if fwd is not None:
+            pairs.extend([("wo", fwd.weights_out), ("bo", fwd.bias_out)])
+        return pairs
+
     def region_vectors(self):
         vecs = super().region_vectors()
         seen = {id(v) for v in vecs}

@@ -65,17 +65,33 @@ class EvaluatorBase(AcceleratedUnit):
     def _seed_step_flags(self, xp, loss_ok) -> None:
         """Write [running_ok, loss_ok]; the backward chain ANDs its
         gradient-finiteness into slot 0 and the AnomalyGuard commits
-        the verdict at the end of the step."""
+        the verdict at the end of the step.
+
+        Under gradient accumulation (round 20) the flags span ALL
+        microbatches of one accumulated step: accumulation-phase
+        bodies AND their loss verdict into the running flags instead
+        of overwriting (the guard resets them to ones after each
+        apply-phase commit, so the first microbatch starts from a
+        clean [1, 1]) — one non-finite microbatch loss poisons the
+        whole step's verdict, matching the fused-batch semantics."""
         flags = self.step_flags
         if flags is None or not flags:
             return
+        from znicz_tpu.accelerated_units import current_accum_phase
+        phase = current_accum_phase()
         if xp is jnp:
             f = loss_ok.astype(jnp.float32)
-            flags.devmem = jnp.stack([f, f])
+            if phase is not None:
+                flags.devmem = flags.devmem * f
+            else:
+                flags.devmem = jnp.stack([f, f])
         else:
             f = np.float32(1.0 if loss_ok else 0.0)
             flags.mem[...] = [f, f]
-        self._seed_fingerprint(xp)
+        if phase is None or phase[0] == "apply":
+            # the SDC per-step slots reset once per OPTIMIZER step —
+            # accumulation microbatches fold no fingerprints
+            self._seed_fingerprint(xp)
 
     def _seed_fingerprint(self, xp) -> None:
         """Zero the SDC fingerprint's per-step slots (claimed param

@@ -256,6 +256,15 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
             name=f"{self.name}.acc_grad_w")
         self.accumulated_gradient_bias = Vector(
             name=f"{self.name}.acc_grad_b")
+        #: round 20 microbatch gradient-accumulation buffers, keyed by
+        #: parameter Vector identity — allocated at initialize when
+        #: ``root.common.engine.grad_accum > 1`` (f32, replicated; the
+        #: ``acc_micro_*`` slot names ride the default ``acc_\w+``
+        #: partition rule).  During an ``("accum", M)`` region phase
+        #: every gradient sums in here instead of updating parameters;
+        #: the ``("apply", M)`` phase folds the mean through the
+        #: unchanged update path (see ``_apply_param_xla``).
+        self._micro_accum: dict[int, Vector] = {}
         # device-resident [lr, lr_bias]; only populated when a
         # LearningRateAdjust unit schedules this GD unit — a region
         # leaf, so schedule changes never recompile the step program
@@ -314,6 +323,45 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
                                         self.bias)
             self.init_vectors(self.accumulated_gradient_weights,
                               self.accumulated_gradient_bias)
+        self._alloc_micro_accum()
+
+    def _micro_accum_params(self) -> list:
+        """``(suffix, parameter Vector)`` pairs covered by microbatch
+        gradient accumulation; units with extra parameter pairs
+        (attention's output projection) extend this the same way they
+        extend ``EXPORT_PARAMS``."""
+        return [("w", self.weights), ("b", self.bias)]
+
+    def _alloc_micro_accum(self) -> None:
+        """Allocate the round-20 microbatch gradient-accumulation
+        buffers when ``root.common.engine.grad_accum > 1``: one f32
+        zero buffer per parameter tensor, registered as a region leaf
+        (the ``acc_micro_*`` attribute makes ``region_vectors`` pick
+        it up) and mapped from the parameter's identity so
+        ``_apply_param_xla`` finds it during accumulation phases.
+        Replicated placement (the ``acc_\\w+`` default rule): the
+        buffer holds the logically-global microbatch gradient sum;
+        ZeRO-1's reduce-scatter engages once, at apply."""
+        from znicz_tpu.utils.config import root
+        n_micro = int(root.common.engine.get("grad_accum", 1) or 1)
+        if (n_micro < 2 or self.device is None
+                or self.device.is_host_only):
+            return
+        if self.weights is None or not self.weights:
+            return  # weightless backward: nothing accumulates
+        for suffix, param in self._micro_accum_params():
+            if param is None or not param:
+                continue
+            attr = f"micro_accum_{suffix}"
+            vec = getattr(self, attr, None)
+            if vec is None:
+                vec = Vector(name=f"{self.name}.acc_micro_{suffix}")
+                setattr(self, attr, vec)
+            if not vec:
+                vec.reset(np.zeros(tuple(param.shape),
+                                   dtype=np.float32))
+            self._micro_accum[id(param)] = vec
+            self.init_vectors(vec)
 
     def _alloc_accumulator(self, acc_vec: Vector, param_vec: Vector) -> None:
         """Allocate a momentum accumulator for ``param_vec``: storage
@@ -550,8 +598,41 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         its momentum bitwise untouched; finite steps are bitwise
         identical to the unguarded path (``where`` with a true
         predicate selects the new value exactly).
+
+        Round 20 — microbatch gradient accumulation: when the region
+        body traces in an accumulation phase
+        (:func:`~znicz_tpu.accelerated_units.current_accum_phase`),
+        an ``("accum", M)`` microbatch only sums its raw gradient into
+        the f32 micro-accumulation buffer and returns — no pmean, no
+        fingerprint fold, no guard gate, no parameter write; the
+        ``("apply", M)`` microbatch replaces its gradient with the
+        buffered mean ``(Σ grads)/M`` and falls through to the
+        UNCHANGED path below, then zeroes the buffer.  A non-finite
+        gradient in ANY microbatch propagates through the sum, so the
+        guard's finite check at apply skips the whole accumulated
+        step; the buffer zeroing is unconditional so a skipped step
+        cannot poison the next one.
         """
+        from znicz_tpu.accelerated_units import current_accum_phase
         from znicz_tpu.parallel.axis import current_data_axis
+        phase = current_accum_phase()
+        if phase is not None:
+            mode, n_micro = phase
+            acc = self._micro_accum.get(id(vec))
+            if acc is None or not acc:
+                raise RuntimeError(
+                    f"{self}: gradient accumulation phase {phase} but "
+                    f"no micro-accumulation buffer for '{vec.name}' — "
+                    f"set root.common.engine.grad_accum before "
+                    f"initialize (and cover the tensor in "
+                    f"_micro_accum_params for extra parameter pairs)")
+            if mode == "accum":
+                acc.devmem = acc.devmem + grad.astype(jnp.float32)
+                return
+            assert mode == "apply", phase
+            grad = (acc.devmem + grad.astype(jnp.float32)) \
+                / np.float32(n_micro)
+            acc.devmem = jnp.zeros_like(acc.devmem)
         grad = maybe_pmean(grad)
         self._fp_register(vec)
         # round 19: refold the STORED parameter before the update

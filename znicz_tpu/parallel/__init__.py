@@ -13,6 +13,7 @@ bootstrap is ``jax.distributed.initialize`` over DCN (SURVEY.md §2.5,
 from znicz_tpu.parallel.axis import (  # noqa: F401
     DATA_AXIS,
     MODEL_AXIS,
+    PIPE_AXIS,
     SEQ_AXIS,
     current_data_axis,
     data_axis,
@@ -24,6 +25,7 @@ from znicz_tpu.parallel.distributed import (  # noqa: F401
 )
 from znicz_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
+    mesh_for_stage,
     batch_sharding,
     kernel_shard_spec,
     replicated_sharding,

@@ -22,6 +22,9 @@ MODEL_AXIS = "model"
 #: sequence/context parallelism (ring attention) — distinct from
 #: data/model so DP × SP compose
 SEQ_AXIS = "seq"
+#: pipeline-stage axis (round 20): a workflow's unit chain splits into
+#: K stages scheduled 1F1B over the gradient-accumulation microbatches
+PIPE_AXIS = "pipe"
 
 _active_data_axis: ContextVar[str | None] = ContextVar(
     "znicz_tpu_data_axis", default=None)

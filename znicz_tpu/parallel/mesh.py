@@ -19,7 +19,8 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from znicz_tpu.parallel.axis import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from znicz_tpu.parallel.axis import (DATA_AXIS, MODEL_AXIS, PIPE_AXIS,
+                                     SEQ_AXIS)
 
 
 def shard_map_fn():
@@ -185,8 +186,9 @@ def zero1_specs(mesh: Mesh, ndim: int, data_shard_dim: int,
 
 
 def make_mesh(n_data: int | None = None, n_model: int = 1,
-              n_seq: int = 1, devices=None) -> Mesh:
-    """Build a (data, model[, seq]) mesh over the available devices.
+              n_seq: int = 1, devices=None, n_pipe: int = 1) -> Mesh:
+    """Build a ([pipe, ]data, model[, seq]) mesh over the available
+    devices.
 
     ``n_data=None`` uses all remaining devices on the data axis — the
     DP layout matching the reference's capability (its only scale-out
@@ -199,17 +201,41 @@ def make_mesh(n_data: int | None = None, n_model: int = 1,
     (the ring rides it instead of doubling up on ``model``, so
     DP × TP × SP compose); ``n_seq=1`` keeps the historical 2-D mesh
     so existing sharding specs and tests are untouched.
+
+    ``n_pipe > 1`` (round 20) prepends a LEADING ``pipe`` axis — the
+    slowest-varying position, so each pipeline stage owns a contiguous
+    block of devices and stage-boundary transfers cross the fewest
+    links.  The pipeline executor assigns stage ``k`` the sub-mesh
+    ``mesh_for_stage(mesh, k)``; DP/TP/SP placements inside a stage
+    are untouched.
     """
     if devices is None:
         devices = jax.devices()
     if n_data is None:
-        n_data = len(devices) // (n_model * n_seq)
-    use = n_data * n_model * n_seq
-    if n_seq > 1:
-        grid = np.asarray(devices[:use]).reshape(n_data, n_model, n_seq)
-        return Mesh(grid, axis_names=(DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
-    grid = np.asarray(devices[:use]).reshape(n_data, n_model)
-    return Mesh(grid, axis_names=(DATA_AXIS, MODEL_AXIS))
+        n_data = len(devices) // (n_model * n_seq * n_pipe)
+    use = n_data * n_model * n_seq * n_pipe
+    shape = [n_data, n_model] + ([n_seq] if n_seq > 1 else [])
+    names = [DATA_AXIS, MODEL_AXIS] + ([SEQ_AXIS] if n_seq > 1 else [])
+    if n_pipe > 1:
+        shape = [n_pipe] + shape
+        names = [PIPE_AXIS] + names
+    grid = np.asarray(devices[:use]).reshape(shape)
+    return Mesh(grid, axis_names=tuple(names))
+
+
+def mesh_for_stage(mesh: Mesh, stage: int) -> Mesh:
+    """The per-stage sub-mesh of a pipelined mesh: index the leading
+    ``pipe`` axis at ``stage`` and return the remaining
+    (data, model[, seq]) mesh over that stage's device block.  A mesh
+    without a pipe axis is returned unchanged (single-stage layouts and
+    the CPU temporal-MPMD executor, which time-multiplexes every stage
+    over the same devices)."""
+    if PIPE_AXIS not in mesh.axis_names:
+        return mesh
+    k = mesh.axis_names.index(PIPE_AXIS)
+    grid = np.take(mesh.devices, stage, axis=k)
+    names = tuple(n for n in mesh.axis_names if n != PIPE_AXIS)
+    return Mesh(grid, axis_names=names)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:

@@ -109,6 +109,23 @@ class Member:
         return f"MEMBER(model_dim={self.model_dim})"
 
 
+@dataclass(frozen=True)
+class Stage:
+    """Pipeline-stage placement tag (round 20): every leaf the rule
+    matches belongs to pipeline stage ``index`` on the mesh's ``pipe``
+    axis.  A Stage rule decides WHICH stage owns a leaf, not how the
+    leaf shards inside the stage: ``inner`` (when given) is the
+    within-stage placement; ``inner=None`` falls through to the next
+    matching rule — typically the defaults tail — so a unit's existing
+    BATCH/ZERO1/TP declarations keep working verbatim under staging."""
+    index: int
+    inner: object = None
+
+    def __repr__(self) -> str:
+        return (f"STAGE({self.index})" if self.inner is None
+                else f"STAGE({self.index}, {self.inner!r})")
+
+
 def model_sharded(dim: int, axis: str = MODEL_AXIS, batch: bool = False):
     """Explicit spec with ``dim`` on ``axis`` (and dim 0 on the data
     axis when ``batch``) — the TP/ring building block."""
@@ -155,6 +172,8 @@ class ResolvedPartition:
     #: True once the Vector's storage carries the derived pad rows —
     #: re-binds must not re-derive from the padded shape
     pad_applied: bool = False
+    #: pipeline stage owning this leaf (round 20); None = unstaged
+    stage: int | None = None
 
     def apply_to(self, vec) -> "ResolvedPartition":
         """Populate the legacy slot attributes FROM this resolution —
@@ -273,9 +292,14 @@ class PartitionTable:
         return pattern
 
     # -- matching -------------------------------------------------------
-    def match(self, path: str) -> tuple[str, object]:
-        """First matching (pattern, placement); hard error otherwise."""
+    def match(self, path: str,
+              skip_stage: bool = False) -> tuple[str, object]:
+        """First matching (pattern, placement); hard error otherwise.
+        ``skip_stage`` ignores :class:`Stage` tags — the fall-through
+        lookup for a Stage rule with no ``inner`` placement."""
         for pattern, placement in self.rules:
+            if skip_stage and isinstance(placement, Stage):
+                continue
             if re.search(pattern, path):
                 return pattern, placement
         raise UnmatchedLeafError(
@@ -287,24 +311,43 @@ class PartitionTable:
     def audit(self, path: str) -> dict:
         """Every matching rule, split by section — the rule-coverage
         linter's view.  A well-formed table gives each leaf at most
-        one override and, when none, exactly one default match."""
-        overrides = [p for p, _ in self._overrides if re.search(p, path)]
+        one (non-Stage) override and, when none, exactly one default
+        match; :class:`Stage` tags are listed separately (``stages``)
+        because a stage assignment composes WITH a placement rather
+        than competing with it — at most one may match a leaf."""
+        overrides, stages = [], []
+        for p, pl in self._overrides:
+            if re.search(p, path):
+                (stages if isinstance(pl, Stage) else overrides).append(p)
         defaults = [p for p, _ in self._defaults if re.search(p, path)]
         return {"path": path, "overrides": overrides,
-                "defaults": defaults}
+                "defaults": defaults, "stages": stages}
 
     # -- resolution -----------------------------------------------------
     def resolve(self, path: str, shape, n_data: int = 1,
                 member_count: int | None = None) -> ResolvedPartition:
         """Resolve one leaf: scalar short-circuit → first match →
-        placement materialized against the LOGICAL shape."""
+        placement materialized against the LOGICAL shape.  A
+        :class:`Stage` match records the stage tag, then the effective
+        placement is its ``inner`` (when given) or the NEXT matching
+        non-Stage rule — so staging never silences the
+        unmatched-leaf hard error."""
         shape = tuple(int(s) for s in shape)
         if len(shape) == 0 or int(np.prod(shape)) <= 1:
             return ResolvedPartition(path, _pspec(), "<scalar>",
                                      logical_shape=shape)
         pattern, placement = self.match(path)
-        return materialize(placement, path, shape, n_data,
-                           rule=pattern)
+        stage = None
+        if isinstance(placement, Stage):
+            stage = int(placement.index)
+            if placement.inner is not None:
+                placement = placement.inner
+            else:
+                pattern, placement = self.match(path, skip_stage=True)
+        resolved = materialize(placement, path, shape, n_data,
+                               rule=pattern)
+        resolved.stage = stage
+        return resolved
 
     def bind(self, vec, path: str, device) -> ResolvedPartition:
         """Resolve ``path`` for ``vec`` on ``device``, stamp the compat

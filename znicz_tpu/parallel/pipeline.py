@@ -1,0 +1,304 @@
+"""Pipeline parallelism over the workflow's ordered unit chain
+(round 20): split the forward/backward chain into K contiguous stages
+and schedule them 1F1B over the ``engine.grad_accum`` microbatches
+(GPipe — Huang et al. 2019, arXiv:1811.06965; the one-forward-
+one-backward schedule — Narayanan et al., PipeDream, arXiv:1806.03377;
+see PAPERS.md).
+
+Execution model
+---------------
+Each stage owns TWO :class:`~znicz_tpu.accelerated_units.JitRegion`
+programs built over the SAME unit and Vector objects as the unstaged
+region:
+
+- forward region ``s``: that stage's forward units (stage 0 is led by
+  the loader, which advances the device-resident schedule cursor once
+  per microbatch — exactly as it does inside ``run_accum``'s scan);
+- backward region ``s``: that stage's GD units in reverse layer order
+  (stage K−1 is led by the evaluator; stage 0 is trailed by the
+  anomaly guard, keeping the guard's commit the LAST program of the
+  optimizer step, its position in the unstaged trace order).
+
+Backward dispatches ride the gradient-accumulation phases: microbatch
+``m < M−1`` runs ``("accum", M)`` (gradients buffer, no parameter
+write), the last runs ``("apply", M)`` — each stage applies its own
+parameters at its final backward, which is legal in any valid schedule
+because every forward of the step reads pre-step parameters.
+
+Because the host dispatches one program at a time, on a single-process
+CPU/TPU mesh this is **temporal MPMD**: stages time-multiplex the same
+devices, so what pipelining buys here is the ACCUMULATION memory
+profile (one microbatch of activations per stage) plus a faithfully
+modeled schedule.  The bubble metrics are computed from measured
+per-op wall times laid onto the schedule's tick structure — the cost
+model a spatial (``Stage(k)`` placements on a ``pipe`` mesh axis,
+``PP_TPU=1``) deployment realizes physically.
+
+Microbatch context
+------------------
+1F1B interleaves microbatches, so stage buffers (activations, error
+tensors, minibatch data) are VERSIONED per in-flight microbatch: before
+an op runs, every batch-major leaf of its region that microbatch ``m``
+has already produced is restored; after it runs, the region's
+batch-major leaves are saved under ``m``.  Weights, optimizer state,
+PRNG chains, epoch accumulators and other non-batch leaves are shared
+mutable state, exactly as in the fused program.  Vector objects are
+shared across stage regions, so a stage boundary is nothing but the
+producer's save followed by the consumer's restore — no explicit
+send/recv plumbing in the temporal executor.
+"""
+
+from __future__ import annotations
+
+import re
+import time
+
+import numpy as np
+
+from znicz_tpu.utils.logger import Logger
+from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.parallel.partition import Stage
+
+
+def split_stages(n_layers: int, n_stages: int) -> list[list[int]]:
+    """Contiguous balanced split of ``n_layers`` forward indices into
+    ``n_stages`` groups (earlier stages take the remainder, matching
+    ``np.array_split``)."""
+    if not 1 <= n_stages <= n_layers:
+        raise ValueError(
+            f"cannot split {n_layers} layers into {n_stages} stages")
+    return [list(chunk) for chunk in
+            np.array_split(np.arange(n_layers), n_stages)]
+
+
+# ----------------------------------------------------------------------
+# schedules: per-stage local op sequences + readiness merge
+# ----------------------------------------------------------------------
+def _local_1f1b(n_stages: int, n_micro: int, stage: int) -> list[tuple]:
+    """Stage-local 1F1B sequence: ``min(K−s−1, M)`` warmup forwards,
+    then alternate F/B until forwards run out, then drain backwards."""
+    warmup = min(n_stages - stage - 1, n_micro)
+    ops: list[tuple] = [("F", stage, m) for m in range(warmup)]
+    f, b = warmup, 0
+    while f < n_micro:
+        ops.append(("F", stage, f))
+        f += 1
+        ops.append(("B", stage, b))
+        b += 1
+    while b < n_micro:
+        ops.append(("B", stage, b))
+        b += 1
+    return ops
+
+
+def _local_gpipe(n_stages: int, n_micro: int, stage: int) -> list[tuple]:
+    """Stage-local GPipe (naive-sequential) sequence: every forward,
+    then every backward."""
+    return ([("F", stage, m) for m in range(n_micro)]
+            + [("B", stage, m) for m in range(n_micro)])
+
+
+_LOCAL = {"1f1b": _local_1f1b, "gpipe": _local_gpipe}
+
+
+def build_schedule(n_stages: int, n_micro: int,
+                   kind: str = "1f1b") -> list[list[tuple]]:
+    """Merge the per-stage local sequences into parallel **ticks**.
+
+    Each tick is the set of ops the K stages would execute
+    concurrently on a spatial deployment: every stage fires its next
+    local op as soon as its dependencies are done.  ``F(s, m)`` needs
+    ``F(s−1, m)``; ``B(s, m)`` needs ``F(s, m)`` and ``B(s+1, m)``.
+    Flattening the ticks (stage-descending inside a tick for B-first
+    determinism) gives the host dispatch order; the tick structure is
+    the cost model the bubble metrics are read from.
+    """
+    try:
+        local = _LOCAL[kind]
+    except KeyError:
+        raise ValueError(f"unknown pipeline schedule '{kind}' "
+                         f"(have: {sorted(_LOCAL)})") from None
+    seqs = [local(n_stages, n_micro, s) for s in range(n_stages)]
+    ptr = [0] * n_stages
+    done: set[tuple] = set()
+    ticks: list[list[tuple]] = []
+    total = sum(len(s) for s in seqs)
+    while len(done) < total:
+        fired: list[tuple] = []
+        for s in range(n_stages):
+            if ptr[s] >= len(seqs[s]):
+                continue
+            kind_, st, m = op = seqs[s][ptr[s]]
+            if kind_ == "F":
+                ready = st == 0 or ("F", st - 1, m) in done
+            else:
+                ready = (("F", st, m) in done
+                         and (st == n_stages - 1
+                              or ("B", st + 1, m) in done))
+            if ready:
+                fired.append(op)
+        if not fired:
+            raise RuntimeError(
+                f"pipeline schedule '{kind}' deadlocked at "
+                f"{sum(ptr)}/{total} ops — malformed local sequences")
+        for op in fired:
+            ptr[op[1]] += 1
+            done.add(op)
+        # backward-bearing stages first inside the tick: on the
+        # temporal executor this drains gradients (and frees their
+        # microbatch context) at the earliest legal point
+        ticks.append(sorted(fired, key=lambda o: (o[0] == "F", -o[1])))
+    return ticks
+
+
+def bubble_fraction(n_stages: int, n_micro: int) -> float:
+    """The 1F1B/GPipe steady-state bubble fraction (K−1)/(M+K−1) —
+    the analytic curve PP_BENCH.json compares measured ticks against."""
+    return (n_stages - 1) / (n_micro + n_stages - 1)
+
+
+# ----------------------------------------------------------------------
+# the executor
+# ----------------------------------------------------------------------
+class PipelineExecutor(Logger):
+    """Temporal-MPMD pipeline executor over a ``StandardWorkflow``.
+
+    Built AFTER ``workflow.initialize`` (unit chain and Vectors
+    exist); owns the per-stage forward/backward JitRegions, the merged
+    schedule, and the per-microbatch context store.  One
+    :meth:`run_step` consumes M already-staged TRAIN microbatches
+    (the caller advances the loader's host bookkeeping M times, same
+    contract as ``JitRegion.run_accum``) and commits exactly one
+    optimizer step.
+    """
+
+    def __init__(self, workflow, n_stages: int, n_micro: int,
+                 schedule: str = "1f1b") -> None:
+        super().__init__()
+        from znicz_tpu.accelerated_units import JitRegion
+        if n_micro < 2:
+            raise ValueError(
+                "pipeline execution rides the gradient-accumulation "
+                "phases: engine.grad_accum (microbatches) must be ≥ 2")
+        self.workflow = workflow
+        self.n_stages = int(n_stages)
+        self.n_micro = int(n_micro)
+        self.schedule_kind = schedule
+        self.stages = split_stages(len(workflow.forwards), self.n_stages)
+        device = workflow.device
+        loader = workflow.loader
+        guard = getattr(workflow, "anomaly_guard", None)
+        self.fwd_regions = []
+        self.bwd_regions = []
+        for s, idxs in enumerate(self.stages):
+            f_units = [workflow.forwards[i] for i in idxs]
+            if s == 0:
+                f_units = [loader] + f_units
+            b_units = [workflow.gds[i] for i in reversed(idxs)]
+            if s == self.n_stages - 1:
+                b_units = [workflow.evaluator] + b_units
+            if s == 0 and guard is not None:
+                b_units = b_units + [guard]
+            self.fwd_regions.append(JitRegion(
+                f"{workflow.name}_pp_f{s}", f_units, device))
+            self.bwd_regions.append(JitRegion(
+                f"{workflow.name}_pp_b{s}", b_units, device))
+        self.ticks = build_schedule(self.n_stages, self.n_micro, schedule)
+        self._declare_stage_rules()
+        #: in-flight microbatch contexts: m -> {id(vec): (vec, leaf)}
+        self._ctx: dict[int, dict[int, tuple]] = {}
+        self.last_makespan = 0.0
+        self.last_bubble_seconds = 0.0
+        _metrics.pipeline_stages(workflow.name).set(self.n_stages)
+        _metrics.grad_accum_microbatches(workflow.name).set(self.n_micro)
+
+    # -- declarative stage assignment ----------------------------------
+    def _declare_stage_rules(self) -> None:
+        """Record each stage's unit→stage assignment as ``Stage(k)``
+        tags in the workflow's partition table (and back-annotate
+        already-bound leaves), so the placement story — including the
+        spatial ``pipe``-axis arm — reads from the ONE rule table."""
+        table = getattr(self.workflow, "partition", None)
+        if table is None:
+            return
+        patterns = []
+        for s, idxs in enumerate(self.stages):
+            units = [self.workflow.forwards[i] for i in idxs] \
+                + [self.workflow.gds[i] for i in idxs]
+            for unit in units:
+                pat = rf"^{re.escape(unit.name)}/"
+                table.declare(pat, Stage(s))
+                patterns.append((re.compile(pat), s))
+        for path, resolved in table.leaves.items():
+            for pat, s in patterns:
+                if pat.search(path):
+                    resolved.stage = s
+                    break
+
+    # -- microbatch context --------------------------------------------
+    @staticmethod
+    def _batch_leaves(region):
+        if region._vectors is None:
+            region._vectors = region._collect_vectors()
+        return [v for v in region._vectors
+                if getattr(v, "batch_major", False)]
+
+    def _restore(self, region, m: int) -> None:
+        ctx = self._ctx.get(m)
+        if not ctx:
+            return
+        for vec in self._batch_leaves(region):
+            saved = ctx.get(id(vec))
+            if saved is not None:
+                vec.devmem = saved[1]
+
+    def _save(self, region, m: int) -> None:
+        ctx = self._ctx.setdefault(m, {})
+        for vec in self._batch_leaves(region):
+            ctx[id(vec)] = (vec, vec._devmem)
+
+    # -- execution ------------------------------------------------------
+    def _dispatch(self, op: tuple) -> float:
+        kind, s, m = op
+        if kind == "F":
+            region, phase = self.fwd_regions[s], None
+        else:
+            region = self.bwd_regions[s]
+            phase = ("apply" if m == self.n_micro - 1 else "accum",
+                     self.n_micro)
+        self._restore(region, m)
+        t0 = time.perf_counter()
+        region.run_undonated(accum_phase=phase)
+        dt = time.perf_counter() - t0
+        self._save(region, m)
+        if kind == "B" and s == 0:
+            self._ctx.pop(m, None)  # microbatch fully drained
+        return dt
+
+    def run_step(self) -> dict:
+        """Execute one optimizer step's schedule; returns the step's
+        modeled timing ``{"makespan": s, "bubble_seconds": s}``.
+
+        Timing model: per-op wall times are measured around each
+        dispatch; a tick's span is its slowest op (the ops of one tick
+        run concurrently on a spatial deployment), the makespan is the
+        sum of tick spans, and the bubble is
+        ``Σ_stages (makespan − stage busy time)`` — the idle-chip
+        seconds a ``pipe``-axis deployment of this exact schedule and
+        these exact op costs would spend.
+        """
+        busy = [0.0] * self.n_stages
+        makespan = 0.0
+        for tick in self.ticks:
+            span = 0.0
+            for op in tick:
+                dt = self._dispatch(op)
+                busy[op[1]] += dt
+                span = max(span, dt)
+            makespan += span
+        self._ctx.clear()  # nothing may leak across optimizer steps
+        bubble = sum(makespan - b for b in busy)
+        self.last_makespan = makespan
+        self.last_bubble_seconds = bubble
+        _metrics.pipeline_bubble_seconds(self.workflow.name).inc(bubble)
+        return {"makespan": makespan, "bubble_seconds": bubble}

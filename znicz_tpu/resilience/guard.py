@@ -218,6 +218,14 @@ class AnomalyGuard(AcceleratedUnit):
 
     def xla_run(self) -> None:
         import jax.numpy as jnp
+        from znicz_tpu.accelerated_units import current_accum_phase
+        phase = current_accum_phase()
+        if phase is not None and phase[0] != "apply":
+            # accumulation microbatch (round 20): no parameter was
+            # touched and no fingerprint folded — the verdict for the
+            # whole accumulated step commits once, in the apply-phase
+            # body (the flags keep ANDing across microbatches)
+            return
         flags = self.step_flags.devmem
         ok = flags[0] > 0.5
         loss_ok = flags[1] > 0.5
@@ -243,6 +251,12 @@ class AnomalyGuard(AcceleratedUnit):
             fpv.devmem = jnp.stack([
                 fp[0], fp[1], fp[2],
                 fp[3] + jnp.where(bad, 1.0, 0.0), fp[0]])
+        if phase is not None:
+            # apply phase: the accumulated step is committed — reset
+            # the flags so the NEXT step's first accumulation
+            # microbatch ANDs into a clean [1, 1] (the non-accum path
+            # keeps the historical evaluator overwrite instead)
+            self.step_flags.devmem = jnp.ones(2, dtype=jnp.float32)
 
     def numpy_run(self) -> None:
         flags = self.step_flags.mem
