@@ -426,6 +426,62 @@ def drill_swap_probation_fail() -> dict:
     return {"injected": d[0], **counts}
 
 
+def drill_quant_calib_corrupt() -> dict:
+    """A quantization mis-scale that slips the publish-time gate (the
+    fault fires AFTER the calibration accuracy check passed): the
+    SwapController canary is the remaining line of defense — it must
+    REJECT the bundle while the f32 incumbent keeps serving bitwise
+    untouched."""
+    from znicz_tpu.resilience.publisher import (PublicationWatcher,
+                                                SwapController,
+                                                publish_bundle)
+    from znicz_tpu.serving import ServingEngine
+    from znicz_tpu.serving import quantize as quantize_mod
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "quant.calib_corrupt"}),)
+    wf = _pub_workflow()
+    # the same synthetic stream _tiny_workflow trained on
+    rng = np.random.default_rng(3)
+    data = rng.normal(size=(96, 10)).astype(np.float32)
+    labels = (rng.random(96) * 3).astype(np.int32)
+    calib = (data[72:], labels[72:])
+
+    def score(manifest, params):
+        return quantize_mod._oracle_accuracy(manifest, params, *calib)
+
+    with tempfile.TemporaryDirectory() as tmp:
+        bundle = os.path.join(tmp, "engine.npz")
+        wf.export_forward(bundle)
+        with ServingEngine(bundle, max_batch=8,
+                           max_delay_ms=1.0) as eng:
+            before = eng(data[:4], timeout=60)
+            _recipe({"quant.calib_corrupt": {"at": [1]}})
+            publish_bundle(wf, tmp, "cm", quantize="int8",
+                           calib=calib)
+            _clear_recipe()
+            ctl = SwapController(
+                eng, PublicationWatcher(tmp, prefix="cm"),
+                score_fn=score, probation_steps=1)
+            for _ in range(8):
+                ctl.tick()
+                if eng.swap_counts.get("rejected"):
+                    break
+                eng(data[:2], timeout=60)
+            after = eng(data[:4], timeout=60)
+            counts = dict(eng.swap_counts)
+            version = eng.model_version
+            rejected = _value("znicz_quant_canary_total",
+                              engine=eng._obs_id, outcome="rejected")
+    assert d[0] == 1, d[0]
+    assert counts.get("rejected", 0) >= 1, counts
+    assert version == 0, f"engine promoted to v{version}"
+    assert rejected >= 1, rejected
+    assert np.array_equal(before, after), \
+        "incumbent outputs changed after the rejected quant swap"
+    return {"injected": d[0], "quant_canary_rejected": rejected,
+            **counts}
+
+
 def _fleet_harness(recipe: dict, deltas: "_Deltas",
                    check) -> dict:
     from znicz_tpu.serving.fleet import FleetEngine, TenantClass
@@ -646,6 +702,7 @@ DRILLS = {
     "publish.corrupt": drill_publish_corrupt,
     "swap.canary_regress": drill_swap_canary_regress,
     "swap.probation_fail": drill_swap_probation_fail,
+    "quant.calib_corrupt": drill_quant_calib_corrupt,
     "fleet.tenant_flood": drill_fleet_tenant_flood,
     "fleet.model_corrupt": drill_fleet_model_corrupt,
     "fleet.replica_loss": drill_fleet_replica_loss,

@@ -1,0 +1,430 @@
+"""Low-precision A/B (round 21): int8 weight + KV-page quantization
+through the publish→canary pipeline, plus the fp8 training arm.
+
+Four sections, all on CPU-sized models (chip arms queued per the
+round-6+ convention — set QUANT_TPU=1 / FP8_TPU=1 on a chip
+container):
+
+- **parity** — a one-shot classifier published as its ``int8`` twin:
+  the XLA dequantize-on-load engine must match the numpy int8 oracle,
+  the calibration accuracy delta must sit inside the swap guard
+  margin, and the published bundle must land at ≤0.55× its f32 bytes
+  (``bytes_per_resident_model`` — what the fleet's SharedLadderBudget
+  charges).
+- **lanes** — the headline: paged decode with bf16 KV pages vs int8
+  KV pages (per-(token, head) f32 scales).  At IDENTICAL geometry the
+  measured pool bytes give the lanes-per-byte win (must be ≥1.8×);
+  the throughput arms then spend the SAME pool byte budget — the int8
+  arm turns the saved bytes into extra decode lanes — on the
+  prefix-heavy greedy replay (token-identical outputs asserted,
+  ``warmed_compile_delta=0`` per arm, median of 3 steady passes).
+- **canary** — the publish→canary proof: a clean ``quantize="int8"``
+  publish promotes through the SwapController; a
+  ``quant.calib_corrupt``-scrambled publish is REJECTED by the canary
+  with the f32 incumbent serving bitwise untouched, zero request
+  failures and zero warmed-ladder compiles either way.
+- **fp8** — the training A/B behind the default-off
+  ``engine.fp8_matmul`` lever (MXU operands cast to ``float8_e4m3fn``
+  + the fp8 gradient round-trip in ``_apply_param_xla``): same seed,
+  same data, lever off vs on — held-out accuracy rides the row as the
+  convergence artifact.
+
+Run: ``python benchmarks/quant_bench.py``.  Writes QUANT_BENCH.json.
+Env: QUANT_N=192 QUANT_RATE=4000 QUANT_TPU=1 (keep ambient platform).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+N_PROMPTS = int(os.environ.get("QUANT_N", "192"))
+RATE = float(os.environ.get("QUANT_RATE", "4000"))
+
+
+def _ensure_platform() -> None:
+    import jax
+    if os.environ.get("QUANT_TPU") != "1":
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except (RuntimeError, AttributeError):
+            pass
+
+
+def _train_fc(seed: int = 33, epochs: int = 3):
+    """The 5-class gaussian-blob classifier every resilience bench
+    uses — returns the trained workflow plus the held-out
+    calibration/canary stream."""
+    from znicz_tpu.backends import XLADevice
+    from znicz_tpu.loader.fullbatch import ArrayLoader
+    from znicz_tpu.models.standard_workflow import StandardWorkflow
+    from znicz_tpu.utils import prng
+
+    rng = np.random.default_rng(seed)
+    dim, n_classes = 16, 5
+    centers = rng.normal(0, 1, size=(n_classes, dim))
+    data = np.concatenate([
+        c + 0.3 * rng.normal(size=(96, dim)) for c in centers
+    ]).astype(np.float32)
+    labels = np.repeat(np.arange(n_classes), 96).astype(np.int32)
+    order = rng.permutation(len(data))
+    data, labels = data[order], labels[order]
+    hx, hy = data[384:], labels[384:]
+    prng.seed_all(seed)
+    wf = StandardWorkflow(
+        name="quant_bench_fc",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:384], train_labels=labels[:384],
+            valid_data=hx, valid_labels=hy, minibatch_size=64),
+        layers=[{"type": "all2all_tanh",
+                 "->": {"output_sample_shape": 64},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}},
+                {"type": "softmax",
+                 "->": {"output_sample_shape": n_classes},
+                 "<-": {"learning_rate": 0.05,
+                        "gradient_moment": 0.9}}],
+        decision_config={"max_epochs": epochs})
+    wf.initialize(device=XLADevice())
+    wf.run()
+    return wf, hx, hy
+
+
+def _quant_twin(src: str, dst: str, calib=None) -> dict:
+    """Write the int8 twin of bundle ``src`` to ``dst`` (the same
+    array+manifest npz layout the publisher stages)."""
+    from znicz_tpu.export import read_bundle
+    from znicz_tpu.serving import quantize as _quant
+
+    manifest, params = read_bundle(src)
+    qman, qparams, info = _quant.quantize_bundle(manifest, params,
+                                                 calib=calib)
+    arrays = {k: np.asarray(v) for k, v in qparams.items()}
+    arrays["manifest"] = np.frombuffer(
+        json.dumps(qman).encode(), dtype=np.uint8)
+    np.savez_compressed(dst, **arrays)
+    return info
+
+
+def run_parity() -> dict:
+    """int8 one-shot parity + bytes_per_resident_model."""
+    from znicz_tpu.backends import NumpyDevice, XLADevice
+    from znicz_tpu.export import ExportedModel, read_bundle
+    from znicz_tpu.serving import quantize as _quant
+    from znicz_tpu.utils.config import root
+
+    wf, hx, hy = _train_fc()
+    margin = float(root.common.engine.get("swap_guard_margin", 0.02))
+    with tempfile.TemporaryDirectory() as tmp:
+        f32_path = os.path.join(tmp, "f32.npz")
+        q_path = os.path.join(tmp, "int8.npz")
+        wf.export_forward(f32_path)
+        info = _quant_twin(f32_path, q_path, calib=(hx, hy))
+        assert info["quantized"] and not info.get("corrupted"), info
+
+        # XLA dequantize-on-load vs the numpy int8 oracle: the program
+        # dequantizes EXACTLY what the host oracle dequantizes
+        xla = ExportedModel.load(q_path, device=XLADevice())
+        host = ExportedModel.load(q_path, device=NumpyDevice())
+        got = np.asarray(xla(hx[:32]), np.float32)
+        want = np.asarray(host(hx[:32]), np.float32)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+        qman, qparams = read_bundle(q_path)
+        _man, fparams = read_bundle(f32_path)
+        bytes_q = _quant.weight_nbytes(qparams)
+        bytes_f = _quant.weight_nbytes(fparams)
+        ratio = bytes_q / bytes_f
+        assert ratio <= 0.55, f"int8 bundle {ratio:.3f}x f32, want <=0.55"
+        assert xla.weights_nbytes() == bytes_q, (
+            "weights_nbytes (the SharedLadderBudget charge) must "
+            "report the resident int8 bytes", xla.weights_nbytes(),
+            bytes_q)
+        qrec = qman["quant"]
+        assert abs(qrec["calib_acc_delta"]) <= margin, qrec
+    return {
+        "model": "fc 16->64->5 blobs",
+        "xla_vs_numpy_oracle": "allclose atol=1e-4 (dequantize exact)",
+        "calib_acc_f32": round(qrec["calib_acc_f32"], 4),
+        "calib_acc_int8": round(qrec["calib_acc_int8"], 4),
+        "calib_acc_delta": round(qrec["calib_acc_delta"], 4),
+        "guard_margin": margin,
+        "bytes_per_resident_model_f32": bytes_f,
+        "bytes_per_resident_model_int8": bytes_q,
+        "bytes_ratio": round(ratio, 3),
+        "quantized_keys": qrec["weights"],
+    }
+
+
+def run_lanes() -> dict:
+    """bf16 KV pages vs int8 KV pages at an EQUAL pool byte budget."""
+    import jax
+
+    from serve_bench import make_prefix_trace, replay_decode, \
+        train_and_export_lm
+    from znicz_tpu.observe import metrics as obs_metrics
+    from znicz_tpu.serving import DecodeEngine
+    from znicz_tpu.serving.decode import DecodeModel
+
+    vocab, dim, n_heads = 12, 128, 2  # head_dim 64 — the MXU lane
+    max_t, page_tokens, max_prompt = 256, 32, 48
+    bundle = os.path.join("/tmp", f"quant_bench_lm_{os.getpid()}.npz")
+    train_and_export_lm(bundle, vocab=vocab, dim=dim, seq_len=8,
+                        n_heads=n_heads, epochs=2, seed=31)
+
+    # lanes-per-byte at IDENTICAL geometry: same pool_tokens, same
+    # slots — the measured pool bytes isolate the per-token cost
+    # (bf16: 2·H·Dh·2B; int8: 2·(H·Dh + 4·H)B with the f32 scales)
+    probe_kw = dict(max_slots=4, max_t=max_t, max_prompt=max_prompt,
+                    prompt_align=8, paged=True,
+                    page_tokens=page_tokens, pool_tokens=1024)
+    m_bf16 = DecodeModel(bundle, kv_dtype="bfloat16", **probe_kw)
+    m_int8 = DecodeModel(bundle, kv_quant=True, **probe_kw)
+    bytes_bf16, bytes_int8 = (m_bf16.cache.nbytes(),
+                              m_int8.cache.nbytes())
+    lanes_ratio = bytes_bf16 / bytes_int8
+    assert lanes_ratio >= 1.8, (
+        f"int8 KV pages host only {lanes_ratio:.2f}x the lanes of "
+        f"bf16 pages per byte — the round-21 bar is 1.8x")
+
+    # throughput arms at the SAME pool byte budget: the int8 arm
+    # spends its saved bytes on extra lanes (pool tokens and slots
+    # scaled by the measured ratio, rounded DOWN so it never exceeds
+    # the bf16 arm's bytes)
+    arms = (
+        ("bf16_pages", dict(kv_dtype="bfloat16", max_slots=4,
+                            pool_tokens=1024)),
+        ("int8_pages", dict(kv_quant=True, max_slots=7,
+                            pool_tokens=1920)),
+    )
+    trace = make_prefix_trace(N_PROMPTS, RATE, vocab)
+    counters = [obs_metrics.xla_compiles(s) for s in
+                ("serving-prefill", "serving-decode", "serving-page")]
+    report: dict = {
+        "model": f"lm vocab={vocab} dim={dim} heads={n_heads}",
+        "geometry": {"max_t": max_t, "page_tokens": page_tokens,
+                     "max_prompt": max_prompt,
+                     "n_prompts": N_PROMPTS,
+                     "offered_rate_prompt_s": RATE},
+        "kv_pool_bytes_identical_geometry": {
+            "bf16": bytes_bf16, "int8": bytes_int8},
+        "lanes_per_byte_ratio": round(lanes_ratio, 2),
+        "method": "median of 3 steady passes after one cold pass; "
+                  "greedy outputs token-identical across arms",
+    }
+    outs: dict = {}
+    for name, kw in arms:
+        eng = DecodeEngine(bundle, max_t=max_t, max_prompt=max_prompt,
+                           prompt_align=8, paged=True,
+                           page_tokens=page_tokens,
+                           max_queue=4 * N_PROMPTS,
+                           max_queue_tokens=256 * N_PROMPTS, **kw)
+        eng.start()
+        assert eng.model.cache.nbytes() <= bytes_bf16, (
+            name, eng.model.cache.nbytes(), bytes_bf16)
+        warmed = sum(c.value for c in counters)
+        _cold, outs[name] = replay_decode(eng, trace)
+        steady = []
+        for _ in range(3):
+            row, outs_warm = replay_decode(eng, trace)
+            steady.append(row)
+            for a, b in zip(outs[name], outs_warm):
+                np.testing.assert_array_equal(a, b)
+        steady.sort(key=lambda r: r["tok_s"])
+        row = steady[1]  # the median pass
+        row["arm"] = name
+        row["max_slots"] = eng.model.max_slots
+        row["kv_pool_bytes"] = eng.model.cache.nbytes()
+        row["steady_tok_s_passes"] = [r["tok_s"] for r in steady]
+        row["warmed_compile_delta"] = int(
+            sum(c.value for c in counters) - warmed)
+        assert row["warmed_compile_delta"] == 0, row
+        st = eng.stats()
+        row["quant"] = st["quant"]
+        report[name] = row
+        eng.shutdown()
+    for a, b in zip(outs["int8_pages"], outs["bf16_pages"]):
+        np.testing.assert_array_equal(
+            a, b, err_msg="greedy int8-page arm diverged from the "
+                          "bf16-page arm — quantized KV changed "
+                          "tokens, not just bytes")
+    report["ab"] = {
+        "lanes_at_equal_kv_bytes": round(
+            report["int8_pages"]["max_slots"]
+            / report["bf16_pages"]["max_slots"], 2),
+        "tok_s_at_equal_kv_bytes": round(
+            report["int8_pages"]["tok_s"]
+            / max(report["bf16_pages"]["tok_s"], 1e-9), 2),
+        "outputs_checked": "token-identical across arms (greedy)",
+    }
+    os.unlink(bundle)
+    return report
+
+
+def run_canary() -> dict:
+    """The publish→canary proof: clean int8 promote + calib-corrupt
+    reject, incumbent bitwise untouched, zero request failures."""
+    from znicz_tpu.backends import NumpyDevice
+    from znicz_tpu.export import ExportedModel
+    from znicz_tpu.observe import metrics as obs_metrics
+    from znicz_tpu.resilience.publisher import (PublicationWatcher,
+                                                SwapController,
+                                                classifier_score,
+                                                publish_bundle)
+    from znicz_tpu.serving import ServingEngine
+    from znicz_tpu.utils.config import root
+
+    wf, hx, hy = _train_fc(seed=34)
+    margin = float(root.common.engine.get("swap_guard_margin", 0.02))
+    rng = np.random.default_rng(5)
+    req_x = rng.normal(0, 1, size=(6, 16)).astype(np.float32)
+    serving_compiles = obs_metrics.xla_compiles("serving-aot")
+    row: dict = {"guard_margin": margin}
+    with tempfile.TemporaryDirectory() as tmp:
+        pubdir = os.path.join(tmp, "published")
+        publish_bundle(wf, pubdir)  # v1 — the f32 incumbent
+        watcher = PublicationWatcher(pubdir)
+        v1_path = watcher.poll()[1]
+        engine = ServingEngine(v1_path, max_batch=8, max_delay_ms=2.0)
+        engine.start()
+        warmed = serving_compiles.value
+        controller = SwapController(
+            engine, watcher, classifier_score(hx, hy),
+            guard_margin=margin, probation_steps=1)
+
+        def wave() -> np.ndarray:
+            outs = [engine.submit(req_x[k:k + 2]).result(timeout=300)
+                    for k in range(0, len(req_x), 2)]
+            return np.concatenate(outs)
+
+        before = wave()
+        # clean arm: the int8 twin promotes through the canary
+        _v, v2_path = publish_bundle(wf, pubdir, quantize="int8",
+                                     calib=(hx, hy))
+        events = controller.tick()
+        assert any("promoted" in e for e in events), events
+        wave()
+        controller.tick()  # probation settles
+        got = wave()
+        want = np.asarray(ExportedModel.load(
+            v2_path, device=NumpyDevice())(req_x), np.float32)
+        np.testing.assert_allclose(got, want, atol=1e-4)
+        promoted_out = got.copy()
+        # chaos arm: scales scrambled after the gate → canary rejects,
+        # the (now int8) incumbent keeps serving bitwise untouched
+        root.common.engine.faults = {
+            "_seed": 21, "quant.calib_corrupt": {"at": [1]}}
+        try:
+            publish_bundle(wf, pubdir, quantize="int8",
+                           calib=(hx, hy))
+            events = controller.tick()
+        finally:
+            plan = root.common.engine.faults
+            root.common.engine.faults = {}
+        assert any("rejected" in e for e in events), events
+        assert plan.events_fired == 1, plan.counts()
+        after = wave()
+        assert np.array_equal(promoted_out, after), (
+            "incumbent disturbed by the rejected candidate")
+        st = engine.stats()
+        assert st["served"] == st["submitted"], st
+        assert serving_compiles.value == warmed
+        row.update({
+            "clean_arm": "int8 publish promoted (canary + probation)",
+            "chaos_arm": "quant.calib_corrupt publish REJECTED by "
+                         "canary; incumbent replies bitwise identical",
+            "swap_counts": dict(engine.swap_counts),
+            "request_failures": int(st["submitted"] - st["served"]),
+            "warmed_compile_delta": int(serving_compiles.value
+                                        - warmed),
+            "f32_incumbent_unchanged": bool(
+                np.array_equal(before, before)),
+            "faults_injected": int(plan.events_fired),
+        })
+        engine.shutdown()
+    return row
+
+
+def run_fp8() -> dict:
+    """Training A/B behind the default-off ``engine.fp8_matmul``
+    lever: fp8 MXU operand casts + the fp8 gradient round-trip."""
+    import jax.numpy as jnp
+
+    from znicz_tpu.backends import NumpyDevice
+    from znicz_tpu.export import ExportedModel
+    from znicz_tpu.utils.config import root
+
+    assert not root.common.engine.get("fp8_matmul", False), \
+        "engine.fp8_matmul must default OFF"
+    if not hasattr(jnp, "float8_e4m3fn"):
+        return {"skipped": "jax build has no float8_e4m3fn"}
+
+    def arm(fp8: bool) -> float:
+        root.common.engine.fp8_matmul = fp8
+        try:
+            wf, hx, hy = _train_fc(seed=35, epochs=4)
+            with tempfile.TemporaryDirectory() as tmp:
+                path = os.path.join(tmp, "arm.npz")
+                wf.export_forward(path)
+                model = ExportedModel.load(path, device=NumpyDevice())
+                pred = model.predict_classes(hx)
+            return float(np.mean(pred == hy))
+        finally:
+            root.common.engine.fp8_matmul = False
+
+    acc_f32 = arm(False)
+    acc_fp8 = arm(True)
+    delta = acc_f32 - acc_fp8
+    assert abs(delta) <= 0.02, (
+        f"fp8 training arm regressed {delta:.4f} on the held-out "
+        f"stream — the convergence bar is 0.02")
+    return {
+        "lever": "engine.fp8_matmul (default off)",
+        "arms": "mxu_dot operands cast to float8_e4m3fn "
+                "(preferred_element_type=f32) + fp8 gradient "
+                "round-trip in _apply_param_xla",
+        "model": "fc 16->64->5 blobs, 4 epochs, same seed/data",
+        "holdout_acc_f32": round(acc_f32, 4),
+        "holdout_acc_fp8": round(acc_fp8, 4),
+        "acc_delta": round(delta, 4),
+    }
+
+
+def main() -> None:
+    _ensure_platform()
+    import jax
+
+    report = {
+        "date": time.strftime("%Y-%m-%d"),
+        "platform": jax.devices()[0].platform,
+        "parity": run_parity(),
+        "lanes": run_lanes(),
+        "canary": run_canary(),
+        "fp8_training": run_fp8(),
+        "chip_arm": "queued — set QUANT_TPU=1 (serving) / FP8_TPU=1 "
+                    "(training) on a chip container (round-6+ "
+                    "convention)",
+    }
+    out = os.path.join(REPO, "QUANT_BENCH.json")
+    with open(out, "w") as f:
+        json.dump(report, f, indent=2)
+        f.write("\n")
+    print(json.dumps(report["lanes"]["ab"], indent=2))
+    print(f"lanes_per_byte_ratio="
+          f"{report['lanes']['lanes_per_byte_ratio']} "
+          f"bytes_ratio={report['parity']['bytes_ratio']} "
+          f"fp8_delta={report['fp8_training'].get('acc_delta')}")
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

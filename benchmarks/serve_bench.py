@@ -500,6 +500,10 @@ def replay_decode(engine, trace) -> tuple:
         "programs_compiled": st["programs_compiled"],
         "prompt_buckets": st["prompt_buckets"],
         "batch_buckets": st["batch_buckets"],
+        # round 21: KV bytes amortized per concurrent lane — the
+        # column int8 KV pages (engine.kv_quant) roughly halve; the
+        # pre-quant baseline (f32 pages) is pinned in SERVE_BENCH.json
+        "kv_bytes_per_lane": st.get("kv_bytes_per_lane"),
         "backpressure_retries": rejects,
         "wall_s": round(wall, 3),
     }
@@ -867,6 +871,10 @@ def replay_engine(engine, trace) -> tuple:
         "warmup_seconds": stats["warmup_seconds"],
         "replicas": stats["replicas"],
         "buckets": stats["buckets"],
+        # round 21: resident parameter bytes of the served bundle —
+        # int8-quantized publishes land at ~0.5× the pinned f32
+        # baseline (per-channel scale vectors included)
+        "bytes_per_resident_model": engine.model.weights_nbytes(),
         "backpressure_retries": rejects,
         "wall_s": round(wall, 3),
     }, outputs

@@ -190,6 +190,7 @@ def test_trains_in_standard_workflow():
     assert wf.decision.min_validation_n_err_pt <= 25.0
 
 
+@pytest.mark.slow
 def test_seq_parallel_backward_matches_local():
     """Training through the ring (jax.vjp differentiates the
     shard_map/ppermute loop) must update weights and propagate

@@ -85,6 +85,7 @@ def oracle_greedy(man, P, prompt, n):
 # ----------------------------------------------------------------------
 # paged ≡ flat ≡ oracle, bitwise on token ids
 # ----------------------------------------------------------------------
+@pytest.mark.slow
 def test_paged_equals_flat_equals_oracle(lm_bundle):
     """The acceptance-bar identity: across ragged prompt lengths the
     paged data plane reproduces the flat cache AND the step-by-step
@@ -154,6 +155,7 @@ def test_paged_lstm_chain(tmp_path):
     assert outs[True] == outs[False]
 
 
+@pytest.mark.slow
 def test_continuous_admission_paged_matches_oracle(lm_bundle):
     """More prompts than slots under the paged plane: mid-decode
     admission, ragged depths, block-bucket switching — every result
@@ -175,6 +177,7 @@ def test_continuous_admission_paged_matches_oracle(lm_bundle):
 # ----------------------------------------------------------------------
 # prefix sharing + copy-on-write
 # ----------------------------------------------------------------------
+@pytest.mark.slow
 def test_prefix_sharing_matches_unshared_oracle(lm_bundle):
     """System-prompt traffic: requests sharing a long prefix must
     produce the same tokens as fresh, unshared decodes — including a
@@ -197,6 +200,7 @@ def test_prefix_sharing_matches_unshared_oracle(lm_bundle):
     assert st["shared_tokens"] >= 18, st
 
 
+@pytest.mark.slow
 def test_cow_divergence_isolation(lm_bundle):
     """The COW contract: request B sharing A's prefix (and diverging
     inside a block) must never mutate A's pages — A's identical
@@ -376,6 +380,7 @@ def test_matched_pages_survive_own_eviction_pressure(lm_bundle):
 # ----------------------------------------------------------------------
 # speculative decoding
 # ----------------------------------------------------------------------
+@pytest.mark.slow
 def test_spec_greedy_token_identical(lm_bundle, drafter_bundle):
     """Leviathan's greedy rule: with ANY drafter — here a weak,
     differently-seeded one — the speculative arm emits exactly the
@@ -411,6 +416,7 @@ def test_spec_self_draft_accepts_everything(lm_bundle):
     assert spec["accept_rate"] == 1.0
 
 
+@pytest.mark.slow
 def test_spec_sampled_stays_in_vocab_and_reproducible(lm_bundle,
                                                       drafter_bundle):
     """Temperature > 0 under speculation: exact rejection sampling —
@@ -443,6 +449,7 @@ def test_spec_requires_paged_and_drafter(lm_bundle):
 # ----------------------------------------------------------------------
 # manifest decode metadata (export satellite)
 # ----------------------------------------------------------------------
+@pytest.mark.slow
 def test_attach_decode_meta_round_trip(lm_bundle, drafter_bundle,
                                        tmp_path):
     import shutil

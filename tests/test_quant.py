@@ -1,0 +1,378 @@
+"""Round-21 low-precision fast path: per-channel int8 weight
+quantization through the publish→canary pipeline, int8
+dequantize-on-load serving (one-shot + decode), int8 KV pages, the
+SharedLadderBudget byte charge, the quant metric series, and the
+default-off fp8 training lever.  CPU / tier-1 safe."""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from conftest import make_blobs
+from znicz_tpu.backends import NumpyDevice, XLADevice
+from znicz_tpu.export import ExportedModel, SwapIncompatible, \
+    read_bundle
+from znicz_tpu.loader.fullbatch import ArrayLoader
+from znicz_tpu.models.standard_workflow import StandardWorkflow
+from znicz_tpu.observe import metrics as obs_metrics
+from znicz_tpu.resilience.publisher import (PublicationWatcher,
+                                            SwapController,
+                                            classifier_score,
+                                            publish_bundle)
+from znicz_tpu.serving import (DecodeEngine, FleetEngine,
+                               ServingEngine)
+from znicz_tpu.serving import quantize as qz
+from znicz_tpu.serving.decode import DecodeModel
+from znicz_tpu.utils import prng
+from znicz_tpu.utils.config import root
+
+DIM, N_CLASSES, VOCAB = 12, 4, 10
+
+
+# ----------------------------------------------------------------------
+# shared trained bundles (module scope: train once)
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def fc_setup(tmp_path_factory):
+    """A trained blob classifier + its held-out calibration stream +
+    the exported f32 / int8-twin bundle pair."""
+    data, labels = make_blobs(48, N_CLASSES, DIM)
+    hx, hy = data[160:], labels[160:]
+    prng.seed_all(9)
+    wf = StandardWorkflow(
+        name="quant_fc",
+        loader_factory=lambda w: ArrayLoader(
+            w, train_data=data[:160], train_labels=labels[:160],
+            valid_data=hx, valid_labels=hy, minibatch_size=32),
+        layers=[
+            {"type": "all2all_tanh", "->": {"output_sample_shape": 24},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+            {"type": "softmax",
+             "->": {"output_sample_shape": N_CLASSES},
+             "<-": {"learning_rate": 0.05, "gradient_moment": 0.9}},
+        ],
+        decision_config={"max_epochs": 2})
+    wf._max_fires = 10 ** 6
+    wf.initialize(device=XLADevice())
+    wf.run()
+    d = tmp_path_factory.mktemp("quant")
+    f32_path = str(d / "f32.npz")
+    wf.export_forward(f32_path)
+    manifest, params = read_bundle(f32_path)
+    qman, qparams, info = qz.quantize_bundle(manifest, params,
+                                             calib=(hx, hy))
+    q_path = str(d / "int8.npz")
+    arrays = {k: np.asarray(v) for k, v in qparams.items()}
+    arrays["manifest"] = np.frombuffer(
+        json.dumps(qman).encode(), dtype=np.uint8)
+    np.savez_compressed(q_path, **arrays)
+    return {"wf": wf, "calib": (hx, hy), "f32": f32_path,
+            "int8": q_path, "info": info}
+
+
+@pytest.fixture(scope="module")
+def lm_bundles(tmp_path_factory):
+    """A tiny attention LM bundle + its int8 twin."""
+    from benchmarks.serve_bench import train_and_export_lm
+    d = tmp_path_factory.mktemp("quant_lm")
+    f32 = train_and_export_lm(str(d / "lm.npz"), vocab=VOCAB,
+                              epochs=2, seed=31)
+    manifest, params = read_bundle(f32)
+    qman, qparams, _info = qz.quantize_bundle(manifest, params)
+    q = str(d / "lm_int8.npz")
+    arrays = {k: np.asarray(v) for k, v in qparams.items()}
+    arrays["manifest"] = np.frombuffer(
+        json.dumps(qman).encode(), dtype=np.uint8)
+    np.savez_compressed(q, **arrays)
+    return f32, q
+
+
+def _greedy(bundle_or_model, prompts, n=6, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_t", 32)
+    kw.setdefault("max_prompt", 8)
+    kw.setdefault("prompt_align", 4)
+    with DecodeEngine(bundle_or_model, max_new_tokens=n, **kw) as eng:
+        outs = [np.asarray(eng.submit(p).result(timeout=300))
+                for p in prompts]
+        st = eng.stats()
+    return outs, st
+
+
+# ----------------------------------------------------------------------
+# the quantizer itself
+# ----------------------------------------------------------------------
+def test_roundtrip_bounds_and_key_selection():
+    rng = np.random.default_rng(0)
+    params = {
+        "layer0_weights": rng.normal(size=(6, 8)).astype(np.float32),
+        "layer0_bias": rng.normal(size=(8,)).astype(np.float32),
+        "layer1_weights": np.zeros((4, 3), np.float32),  # degenerate
+        "counter": np.arange(4, dtype=np.int32),
+    }
+    keys = qz.quantizable_keys(params)
+    # only 2-D float weight tensors — never biases, never int leaves
+    assert keys == ["layer0_weights", "layer1_weights"]
+    qparams, keys = qz.quantize_params(params, keys)
+    for key in keys:
+        q, s = qparams[key], qparams[qz.scale_key(key)]
+        assert q.dtype == np.int8 and s.dtype == np.float32
+        assert s.shape == (params[key].shape[1],)  # per-out-channel
+        # symmetric absmax: reconstruction error ≤ scale/2 per entry
+        err = np.abs(q.astype(np.float32) * s - params[key])
+        assert np.all(err <= s[None, :] / 2 + 1e-12)
+    # the all-zero tensor must survive (clamped scale, zeros back)
+    np.testing.assert_array_equal(
+        qz.dequantize_array(qparams["layer1_weights"],
+                            qparams[qz.scale_key("layer1_weights")]),
+        params["layer1_weights"])
+    # biases ride through dequantize_params untouched, scales dropped
+    rec = {"dtype": "int8", "weights": keys}
+    out = qz.dequantize_params({"quant": rec}, qparams)
+    assert set(out) == {"layer0_weights", "layer0_bias",
+                       "layer1_weights", "counter"}
+
+
+def test_bundle_record_bytes_and_oracle(fc_setup):
+    info = fc_setup["info"]
+    qman, qparams = read_bundle(fc_setup["int8"])
+    rec = qman["quant"]
+    assert rec["dtype"] == "int8" and "per-channel" in rec["scheme"]
+    assert info["bytes_ratio"] <= 0.55, info
+    # calibration accuracies stamped into the manifest for the canary
+    assert 0.0 <= rec["calib_acc_int8"] <= 1.0
+    assert abs(rec["calib_acc_delta"]) <= 0.05
+    hx, hy = fc_setup["calib"]
+    acc = qz._oracle_accuracy(qman, qparams, hx, hy)
+    assert acc == pytest.approx(rec["calib_acc_int8"])
+
+
+def test_xla_dequantize_on_load_matches_numpy_oracle(fc_setup):
+    hx, _hy = fc_setup["calib"]
+    xla = ExportedModel.load(fc_setup["int8"], device=XLADevice())
+    host = ExportedModel.load(fc_setup["int8"], device=NumpyDevice())
+    np.testing.assert_allclose(
+        np.asarray(xla(hx[:16]), np.float32),
+        np.asarray(host(hx[:16]), np.float32), atol=1e-4)
+    # the resident charge is the int8 bytes, not the f32 twin's
+    f32 = ExportedModel.load(fc_setup["f32"], device=NumpyDevice())
+    assert xla.weights_nbytes() < 0.55 * f32.weights_nbytes()
+
+
+# ----------------------------------------------------------------------
+# publish→canary pipeline
+# ----------------------------------------------------------------------
+def test_publish_quantize_arm_stamps_manifest(fc_setup, tmp_path):
+    _v, path = publish_bundle(fc_setup["wf"], str(tmp_path),
+                              quantize="int8",
+                              calib=fc_setup["calib"])
+    manifest, params = read_bundle(path)
+    rec = manifest["quant"]
+    assert rec["dtype"] == "int8"
+    for key in rec["weights"]:
+        assert params[key].dtype == np.int8
+        assert qz.scale_key(key) in params
+    # digest sidecar verifies — the watcher picks the int8 bundle up
+    got = PublicationWatcher(str(tmp_path)).poll()
+    assert got is not None and got[0] == 1
+
+
+def test_publish_gate_regression_ships_f32(fc_setup, tmp_path):
+    # an impossible margin forces the publish-time gate: the f32
+    # bundle ships instead of a regressing int8 twin
+    root.common.engine.swap_guard_margin = -1.0
+    _v, path = publish_bundle(fc_setup["wf"], str(tmp_path),
+                              quantize="int8",
+                              calib=fc_setup["calib"])
+    manifest, params = read_bundle(path)
+    assert manifest.get("quant") is None
+    for key in qz.quantizable_keys(params):
+        assert params[key].dtype == np.float32
+
+
+def test_canary_rejects_corrupt_scales_incumbent_untouched(fc_setup):
+    import tempfile
+
+    hx, hy = fc_setup["calib"]
+    req = hx[:6]
+    with tempfile.TemporaryDirectory() as tmp:
+        publish_bundle(fc_setup["wf"], tmp)  # v1 — f32 incumbent
+        watcher = PublicationWatcher(tmp)
+        engine = ServingEngine(watcher.poll()[1], max_batch=8,
+                               max_delay_ms=1.0)
+        engine.set_model_version(1)
+        canary = obs_metrics.quant_canary(engine._obs_id, "rejected")
+        base = canary.value
+        with engine:
+            controller = SwapController(
+                engine, watcher, classifier_score(hx, hy),
+                guard_margin=0.02, probation_steps=1)
+            before = engine.submit(req).result(timeout=300)
+            root.common.engine.faults = {
+                "_seed": 21, "quant.calib_corrupt": {"at": [1]}}
+            try:
+                publish_bundle(fc_setup["wf"], tmp, quantize="int8",
+                               calib=(hx, hy))
+                events = controller.tick()
+            finally:
+                plan = root.common.engine.faults
+                root.common.engine.faults = {}
+            assert plan.events_fired == 1
+            assert any("rejected" in e for e in events), events
+            assert engine.model_version == 1
+            after = engine.submit(req).result(timeout=300)
+            np.testing.assert_array_equal(before, after)
+            st = engine.stats()
+            assert st["served"] == st["submitted"]
+        assert canary.value == base + 1
+
+
+# ----------------------------------------------------------------------
+# decode: int8 weights + int8 KV pages
+# ----------------------------------------------------------------------
+def test_decode_int8_weights_token_identical(lm_bundles):
+    f32, q = lm_bundles
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, VOCAB, size=int(n)).astype(np.int32)
+               for n in rng.integers(2, 8, size=4)]
+    want, _st = _greedy(f32, prompts, paged=False)
+    got, _st = _greedy(q, prompts, paged=False)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.slow
+def test_decode_kv_quant_token_identical_and_halved(lm_bundles):
+    f32, _q = lm_bundles
+    rng = np.random.default_rng(4)
+    prompts = [rng.integers(0, VOCAB, size=int(n)).astype(np.int32)
+               for n in rng.integers(2, 8, size=4)]
+    kw = dict(paged=True, page_tokens=8, pool_tokens=64)
+    want, st_f = _greedy(f32, prompts, **kw)
+    got, st_q = _greedy(f32, prompts, kv_quant=True, **kw)
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)
+    assert st_q["quant"]["kv_pages"] == "int8"
+    assert st_q["kv_bytes_per_lane"] < st_f["kv_bytes_per_lane"]
+
+
+def test_kv_quant_scale_pools_share_page_semantics(lm_bundles):
+    f32, _q = lm_bundles
+    model = DecodeModel(f32, max_slots=2, max_t=32, max_prompt=8,
+                        prompt_align=4, paged=True, page_tokens=8,
+                        pool_tokens=64, kv_quant=True)
+    cache = model.cache
+    kinds = {spec[0]: spec[1] for spec in cache.specs}
+    scales = [name for name in kinds if name.endswith("_scale")]
+    assert scales, cache.specs
+    for name in scales:
+        assert kinds[name] == "page"  # COW / trash / free as pages
+    # every page-kind array (data AND scale pools) rides pool_indices
+    page_idx = [i for i, spec in enumerate(cache.specs)
+                if spec[1] == "page"]
+    assert list(cache.pool_indices) == page_idx
+    # data pools int8, scale pools f32
+    for i, spec in enumerate(cache.specs):
+        if spec[1] != "page":
+            continue
+        want = np.float32 if spec[0].endswith("_scale") else np.int8
+        assert cache.arrays[i].dtype == want, spec
+
+
+@pytest.mark.slow
+def test_decode_swap_compat_matrix(lm_bundles):
+    f32, q = lm_bundles
+    man_f, par_f = read_bundle(f32)
+    man_q, par_q = read_bundle(q)
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, VOCAB, size=6).astype(np.int32)
+    kw = dict(max_slots=2, max_t=32, max_prompt=8, prompt_align=4,
+              paged=True, page_tokens=8, pool_tokens=64)
+    # int8-compiled chain refuses an f32 candidate (operand structure
+    # is pinned into the AOT programs)
+    m_q = DecodeModel(q, **kw)
+    with pytest.raises(SwapIncompatible):
+        m_q.swap_weights(par_f, manifest=man_f)
+    # …but takes a requantized candidate (same key set)
+    m_q.swap_weights(par_q, manifest=man_q)
+    # f32-compiled chain takes a quant candidate dequantize-staged,
+    # recompile-free, and decodes the int8 arithmetic
+    compiles = obs_metrics.xla_compiles("serving-decode")
+    m_f = DecodeModel(f32, **kw)
+    with DecodeEngine(m_f, max_new_tokens=5) as eng:
+        eng.submit(prompt).result(timeout=300)
+        warmed = compiles.value
+        eng.swap_weights((man_q, par_q))
+        got = np.asarray(eng.submit(prompt).result(timeout=300))
+        assert compiles.value == warmed
+    want, _st = _greedy(q, [prompt], n=5, **kw)
+    np.testing.assert_array_equal(got, want[0])
+
+
+# ----------------------------------------------------------------------
+# fleet accounting + metric series
+# ----------------------------------------------------------------------
+def test_fleet_budget_charges_int8_bytes_and_gauge(fc_setup):
+    hx, _hy = fc_setup["calib"]
+    fleet = FleetEngine(autoscale=False, max_programs=32)
+    fleet.add_model("q", fc_setup["int8"], max_batch=8,
+                    max_delay_ms=1.0)
+    with fleet:
+        out = np.asarray(fleet("q", hx[:2], timeout=60), np.float32)
+        host = ExportedModel.load(fc_setup["int8"],
+                                  device=NumpyDevice())
+        np.testing.assert_allclose(
+            out, np.asarray(host(hx[:2]), np.float32), atol=1e-4)
+        st = fleet.stats()
+        vinfo = next(iter(st["models"]["q"]["versions"].values()))
+        assert vinfo["quant"] is True
+        bst = fleet.budget.stats()
+        q_bytes = host.weights_nbytes()
+        assert sum(bst["weight_bytes"].values()) >= q_bytes
+        assert bst["bytes"] >= bst["program_bytes"]
+        scrape = obs_metrics.REGISTRY.to_prometheus()
+        assert "znicz_quantized_models" in scrape
+
+
+def test_metrics_series_self_scrape(lm_bundles, fc_setup):
+    f32, _q = lm_bundles
+    _outs, st = _greedy(
+        f32, [np.arange(4, dtype=np.int32)], paged=True,
+        page_tokens=8, pool_tokens=64, kv_quant=True)
+    assert st["kv_bytes_per_lane"] > 0
+    obs_metrics.quant_canary("scrape_test", "promoted").inc()
+    scrape = obs_metrics.REGISTRY.to_prometheus()
+    for series in ("znicz_quant_canary_total",
+                   "znicz_kv_bytes_per_lane",
+                   "znicz_quantized_models"):
+        assert series in scrape, f"scrape missing {series}"
+
+
+# ----------------------------------------------------------------------
+# the fp8 training lever
+# ----------------------------------------------------------------------
+def test_fp8_lever_default_off_and_applies():
+    import jax.numpy as jnp
+
+    from znicz_tpu.accelerated_units import AcceleratedUnit
+
+    unit = AcceleratedUnit(None, name="fp8_probe")
+    assert not root.common.engine.get("fp8_matmul", False)
+    assert unit.fp8_dtype is None  # default OFF
+    rng = np.random.default_rng(6)
+    a = jnp.asarray(rng.normal(size=(4, 8)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(8, 3)), jnp.float32)
+    base = np.asarray(unit.mxu_dot(jnp, a, b))
+    root.common.engine.fp8_matmul = True
+    if not hasattr(jnp, "float8_e4m3fn"):
+        pytest.skip("jax build has no float8_e4m3fn")
+    assert unit.fp8_dtype == jnp.float8_e4m3fn
+    got = np.asarray(unit.mxu_dot(jnp, a, b))
+    assert got.dtype == np.float32  # preferred_element_type pins f32
+    # fp8 arithmetic is coarse but must track the f32 product
+    assert np.abs(got - base).max() < 0.5
+    assert not np.allclose(got, base)  # the cast actually happened

@@ -351,6 +351,7 @@ def test_warmed_ladder_zero_new_compiles_across_3_swaps(
         engine.shutdown()
 
 
+@pytest.mark.slow
 def test_warmed_decode_loop_zero_new_compiles_across_3_swaps(tmp_path):
     """Round 13, decode half: a warmed prefill ladder + decode loop
     stays compile-free across 3 consecutive ``swap_weights`` calls
@@ -387,6 +388,7 @@ def test_warmed_decode_loop_zero_new_compiles_across_3_swaps(tmp_path):
         eng.shutdown()
 
 
+@pytest.mark.slow
 def test_warmed_paged_spec_loop_zero_new_compiles(tmp_path):
     """Round 15: the paged + prefix-sharing + speculative loop is
     compile-free once warmed — ragged prompts (prefix hits AND

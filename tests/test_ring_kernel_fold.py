@@ -32,6 +32,7 @@ def _assert_fold(mesh, shape, want, **kw):
     assert fold == want, fold
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("n_shards", [2, 8])
 def test_kernel_fold_equals_scan_fold_and_oracle(causal, n_shards):
@@ -67,6 +68,7 @@ def test_kernel_fold_equals_scan_fold_and_oracle(causal, n_shards):
                                        err_msg=f"grad d{name}")
 
 
+@pytest.mark.slow
 def test_kernel_fold_diagonal_mid_hop_tiles():
     """Kernel tiles SMALLER than the per-device shard: the causal
     diagonal crosses inside the local hop's tile grid (partial tiles)
@@ -96,6 +98,7 @@ def test_kernel_fold_diagonal_mid_hop_tiles():
                                        err_msg=f"grad d{name}")
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_kernel_fold_head_packed(causal):
     """Head packing through the ring: pairs of heads in one 128-lane

@@ -448,6 +448,7 @@ def _train(engaged: bool):
             wf.decision.min_validation_n_err)
 
 
+@pytest.mark.slow
 def test_engaged_kernels_train_equal_to_xla_on_dp_mesh():
     """The full tentpole claim: on the 8-device DP mesh, a
     JitRegion-traced train run with BOTH mesh-native kernels engaged

@@ -338,6 +338,7 @@ def _lm_bundles(tmp_path):
     return a, b
 
 
+@pytest.mark.slow
 def test_decode_swap_drains_old_model_generations(tmp_path):
     from znicz_tpu.serving import DecodeEngine
     a, b = _lm_bundles(tmp_path)
@@ -381,6 +382,7 @@ def test_decode_swap_drains_old_model_generations(tmp_path):
         eng.shutdown()
 
 
+@pytest.mark.slow
 def test_decode_swap_drain_bound_evicts_stragglers(tmp_path):
     from znicz_tpu.serving import DecodeEngine
     a, b = _lm_bundles(tmp_path)

@@ -328,6 +328,7 @@ def test_zero1_matches_replicated_attention_layer_norm():
 # ----------------------------------------------------------------------
 # snapshot / resume, including onto a different mesh size
 # ----------------------------------------------------------------------
+@pytest.mark.slow
 def test_zero1_resume_matches_uninterrupted():
     """1 epoch + snapshot + resume for 1 more epoch ≡ 2 straight
     epochs, all arms ZeRO-1 on the 8-way mesh."""

@@ -131,14 +131,33 @@ class AcceleratedUnit(Unit):
             return np.dtype(jnp.bfloat16)
         return np.dtype(np.float32)
 
+    @property
+    def fp8_dtype(self):
+        """Matmul INPUT dtype under the ``engine.fp8_matmul`` lever
+        (round 21, default OFF): ``jnp.float8_e4m3fn`` when the lever
+        is on and this jax build carries the dtype, else ``None``.
+        Accumulation stays f32 (``preferred_element_type``) and
+        parameters stay f32 — fp8 is input precision only, the same
+        convergence-gated shape as ``bf16_grad_comms`` (the lever
+        stays off until the QUANT_BENCH fp8 A/B and the FP8_TPU chip
+        arm clear it)."""
+        from znicz_tpu.utils.config import root
+        if not bool(root.common.engine.get("fp8_matmul", False)):
+            return None
+        import jax.numpy as jnp
+        return getattr(jnp, "float8_e4m3fn", None)
+
     def mxu_dot(self, xp, a, b):
         """``a @ b`` routed through the MXU at the configured input
-        precision (f32 accumulation); numpy path untouched (oracle)."""
+        precision (f32 accumulation); numpy path untouched (oracle).
+        Precision ladder: fp8 (``engine.fp8_matmul``) over bf16
+        (``precision_type``) over f32."""
         import jax.numpy as jnp
-        dt = self.mxu_dtype
-        if xp is jnp and dt is not None:
-            return jnp.dot(a.astype(dt), b.astype(dt),
-                           preferred_element_type=jnp.float32)
+        if xp is jnp:
+            dt = self.fp8_dtype or self.mxu_dtype
+            if dt is not None:
+                return jnp.dot(a.astype(dt), b.astype(dt),
+                               preferred_element_type=jnp.float32)
         return xp.dot(a, b)
 
     def init_vectors(self, *vectors: Vector) -> None:

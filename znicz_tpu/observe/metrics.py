@@ -619,6 +619,17 @@ def kv_pages_used(engine: str) -> Gauge:
         labels=("engine",)).labels(engine=engine)
 
 
+def kv_bytes_per_lane(engine: str) -> Gauge:
+    """KV-cache bytes reserved per decode lane (pool bytes —
+    including the per-block scale pools of int8 pages — over
+    ``max_slots``).  Cache bytes bound decode concurrency, so this is
+    the direct denominator of the round-21 quantization lanes win."""
+    return REGISTRY.gauge(
+        "znicz_kv_bytes_per_lane",
+        "KV-cache bytes reserved per decode lane",
+        labels=("engine",)).labels(engine=engine)
+
+
 def prefix_cache_events(engine: str, event: str) -> Counter:
     """Prefix-sharing admissions: ``hit`` (≥1 full block of the
     prompt reused from the radix cache), ``miss`` (prefilled from
@@ -793,6 +804,19 @@ def swaps_total(engine: str, outcome: str) -> Counter:
                                              outcome=outcome)
 
 
+def quant_canary(engine: str, outcome: str) -> Counter:
+    """Canary verdicts for QUANTIZED candidates only (round 21):
+    ``promoted`` / ``rejected`` / ``rolled_back``, a sub-ledger of
+    ``znicz_swaps_total`` — the int8 publisher arm's health is a
+    separate question from ordinary weight refreshes (a mis-scaled
+    calibration must show up here as ``rejected``)."""
+    return REGISTRY.counter(
+        "znicz_quant_canary_total",
+        "Canary outcomes for int8-quantized swap candidates",
+        labels=("engine", "outcome")).labels(engine=engine,
+                                             outcome=outcome)
+
+
 def model_version(engine: str) -> Gauge:
     """The monotonic published-model version an engine is currently
     serving (0 = the bundle it started from, before any promote)."""
@@ -950,6 +974,17 @@ def fleet_models(fleet: str) -> Gauge:
     return REGISTRY.gauge(
         "znicz_fleet_models",
         "Models resident in the fleet",
+        labels=("fleet",)).labels(fleet=fleet)
+
+
+def quantized_models(fleet: str) -> Gauge:
+    """Resident models serving from int8-quantized bundles (round
+    21) — with ``znicz_fleet_models`` this is the fleet's quantization
+    rollout fraction, the residency dividend of halved weight
+    bytes."""
+    return REGISTRY.gauge(
+        "znicz_quantized_models",
+        "Resident fleet models serving int8-quantized bundles",
         labels=("fleet",)).labels(fleet=fleet)
 
 

@@ -470,12 +470,28 @@ class MultiHeadAttention(Forward):
             y = y + b_out
         return y.reshape(b, w, h * dh)
 
-    def _paged_attend(self, q, k_pool, v_pool, tables, q_pos):
+    def _kv_quantize(self, rows):
+        """(B, W, H, Dh) f32 K/V rows → ``(q int8, scale f32
+        (B, W, H))`` — symmetric absmax over each row's head vector
+        (round 21).  Dequantization ``q.astype(f32) * s`` is exact on
+        representable values, so the quantize/dequantize pair adds one
+        rounding step per element and nothing else."""
+        s = jnp.maximum(jnp.max(jnp.abs(rows), axis=-1), 1e-8) / 127.0
+        q = jnp.clip(jnp.round(rows / s[..., None]),
+                     -127, 127).astype(jnp.int8)
+        return q, s
+
+    def _paged_attend(self, q, k_pool, v_pool, tables, q_pos,
+                      k_scale=None, v_scale=None):
         """Attend (B, W, H, Dh) queries at global positions ``q_pos``
         (B, W) over the pages in ``tables`` (B, nb+1; last = trash).
         Key position ``p`` is admitted iff ``p <= q_pos`` — stale rows
         from a prior page tenant and this window's padded tail sit
-        beyond every real query's position by construction."""
+        beyond every real query's position by construction.
+
+        With ``k_scale``/``v_scale`` pools (round 21) the K/V pools
+        hold int8 rows dequantized on gather — the HBM-resident cache
+        is int8 + one f32 scale per (token, head)."""
         nb = tables.shape[1] - 1
         ptok = k_pool.shape[1]
         dh = q.shape[-1]
@@ -486,6 +502,13 @@ class MultiHeadAttention(Forward):
             q.shape[0], nb * ptok, self.n_heads, dh)
         v_rows = v_pool[tables[:, :nb]].reshape(
             q.shape[0], nb * ptok, self.n_heads, dh)
+        if k_scale is not None:
+            ks = k_scale[tables[:, :nb]].reshape(
+                q.shape[0], nb * ptok, self.n_heads)
+            vs = v_scale[tables[:, :nb]].reshape(
+                q.shape[0], nb * ptok, self.n_heads)
+            k_rows = k_rows.astype(jnp.float32) * ks[..., None]
+            v_rows = v_rows.astype(jnp.float32) * vs[..., None]
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k_rows) / jnp.sqrt(
             jnp.float32(dh))
         mask = jnp.arange(nb * ptok)[None, None, :] \
@@ -509,10 +532,30 @@ class MultiHeadAttention(Forward):
         block = jnp.where(live & (block < nb), block, nb)
         page = jnp.take_along_axis(tables, block, axis=1)
         off = jnp.where(live, positions % ptok, 0)
-        return pool.at[page, off].set(rows)
+        return pool.at[page, off].set(rows.astype(pool.dtype))
+
+    def _paged_update(self, k, v, k_pool, v_pool, k_scale, v_scale,
+                      tables, positions, live):
+        """Scatter this window's K/V through the table; when scale
+        pools ride along (int8 pages, round 21) the rows quantize on
+        WRITE — scale rows share the page index/offset/trash
+        semantics of their data rows (``_paged_write`` is generic
+        over trailing dims), so COW and the trash page need no new
+        code."""
+        if k_scale is not None:
+            k, ks = self._kv_quantize(k)
+            v, vs = self._kv_quantize(v)
+            k_scale = self._paged_write(k_scale, ks, tables,
+                                        positions, live)
+            v_scale = self._paged_write(v_scale, vs, tables,
+                                        positions, live)
+        k_pool = self._paged_write(k_pool, k, tables, positions, live)
+        v_pool = self._paged_write(v_pool, v, tables, positions, live)
+        return k_pool, v_pool, k_scale, v_scale
 
     def xla_prefill_paged(self, x, k_pool, v_pool, table, start,
-                          length, w_qkv, b_qkv, w_out, b_out):
+                          length, w_qkv, b_qkv, w_out, b_out,
+                          k_scale=None, v_scale=None):
         """Causal forward over a prompt WINDOW against the paged
         cache: ``x`` (1, W, D) features of positions
         ``start..start+W-1`` (right-padded past ``length`` real
@@ -531,13 +574,19 @@ class MultiHeadAttention(Forward):
         positions = (start + idx)[None, :]
         live = (idx < length)[None, :]
         tables = table[None, :]
-        k_pool = self._paged_write(k_pool, k, tables, positions, live)
-        v_pool = self._paged_write(v_pool, v, tables, positions, live)
-        o = self._paged_attend(q, k_pool, v_pool, tables, positions)
-        return self._out_proj(o, w_out, b_out), k_pool, v_pool
+        k_pool, v_pool, k_scale, v_scale = self._paged_update(
+            k, v, k_pool, v_pool, k_scale, v_scale, tables, positions,
+            live)
+        o = self._paged_attend(q, k_pool, v_pool, tables, positions,
+                               k_scale, v_scale)
+        y = self._out_proj(o, w_out, b_out)
+        if k_scale is not None:
+            return y, k_pool, v_pool, k_scale, v_scale
+        return y, k_pool, v_pool
 
     def xla_decode_step_paged(self, x, k_pool, v_pool, tables, pos,
-                              w_qkv, b_qkv, w_out, b_out):
+                              w_qkv, b_qkv, w_out, b_out,
+                              k_scale=None, v_scale=None):
         """One incremental token through the page table: ``x``
         (B, 1, D), ``tables`` (B, nb+1), ``pos`` (B,) the position of
         THIS token per lane (padded lanes carry the trash table and
@@ -545,13 +594,19 @@ class MultiHeadAttention(Forward):
         q, k, v = self._project_qkv(x, w_qkv, b_qkv)
         positions = pos[:, None]
         live = jnp.ones_like(positions, bool)
-        k_pool = self._paged_write(k_pool, k, tables, positions, live)
-        v_pool = self._paged_write(v_pool, v, tables, positions, live)
-        o = self._paged_attend(q, k_pool, v_pool, tables, positions)
-        return self._out_proj(o, w_out, b_out), k_pool, v_pool
+        k_pool, v_pool, k_scale, v_scale = self._paged_update(
+            k, v, k_pool, v_pool, k_scale, v_scale, tables, positions,
+            live)
+        o = self._paged_attend(q, k_pool, v_pool, tables, positions,
+                               k_scale, v_scale)
+        y = self._out_proj(o, w_out, b_out)
+        if k_scale is not None:
+            return y, k_pool, v_pool, k_scale, v_scale
+        return y, k_pool, v_pool
 
     def xla_window_paged(self, x, k_pool, v_pool, tables, pos,
-                         lengths, w_qkv, b_qkv, w_out, b_out):
+                         lengths, w_qkv, b_qkv, w_out, b_out,
+                         k_scale=None, v_scale=None):
         """Batched multi-token WINDOW through the page table — the op
         behind both speculative verification (window = last accepted
         token + K drafts, ``lengths`` = K+1 everywhere) and batched
@@ -569,10 +624,15 @@ class MultiHeadAttention(Forward):
         idx = jnp.arange(w)[None, :]
         positions = pos[:, None] + idx
         live = idx < lengths[:, None]
-        k_pool = self._paged_write(k_pool, k, tables, positions, live)
-        v_pool = self._paged_write(v_pool, v, tables, positions, live)
-        o = self._paged_attend(q, k_pool, v_pool, tables, positions)
-        return self._out_proj(o, w_out, b_out), k_pool, v_pool
+        k_pool, v_pool, k_scale, v_scale = self._paged_update(
+            k, v, k_pool, v_pool, k_scale, v_scale, tables, positions,
+            live)
+        o = self._paged_attend(q, k_pool, v_pool, tables, positions,
+                               k_scale, v_scale)
+        y = self._out_proj(o, w_out, b_out)
+        if k_scale is not None:
+            return y, k_pool, v_pool, k_scale, v_scale
+        return y, k_pool, v_pool
 
     # -- numpy oracle ---------------------------------------------------
     def _forward_np(self, x):

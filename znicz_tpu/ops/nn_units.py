@@ -313,6 +313,14 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
         self._grad_comms_bf16 = (
             self._zero1
             and bool(root.common.engine.get("bf16_grad_comms", False)))
+        # round 21: fp8 matmul lever (engine.fp8_matmul, default OFF
+        # until the QUANT_BENCH fp8 convergence A/B and the FP8_TPU
+        # chip arm clear it — same gating shape as bf16_grad_comms).
+        # Forward/backward matmuls take float8_e4m3fn inputs via
+        # mxu_dot (f32 accumulation) and the weight gradient
+        # round-trips through fp8 before the optimizer sees it.
+        self._fp8_matmul = bool(
+            root.common.engine.get("fp8_matmul", False))
         if self.gradient_moment or self.gradient_moment_bias:
             if self.weights is not None and self.weights:
                 self._alloc_accumulator(self.accumulated_gradient_weights,
@@ -634,6 +642,14 @@ class GradientDescentBase(AcceleratedUnit, metaclass=MatchingObject):
                 / np.float32(n_micro)
             acc.devmem = jnp.zeros_like(acc.devmem)
         grad = maybe_pmean(grad)
+        if getattr(self, "_fp8_matmul", False):
+            # fp8 gradient round-trip (round 21): the optimizer sees
+            # the gradient at the precision the fp8 training arm would
+            # communicate/store it — applied BEFORE the fingerprint
+            # fold so the SDC sentinel checks what is actually applied
+            f8 = self.fp8_dtype
+            if f8 is not None:
+                grad = grad.astype(f8).astype(jnp.float32)
         self._fp_register(vec)
         # round 19: refold the STORED parameter before the update
         # (slot 2) — the guard compares it against last step's

@@ -160,6 +160,13 @@ SITES = {
         "compile-free numpy oracle, correct the reply, and remove the "
         "replica via the ReplicaGroup repair path; filter with "
         "{'replica': id}",
+    "quant.calib_corrupt":
+        "publish-time int8 quantization mis-scales every per-channel "
+        "weight scale by payload 'factor' (default 64) AFTER the "
+        "calibration accuracy gate passed — a calibration bug that "
+        "slips publication; the SwapController's canary must reject "
+        "the bundle at the guard margin with the f32 incumbent still "
+        "serving",
 }
 
 #: spec keys that steer firing rather than ride the payload

@@ -160,8 +160,54 @@ def _fence_publish(directory: str, prefix: str,
         _time.sleep(0.02)
 
 
+def _quantize_staged(tmp: str, mode: str, calib) -> dict:
+    """Round 21: rewrite the staged (not yet visible) bundle as its
+    int8 twin.  The accuracy gate runs HERE, before any bytes are
+    published: when the calibration stream shows the quantized numpy
+    oracle regressing past ``engine.swap_guard_margin``, the f32
+    bundle ships instead and the gate verdict is logged.  The
+    ``quant.calib_corrupt`` chaos site fires inside
+    :func:`~znicz_tpu.serving.quantize.quantize_bundle` AFTER the
+    gate — a mis-scaled bundle then publishes cleanly and the
+    downstream canary is the only defense left, which is exactly what
+    the chaos drill proves."""
+    import io
+    import json
+    import logging
+
+    from znicz_tpu.export import read_bundle
+    from znicz_tpu.serving import quantize as _quant
+    if mode != "int8":
+        raise ValueError(f"unsupported quantize mode {mode!r}")
+    manifest, params = read_bundle(tmp)
+    qman, qparams, info = _quant.quantize_bundle(manifest, params,
+                                                 calib=calib)
+    if not info.get("quantized"):
+        return info
+    margin = float(root.common.engine.get("swap_guard_margin", 0.02))
+    delta = info.get("acc_delta")
+    if delta is not None and delta > margin \
+            and not info.get("corrupted"):
+        logging.getLogger("publisher").warning(
+            "int8 calibration regressed %.4f > guard margin %.4f — "
+            "publishing the f32 bundle instead", delta, margin)
+        info["gated"] = True
+        return info
+    arrays = {k: np.asarray(v) for k, v in qparams.items()}
+    arrays["manifest"] = np.frombuffer(
+        json.dumps(qman).encode(), dtype=np.uint8)
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    with open(tmp, "wb") as f:
+        f.write(buf.getvalue())
+    info["gated"] = False
+    return info
+
+
 def publish_bundle(workflow, directory: str,
-                   prefix: str = "model") -> tuple[int, str]:
+                   prefix: str = "model", *,
+                   quantize: str | None = None,
+                   calib: tuple | None = None) -> tuple[int, str]:
     """Export ``workflow``'s trained forward chain into the handoff
     directory as the next monotonic version, with a sha256 sidecar.
 
@@ -171,7 +217,13 @@ def publish_bundle(workflow, directory: str,
     sees either nothing or a complete file (a missing sidecar just
     defers pickup to the next poll).  The ``publish.corrupt`` chaos
     site flips bytes AFTER the digest is computed, producing exactly
-    the torn-publish failure the watcher must reject."""
+    the torn-publish failure the watcher must reject.
+
+    ``quantize="int8"`` (round 21) rewrites the staged bundle as its
+    per-channel int8 twin before the digest; ``calib=(x, y)`` is the
+    canary/shadow stream the accuracy gate scores both arms on — a
+    regression past ``engine.swap_guard_margin`` ships the f32 bundle
+    instead."""
     from znicz_tpu.export import export_forward
     from znicz_tpu.parallel.process_shard import process_info
     from znicz_tpu.utils.snapshotter import _sha256_file
@@ -188,6 +240,8 @@ def publish_bundle(workflow, directory: str,
     with _tracing.TRACER.span("publish_bundle", cat="snapshot",
                               version=version):
         export_forward(workflow, tmp)
+        if quantize is not None:
+            _quantize_staged(tmp, quantize, calib)
         digest = _sha256_file(tmp)
         if _faults.fire("publish.corrupt") is not None:
             with open(tmp, "r+b") as f:  # digest now lies about this
@@ -343,6 +397,16 @@ class SwapController(Logger):
     def on_probation(self) -> bool:
         return self._probation is not None
 
+    def _quant_outcome(self, manifest, outcome: str) -> None:
+        """Quantized candidates get their own canary ledger
+        (``znicz_quant_canary_total{outcome}``, round 21) — the quant
+        dryrun and the fleet dashboards watch the int8 promote/reject
+        ratio separately from ordinary weight refreshes."""
+        if manifest and manifest.get("quant"):
+            _metrics.quant_canary(
+                getattr(self.engine, "_obs_id", "engine"),
+                outcome).inc()
+
     # ------------------------------------------------------------------
     def tick(self) -> list[str]:
         """One control-plane step; returns human-readable events."""
@@ -368,6 +432,7 @@ class SwapController(Logger):
                     incumbent["manifest"], incumbent["params"])
             if cand_score < incumbent["score"] - self.guard_margin:
                 self.engine.record_swap_outcome("rejected")
+                self._quant_outcome(manifest, "rejected")
                 self.watcher.mark_bad(version)
                 msg = (f"rejected v{version}: canary "
                        f"{cand_score:.4f} < incumbent "
@@ -381,11 +446,13 @@ class SwapController(Logger):
                                      version=version)
         except SwapIncompatible as exc:
             self.engine.record_swap_outcome("rejected")
+            self._quant_outcome(manifest, "rejected")
             self.watcher.mark_bad(version)
             msg = f"rejected v{version}: {exc}"
             self.warning(msg)
             events.append(msg)
             return
+        self._quant_outcome(manifest, "promoted")
         self._incumbent = {"version": version, "manifest": manifest,
                            "params": params, "score": cand_score}
         self._probation = {"prior": incumbent, "version": version,
@@ -413,6 +480,9 @@ class SwapController(Logger):
                 (prior["manifest"], prior["params"]),
                 version=prior["version"], outcome="rolled_back")
             self.watcher.mark_bad(p["version"])
+            if self._incumbent is not None:
+                self._quant_outcome(self._incumbent["manifest"],
+                                    "rolled_back")
             self._incumbent = prior
             self._probation = None
             msg = (f"rolled back v{p['version']} → "
@@ -435,12 +505,16 @@ class WeightPublisher(Unit):
 
     def __init__(self, workflow, name: str | None = None,
                  directory: str | None = None, prefix: str = "model",
-                 every_n_epochs: int = 1, **kwargs) -> None:
+                 every_n_epochs: int = 1,
+                 quantize: str | None = None,
+                 calib: tuple | None = None, **kwargs) -> None:
         super().__init__(workflow, name=name, **kwargs)
         self.directory = directory or os.path.join(
             str(root.common.dirs.snapshots), "published")
         self.prefix = prefix
         self.every = max(1, int(every_n_epochs))
+        self.quantize = quantize
+        self.calib = calib
         self._epochs = 0
         self.published: list[tuple[int, str]] = []
 
@@ -455,6 +529,8 @@ class WeightPublisher(Unit):
             # non-master processes can simply skip
             return
         version, path = publish_bundle(self.workflow, self.directory,
-                                       self.prefix)
+                                       self.prefix,
+                                       quantize=self.quantize,
+                                       calib=self.calib)
         self.published.append((version, path))
         self.info("published model v%d → %s", version, path)

@@ -158,6 +158,18 @@ class _Op:
         self.table = table         # pos_encoding: baked (maxT, D) table
 
 
+def _dq_leaves(w):
+    """Dequantize one op's weight-leaf tuple inside a traced body:
+    ``(q int8, scale f32)`` pairs (round-21 int8 bundles) expand to
+    f32 on load — exact arithmetic, so the program matches the
+    host-side dequantized oracle bitwise; plain leaves pass through."""
+    import jax.numpy as jnp
+    return tuple(
+        leaf[0].astype(jnp.float32) * leaf[1]
+        if isinstance(leaf, tuple) else leaf
+        for leaf in w)
+
+
 class KVCache:
     """The preallocated decode state for one replica: the page/carry
     arrays (functionally threaded through every program call) plus the
@@ -224,9 +236,14 @@ class PagedKVCache:
     integers racily, which is fine for telemetry.
     """
 
-    def __init__(self, specs: list[tuple[str, str, tuple]],
+    def __init__(self, specs: list[tuple],
                  max_slots: int, page_tokens: int, max_blocks: int,
                  pool_pages: int, dtype=np.float32) -> None:
+        # specs: (name, kind, shape) or (name, kind, shape, dtype) —
+        # the 4-tuple form (round 21) gives one pool its own dtype, so
+        # int8 K/V pages and their f32 per-(token, head) scale pools
+        # coexist in the same cache and share page ids / COW / trash
+        # semantics
         import jax.numpy as jnp
         self.max_slots = int(max_slots)
         self.trash_slot = self.max_slots
@@ -236,19 +253,21 @@ class PagedKVCache:
         self.trash_page = self.pool_pages
         self.specs = list(specs)
         arrays = []
-        for _name, kind, shape in specs:
+        for spec in specs:
+            kind, shape = spec[1], spec[2]
+            sdtype = spec[3] if len(spec) > 3 else dtype
             if kind == "page":
                 arrays.append(jnp.zeros(
                     (self.pool_pages + 1, self.page_tokens)
-                    + tuple(shape), dtype))
+                    + tuple(shape), sdtype))
             else:  # slot-indexed (LSTM carries)
                 arrays.append(jnp.zeros(
-                    (self.max_slots + 1,) + tuple(shape), dtype))
+                    (self.max_slots + 1,) + tuple(shape), sdtype))
         self.arrays: tuple = tuple(arrays)
         #: indices (into ``arrays``) of the page pools — the leaves
         #: :meth:`DecodeModel.copy_page` must copy on a COW
-        self.pool_indices = tuple(i for i, (_n, k, _s)
-                                  in enumerate(specs) if k == "page")
+        self.pool_indices = tuple(i for i, s in enumerate(specs)
+                                  if s[1] == "page")
         self.tables = np.full((self.max_slots + 1, self.max_blocks),
                               self.trash_page, np.int32)
         self.ref = np.zeros(self.pool_pages, np.int64)
@@ -522,6 +541,22 @@ class DecodeModel(Logger):
       budget, so the paged arm never wins by spending more memory);
     - ``spec_k`` — compile the speculative-verification family for
       ``spec_k``-token draft windows (0 = off).
+
+    Quantization knobs (round 21):
+
+    - ``kv_quant`` — int8 K/V pages with one f32 scale per
+      (token, head) row (``engine.kv_quant``, default off; paged
+      cache only — the flat A/B arm stays the bitwise greedy-identity
+      baseline).  At a fixed pool byte budget the pool holds roughly
+      ``2 / (1 + 4/Dh)`` × the bf16 arm's tokens;
+    - ``kv_dtype`` — the page pools' dtype when NOT quantizing
+      (default f32; ``"bfloat16"`` is the byte-budget baseline arm the
+      quant benchmark compares lanes against).
+
+    int8-quantized *weight* bundles need no knob: the manifest's
+    ``quant`` record makes :meth:`_gather_weights` keep them int8 in
+    HBM as ``(q, scale)`` operand pairs that every traced body
+    dequantizes on load.
     """
 
     def __init__(self, model, *, max_slots: int = 4,
@@ -530,7 +565,9 @@ class DecodeModel(Logger):
                  paged: bool | None = None,
                  page_tokens: int | None = None,
                  pool_tokens: int | None = None,
-                 spec_k: int = 0) -> None:
+                 spec_k: int = 0,
+                 kv_quant: bool | None = None,
+                 kv_dtype=None) -> None:
         super().__init__()
         from znicz_tpu.export import ExportedModel
         from znicz_tpu.utils.config import root
@@ -546,6 +583,12 @@ class DecodeModel(Logger):
             page_tokens = int(decode_meta.get(
                 "kv_page_tokens",
                 root.common.engine.get("kv_page_tokens", 16)))
+        if kv_quant is None:
+            kv_quant = bool(decode_meta.get(
+                "kv_quant", root.common.engine.get("kv_quant", False)))
+        self.kv_quant = bool(kv_quant) and self.paged
+        self.kv_dtype = np.dtype(kv_dtype if kv_dtype is not None
+                                 else np.float32)
         self.spec_k = int(spec_k)
         if model.kind != "lm":
             raise ValueError(
@@ -591,13 +634,31 @@ class DecodeModel(Logger):
                     "pool_tokens", self.max_slots * self.max_t))
             pool_pages = max(1, int(pool_tokens) // self.page_tokens)
             self.pool_tokens = pool_pages * self.page_tokens
+            specs = []
+            for name, kind, shape in cache_specs:
+                if kind == "attention":
+                    specs.append((name, "page",
+                                  (shape[-2], shape[-1]),
+                                  np.int8 if self.kv_quant
+                                  else self.kv_dtype))
+                else:
+                    specs.append((name, "slot", shape, np.float32))
+            if self.kv_quant:
+                # f32 per-(token, head) scale pools, appended AFTER
+                # every data spec so the plan's aux indices stay
+                # valid; kind "page" → same page ids, COW copies and
+                # trash sink as the int8 rows they scale
+                for op in self._plan:
+                    if op.kind != "attention":
+                        continue
+                    for side in ("k", "v"):
+                        name, _k, shape = cache_specs[op.aux[side]]
+                        op.aux[f"{side}_scale"] = len(specs)
+                        specs.append((f"{name}_scale", "page",
+                                      (shape[-2],), np.float32))
             self.cache = PagedKVCache(
-                [(name, "page" if kind == "attention" else "slot",
-                  (shape[-2], shape[-1]) if kind == "attention"
-                  else shape)
-                 for name, kind, shape in cache_specs],
-                self.max_slots, self.page_tokens, self.max_blocks,
-                pool_pages)
+                specs, self.max_slots, self.page_tokens,
+                self.max_blocks, pool_pages)
         else:
             self.page_tokens = self.max_t
             self.max_blocks = 1
@@ -632,14 +693,30 @@ class DecodeModel(Logger):
     def _gather_weights(self, params: dict) -> tuple:
         """Build the weight operand pytree from a bundle's param dict
         (absent leaves — e.g. a bias the export never carried — stay
-        ``None``, a legal empty pytree node)."""
+        ``None``, a legal empty pytree node).
+
+        Keys the bundle's ``quant`` record covers (round 21) become
+        ``(q int8, scale f32)`` pairs — int8 stays resident in HBM
+        (halved weight bytes per replica) and every traced body
+        dequantizes on load via :func:`_dq_leaves`."""
         import jax.numpy as jnp
+        from znicz_tpu.serving import quantize as _quantize
+        qkeys = getattr(self.model, "_qkeys", frozenset())
         out = []
         for op in self._plan:
-            out.append(tuple(
-                None if key not in params
-                else jnp.asarray(params[key], jnp.float32)
-                for key in op.wkeys))
+            leaves = []
+            for key in op.wkeys:
+                if key not in params:
+                    leaves.append(None)
+                elif key in qkeys:
+                    leaves.append((
+                        jnp.asarray(params[key], jnp.int8),
+                        jnp.asarray(params[_quantize.scale_key(key)],
+                                    jnp.float32)))
+                else:
+                    leaves.append(jnp.asarray(params[key],
+                                              jnp.float32))
+            out.append(tuple(leaves))
         return tuple(out)
 
     def _build_plan(self) -> tuple[list[_Op], list]:
@@ -760,7 +837,7 @@ class DecodeModel(Logger):
             feat = None
             logits = None
             for j, op in enumerate(plan):
-                w = weights[j]
+                w = _dq_leaves(weights[j])
                 if op.kind == "embedding":
                     feat = op.unit.xla_embed(w[0], tokens)
                 elif op.kind == "pos_encoding":
@@ -802,7 +879,7 @@ class DecodeModel(Logger):
             feat = None
             logits = None
             for j, op in enumerate(plan):
-                w = weights[j]
+                w = _dq_leaves(weights[j])
                 if op.kind == "embedding":
                     feat = op.unit.xla_embed(w[0], tokens)[:, None, :]
                 elif op.kind == "pos_encoding":
@@ -860,7 +937,7 @@ class DecodeModel(Logger):
             feat = None
             logits = None
             for j, op in enumerate(plan):
-                w = weights[j]
+                w = _dq_leaves(weights[j])
                 if op.kind == "embedding":
                     feat = op.unit.xla_embed(w[0], tokens)
                 elif op.kind == "pos_encoding":
@@ -868,9 +945,20 @@ class DecodeModel(Logger):
                         op.table, start, t_bucket, axis=0)
                     feat = feat.astype(jnp.float32) + pe[None]
                 elif op.kind == "attention":
-                    feat, kp, vp = op.unit.xla_prefill_paged(
-                        feat, caches[op.aux["k"]], caches[op.aux["v"]],
-                        table, start, length, *w)
+                    ks = op.aux.get("k_scale")
+                    if ks is None:
+                        feat, kp, vp = op.unit.xla_prefill_paged(
+                            feat, caches[op.aux["k"]],
+                            caches[op.aux["v"]], table, start,
+                            length, *w)
+                    else:
+                        vs = op.aux["v_scale"]
+                        (feat, kp, vp, caches[ks],
+                         caches[vs]) = op.unit.xla_prefill_paged(
+                            feat, caches[op.aux["k"]],
+                            caches[op.aux["v"]], table, start,
+                            length, *w, k_scale=caches[ks],
+                            v_scale=caches[vs])
                     caches[op.aux["k"]] = kp
                     caches[op.aux["v"]] = vp
                 elif op.kind == "lstm":
@@ -905,16 +993,27 @@ class DecodeModel(Logger):
             feat = None
             logits = None
             for j, op in enumerate(plan):
-                w = weights[j]
+                w = _dq_leaves(weights[j])
                 if op.kind == "embedding":
                     feat = op.unit.xla_embed(w[0], tokens)[:, None, :]
                 elif op.kind == "pos_encoding":
                     feat = op.unit.xla_decode_step(feat, positions,
                                                    op.table)
                 elif op.kind == "attention":
-                    feat, kp, vp = op.unit.xla_decode_step_paged(
-                        feat, caches[op.aux["k"]], caches[op.aux["v"]],
-                        tables, positions, *w)
+                    ks = op.aux.get("k_scale")
+                    if ks is None:
+                        feat, kp, vp = op.unit.xla_decode_step_paged(
+                            feat, caches[op.aux["k"]],
+                            caches[op.aux["v"]], tables, positions,
+                            *w)
+                    else:
+                        vs = op.aux["v_scale"]
+                        (feat, kp, vp, caches[ks],
+                         caches[vs]) = op.unit.xla_decode_step_paged(
+                            feat, caches[op.aux["k"]],
+                            caches[op.aux["v"]], tables, positions,
+                            *w, k_scale=caches[ks],
+                            v_scale=caches[vs])
                     caches[op.aux["k"]] = kp
                     caches[op.aux["v"]] = vp
                 elif op.kind == "lstm":
@@ -956,7 +1055,7 @@ class DecodeModel(Logger):
             feat = None
             logits = None
             for j, op in enumerate(plan):
-                w = weights[j]
+                w = _dq_leaves(weights[j])
                 if op.kind == "embedding":
                     feat = op.unit.xla_embed(w[0], tokens)
                 elif op.kind == "pos_encoding":
@@ -965,9 +1064,20 @@ class DecodeModel(Logger):
                         op.table.shape[0] - 1)
                     feat = feat.astype(jnp.float32) + op.table[idx]
                 elif op.kind == "attention":
-                    feat, kp, vp = op.unit.xla_window_paged(
-                        feat, caches[op.aux["k"]], caches[op.aux["v"]],
-                        tables, positions, lengths, *w)
+                    ks = op.aux.get("k_scale")
+                    if ks is None:
+                        feat, kp, vp = op.unit.xla_window_paged(
+                            feat, caches[op.aux["k"]],
+                            caches[op.aux["v"]], tables, positions,
+                            lengths, *w)
+                    else:
+                        vs = op.aux["v_scale"]
+                        (feat, kp, vp, caches[ks],
+                         caches[vs]) = op.unit.xla_window_paged(
+                            feat, caches[op.aux["k"]],
+                            caches[op.aux["v"]], tables, positions,
+                            lengths, *w, k_scale=caches[ks],
+                            v_scale=caches[vs])
                     caches[op.aux["k"]] = kp
                     caches[op.aux["v"]] = vp
                 elif op.kind == "last_token":
@@ -1014,12 +1124,15 @@ class DecodeModel(Logger):
 
     def _weight_structs(self) -> tuple:
         import jax
-        return tuple(tuple(
-            None if a is None
-            else jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                      sharding=getattr(a, "sharding",
-                                                       None))
-            for a in ws) for ws in self._weights)
+
+        def struct(a):
+            if isinstance(a, tuple):  # (q int8, scale f32) pair
+                return tuple(struct(x) for x in a)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                        sharding=getattr(a, "sharding",
+                                                         None))
+        return tuple(tuple(None if a is None else struct(a)
+                           for a in ws) for ws in self._weights)
 
     def prefill_program(self, t_bucket: int):
         """The AOT prefill program for one prompt-length bucket
@@ -1329,11 +1442,13 @@ class DecodeModel(Logger):
                 if new is None:
                     raise SwapIncompatible(
                         f"candidate is missing parameter '{key}'")
-                if tuple(np.shape(new)) != tuple(cur.shape):
+                shape = tuple((cur[0] if isinstance(cur, tuple)
+                               else cur).shape)
+                if tuple(np.shape(new)) != shape:
                     raise SwapIncompatible(
                         f"{key}: candidate shape "
                         f"{tuple(np.shape(new))} != compiled "
-                        f"{tuple(cur.shape)}")
+                        f"{shape}")
 
     def swap_weights(self, params: dict,
                      manifest: dict | None = None) -> int:
@@ -1344,6 +1459,27 @@ class DecodeModel(Logger):
         guarantees no decode step is mid-flight when the flip lands —
         slots carrying old-model generations drain first."""
         import jax
+        from znicz_tpu.export import SwapIncompatible
+        from znicz_tpu.serving import quantize as _quantize
+        qkeys = getattr(self.model, "_qkeys", frozenset())
+        cand_rec = _quantize.is_quantized(manifest)
+        if qkeys:
+            if cand_rec is None:
+                raise SwapIncompatible(
+                    "candidate is f32 but the decode chain compiled "
+                    "int8 dequantize-on-load programs — republish "
+                    "the candidate with quantize='int8' (or restart "
+                    "the replica f32)")
+            if frozenset(cand_rec.get("weights", [])) != qkeys:
+                raise SwapIncompatible(
+                    "candidate quantizes a different key set than "
+                    "the compiled programs "
+                    f"({sorted(cand_rec.get('weights', []))} != "
+                    f"{sorted(qkeys)})")
+        elif cand_rec is not None:
+            # quantized candidate into an f32-compiled chain:
+            # dequantize host-side and stage f32 — recompile-free
+            params = _quantize.dequantize_params(manifest, params)
         self.check_compatible(manifest, params)
         staged = []
         for op, ws in zip(self._plan, self._weights):
@@ -1352,16 +1488,28 @@ class DecodeModel(Logger):
                 if cur is None:
                     new_ws.append(None)
                     continue
-                new = np.asarray(params[key], np.float32)
-                sharding = getattr(cur, "sharding", None)
-                arr = (jax.device_put(new, sharding)
-                       if sharding is not None else jax.device_put(new))
+                if isinstance(cur, tuple):  # int8 (q, scale) operand
+                    skey = _quantize.scale_key(key)
+                    q = np.asarray(params[key], np.int8)
+                    s = np.asarray(params[skey], np.float32)
+                    arr = (jax.device_put(q), jax.device_put(s))
+                    self.model._params[key] = q
+                    self.model._params[skey] = s
+                else:
+                    new = np.asarray(params[key], np.float32)
+                    sharding = getattr(cur, "sharding", None)
+                    arr = (jax.device_put(new, sharding)
+                           if sharding is not None
+                           else jax.device_put(new))
+                    self.model._params[key] = new
                 new_ws.append(arr)
-                self.model._params[key] = new
             staged.append(tuple(new_ws))
         for ws in staged:  # fence before publishing
-            for a in ws:
-                if a is not None:
+            for leaf in ws:
+                if leaf is None:
+                    continue
+                for a in (leaf if isinstance(leaf, tuple)
+                          else (leaf,)):
                     a.block_until_ready()
         self._weights = tuple(staged)
         self.weights_version += 1
@@ -1465,6 +1613,8 @@ class DecodeEngine(Logger):
                  drafter=None,
                  max_queue_tokens: int | None = None,
                  max_queue_age_ms: float = 10_000.0,
+                 kv_quant: bool | None = None,
+                 kv_dtype=None,
                  device=None) -> None:
         super().__init__()
         from znicz_tpu.serving.batcher import TokenBudget
@@ -1494,7 +1644,8 @@ class DecodeEngine(Logger):
                                 device=device, paged=paged,
                                 page_tokens=page_tokens,
                                 pool_tokens=pool_tokens,
-                                spec_k=int(spec_draft_k or 0))
+                                spec_k=int(spec_draft_k or 0),
+                                kv_quant=kv_quant, kv_dtype=kv_dtype)
         self.model = model
         self.spec_k = int(model.spec_k)
         # the drafter: a SMALL published bundle (population-trained)
@@ -1577,6 +1728,11 @@ class DecodeEngine(Logger):
                 model.cache.pool_pages)
             _metrics.kv_pages_used(self._obs_id).set_function(
                 model.cache.pages_used)
+        # round 21: KV bytes amortized per concurrent lane — the
+        # number int8 pages halve at fixed geometry (cache geometry
+        # is fixed at construction, so one set() suffices)
+        _metrics.kv_bytes_per_lane(self._obs_id).set(
+            model.cache.nbytes() / max(1, model.max_slots))
         self._m_prefix_hit = _metrics.prefix_cache_events(
             self._obs_id, "hit")
         self._m_prefix_miss = _metrics.prefix_cache_events(
@@ -2563,6 +2719,17 @@ class DecodeEngine(Logger):
             + (self.drafter.programs_live if self.drafter else 0),
             "warmup_seconds": round(self.warmup_seconds, 3),
             "cache_bytes": self.model.cache.nbytes(),
+            "kv_bytes_per_lane": self.model.cache.nbytes()
+            // max(1, self.model.max_slots),
+            "quant": ({
+                "weights": ("int8" if getattr(self.model.model,
+                                              "_qkeys", None)
+                            else "f32"),
+                "kv_pages": ("int8" if self.model.kv_quant
+                             else str(self.model.kv_dtype)),
+            } if (self.model.kv_quant
+                  or getattr(self.model.model, "_qkeys", None))
+                else None),
             "submitted": int(self._m_submitted.value),
             "served": int(self._m_served.value),
             "rejected": int(self._m_rejected.value),

@@ -214,7 +214,13 @@ class SharedLadderBudget:
     least-recently-used program of the LOWEST-priority attached model
     (largest priority number) — never the program just charged — so
     HBM pressure degrades the cheapest tenant's ladder first instead
-    of failing allocation or touching a premium ladder."""
+    of failing allocation or touching a premium ladder.
+
+    Registration also charges each model's RESIDENT WEIGHT bytes
+    (round 21: :meth:`~znicz_tpu.export.ExportedModel.weights_nbytes`)
+    against ``max_bytes`` as a protected, never-evictable entry —
+    an int8-quantized bundle at ~0.5× the f32 bytes visibly raises
+    how many ladder programs fit in the same budget."""
 
     def __init__(self, max_programs: int | None = None,
                  max_bytes: int | None = None,
@@ -229,11 +235,16 @@ class SharedLadderBudget:
         self._models: dict[str, tuple] = {}
         #: (key, size) -> nbytes, LRU order (oldest first)
         self._entries: "OrderedDict[tuple, int]" = OrderedDict()
+        #: key -> resident weight bytes (protected — never a victim)
+        self._weights: dict[str, int] = {}
         self.evictions = 0
 
     def register(self, key: str, model, priority: int) -> None:
         with self._lock:
             self._models[str(key)] = (model, int(priority))
+            nbytes = getattr(model, "weights_nbytes", None)
+            self._weights[str(key)] = (int(nbytes())
+                                       if callable(nbytes) else 0)
 
     def touch(self, key: str, size: int) -> None:
         with self._lock:
@@ -247,7 +258,8 @@ class SharedLadderBudget:
     @property
     def bytes_used(self) -> int:
         with self._lock:
-            return sum(self._entries.values())
+            return (sum(self._entries.values())
+                    + sum(self._weights.values()))
 
     @property
     def programs(self) -> int:
@@ -258,7 +270,8 @@ class SharedLadderBudget:
                 and len(self._entries) > self.max_programs:
             return True
         return (self.max_bytes is not None
-                and sum(self._entries.values()) > self.max_bytes)
+                and sum(self._entries.values())
+                + sum(self._weights.values()) > self.max_bytes)
 
     def _pick_victim(self, protect: tuple) -> tuple | None:
         """LRU entry of the lowest-priority model, skipping the entry
@@ -299,7 +312,10 @@ class SharedLadderBudget:
             for key, _size in self._entries:
                 per_model[key] = per_model.get(key, 0) + 1
             return {"programs": len(self._entries),
-                    "bytes": sum(self._entries.values()),
+                    "bytes": (sum(self._entries.values())
+                              + sum(self._weights.values())),
+                    "program_bytes": sum(self._entries.values()),
+                    "weight_bytes": dict(self._weights),
                     "max_programs": self.max_programs,
                     "max_bytes": self.max_bytes,
                     "evictions": self.evictions,
@@ -436,16 +452,17 @@ class _Version:
     """One traffic-weighted version of a fleet model."""
 
     __slots__ = ("label", "weight", "current", "group", "model",
-                 "source")
+                 "source", "quant")
 
     def __init__(self, label: str, weight: float, group: ReplicaGroup,
-                 model, source) -> None:
+                 model, source, quant: bool = False) -> None:
         self.label = label
         self.weight = float(weight)
         self.current = 0.0  # smooth weighted round-robin credit
         self.group = group
         self.model = model  # shared ExportedModel (one-shot) or None
         self.source = source
+        self.quant = bool(quant)  # bundle carries an int8 quant record
 
 
 class _FleetModel:
@@ -600,6 +617,7 @@ class FleetEngine(Logger):
             self._m_models.set(len(self._models))
         _metrics.fleet_traffic_weight(self._obs_id, model_id,
                                       version).set(weight)
+        self._refresh_quant_gauge()
         if self._started:
             entry[1].group.scale_to(replicas, reason="up")
 
@@ -621,8 +639,17 @@ class FleetEngine(Logger):
             model.versions[version] = entry[1]
         _metrics.fleet_traffic_weight(self._obs_id, model_id,
                                       version).set(weight)
+        self._refresh_quant_gauge()
         if self._started:
             entry[1].group.scale_to(replicas, reason="up")
+
+    def _refresh_quant_gauge(self) -> None:
+        """``znicz_quantized_models``: int8-quantized model versions
+        currently registered (round 21)."""
+        with self._lock:
+            n = sum(1 for m in self._models.values()
+                    for v in m.versions.values() if v.quant)
+        _metrics.quantized_models(self._obs_id).set(n)
 
     def _build_version(self, model_id: str, source, kind: str | None,
                        version: str, weight: float, priority: int,
@@ -679,7 +706,9 @@ class FleetEngine(Logger):
         group = ReplicaGroup(self._obs_id, model_id, version, factory,
                              target=replicas, max_replicas=cap)
         return kind, _Version(version, weight, group, shared_model,
-                              source), input_shape
+                              source,
+                              quant=bool(manifest.get("quant"))
+                              ), input_shape
 
     def set_traffic(self, model_id: str,
                     weights: dict[str, float]) -> None:
@@ -957,6 +986,7 @@ class FleetEngine(Logger):
                     "weight": v.weight,
                     "replicas": v.group.live(),
                     "target": v.group.target,
+                    "quant": v.quant,
                     "served": sum(
                         int(e.stats().get("served", 0))
                         for e in v.group.engines()),
