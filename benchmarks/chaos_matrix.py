@@ -158,6 +158,7 @@ def _streaming_workflow(name: str, tmp: str):
 
 
 _SERVE_BUNDLE: str | None = None
+_LM_BUNDLE: str | None = None
 _PUB_WF = None
 
 
@@ -171,6 +172,19 @@ def _serve_bundle() -> str:
                             "model.npz")
         _SERVE_BUNDLE = train_and_export(path, epochs=1)
     return _SERVE_BUNDLE
+
+
+def _lm_bundle() -> str:
+    """One shared tiny exported LM for the disaggregated-serving
+    drill (vocab 12 — serve_bench's decode smoke model)."""
+    global _LM_BUNDLE
+    if _LM_BUNDLE is None:
+        from benchmarks.serve_bench import train_and_export_lm
+        path = os.path.join(tempfile.mkdtemp(prefix="chaosm_"),
+                            "lm.npz")
+        train_and_export_lm(path, vocab=12, epochs=2)
+        _LM_BUNDLE = path
+    return _LM_BUNDLE
 
 
 def _pub_workflow():
@@ -315,6 +329,28 @@ def drill_serving_latency_spike() -> dict:
     assert d[0] == 1 and out.shape[0] == 2, d[0]
     assert took >= 0.03, f"spike not observed ({took * 1e3:.1f} ms)"
     return {"injected": d[0], "latency_s": round(took, 3)}
+
+
+def drill_disagg_handoff_drop() -> dict:
+    from znicz_tpu.serving import DisaggEngine
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "disagg.handoff_drop"}),
+                ("znicz_recoveries_total", {"kind": "handoff_retry"}))
+    _recipe({"disagg.handoff_drop": {"at": [1]}})
+    with DisaggEngine(_lm_bundle(), max_slots=2, max_t=32,
+                      max_prompt=16, max_new_tokens=4,
+                      page_tokens=8) as eng:
+        prompt = np.random.default_rng(2).integers(
+            0, 12, size=10).astype(np.int32)
+        out = eng.generate(prompt, timeout=60)
+        assert len(out) >= 1, "retried request produced no tokens"
+        st = eng.stats()
+    assert d[0] == 1 and d[1] >= 1, (d[0], d[1])
+    assert st["handoffs"]["dropped"] == 1, st["handoffs"]
+    assert st["handoffs"]["retried"] == 1, st["handoffs"]
+    assert eng.balanced(), "token budget unbalanced after retry"
+    return {"injected": d[0], "handoff_retries": d[1],
+            "balanced": True}
 
 
 def drill_sdc_serving_bitflip() -> dict:
@@ -703,6 +739,7 @@ DRILLS = {
     "swap.canary_regress": drill_swap_canary_regress,
     "swap.probation_fail": drill_swap_probation_fail,
     "quant.calib_corrupt": drill_quant_calib_corrupt,
+    "disagg.handoff_drop": drill_disagg_handoff_drop,
     "fleet.tenant_flood": drill_fleet_tenant_flood,
     "fleet.model_corrupt": drill_fleet_model_corrupt,
     "fleet.replica_loss": drill_fleet_replica_loss,

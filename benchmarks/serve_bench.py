@@ -575,6 +575,176 @@ def run_decode(n_prompts: int | None = None, rate: float | None = None,
     return report
 
 
+def run_disagg() -> dict:
+    """Round-22 A/B: the fused engine vs disaggregated prefill/decode
+    pools, two arms, all greedy and token-identical.
+
+    **Interference arm** — long steady decodes take a mid-stream
+    prefill burst.  In the fused engine the admission wave runs each
+    prefill ON the scheduler thread between token steps, so every
+    burst prompt inserts its full prefill latency into the token
+    cadence; in the disaggregated engine the burst lands on the
+    prefill pool and reaches decode only as a page-table handoff.
+    Measured as per-pass ``token_ms`` p99 slices, burst/baseline pass
+    pairs, median of 3 — the bar: disagg decode p99 moves ≤ 1.1×
+    under the burst.  CPU-container caveat: the pools time-share ONE
+    core here, so the disagg arm still pays scheduler contention the
+    real deployment doesn't — chip truth is the DISAGG_TPU=1 row
+    (CHIP_QUEUE.md), where the pools hold separate chips.
+
+    **Spill arm** — a prefix working set ≥ 4× the HBM page pool
+    served through the host-DRAM tier (spill → staging-ring restore)
+    vs an all-HBM pool big enough to pin everything.  Bars: hit rate
+    within 10% of all-HBM, restores actually exercised, tokens
+    bitwise-identical."""
+    import jax
+
+    from znicz_tpu.observe import metrics as obs_metrics
+    from znicz_tpu.serving import DecodeEngine, DisaggEngine
+    from znicz_tpu.serving.engine import window_p99
+
+    vocab = 12
+    bundle = os.path.join("/tmp",
+                          f"serve_bench_disagg_{os.getpid()}.npz")
+    train_and_export_lm(bundle, vocab=vocab, epochs=4)
+    rng = np.random.default_rng(67)
+    dec_new = int(os.environ.get("DISAGG_DEC_NEW", "220"))
+    n_dec = int(os.environ.get("DISAGG_DEC_LANES", "2"))
+    burst_n = int(os.environ.get("DISAGG_BURST", "10"))
+    decode_prompts = [rng.integers(0, vocab, size=8).astype(np.int32)
+                      for _ in range(n_dec)]
+    burst_prompts = [rng.integers(0, vocab, size=16).astype(np.int32)
+                     for _ in range(burst_n)]
+    counters = [obs_metrics.xla_compiles(s) for s in
+                ("serving-prefill", "serving-decode",
+                 "serving-verify", "serving-page")]
+    report: dict = {
+        "mode": "disagg",
+        "date": time.strftime("%Y-%m-%d"),
+        "platform": jax.devices()[0].platform,
+        "config": {
+            "decode_lanes": n_dec, "tokens_per_lane": dec_new,
+            "burst_prompts": burst_n,
+            "decoding": "greedy (fused and disagg token-identical)",
+            "protocol": "per-pass token_ms p99 slices; "
+                        "burst/baseline pass pairs, median of 3",
+        },
+    }
+    common = dict(max_slots=4, max_t=256, max_prompt=16,
+                  prompt_align=8, page_tokens=16,
+                  max_new_tokens=dec_new, max_queue_tokens=10 ** 6)
+
+    def token_pass(eng, with_burst):
+        n0 = len(eng._token_win)
+        futs = [eng.submit(p, max_new_tokens=dec_new)
+                for p in decode_prompts]
+        bouts = []
+        if with_burst:
+            time.sleep(0.25)  # burst lands mid-stream
+            bf = [eng.submit(b, max_new_tokens=1)
+                  for b in burst_prompts]
+        outs = [list(f.result(timeout=900)) for f in futs]
+        if with_burst:
+            bouts = [list(f.result(timeout=900)) for f in bf]
+        return (round(1e3 * window_p99(eng._token_win, n0), 3),
+                outs, bouts)
+
+    def measure(name, eng):
+        token_pass(eng, True)          # cold: warm every bucket
+        warmed = sum(c.value for c in counters)
+        pairs, outs_ref, bursts_ref = [], None, None
+        for _ in range(3):
+            base_p99, outs, _nb = token_pass(eng, False)
+            burst_p99, outs2, bouts = token_pass(eng, True)
+            pairs.append({"baseline_p99_ms": base_p99,
+                          "burst_p99_ms": burst_p99,
+                          "ratio": round(burst_p99
+                                         / max(base_p99, 1e-9), 3)})
+            if outs_ref is None:
+                outs_ref, bursts_ref = outs, bouts
+            assert outs == outs2, f"{name}: burst changed tokens"
+        pairs.sort(key=lambda r: r["ratio"])
+        row = {"arm": name, "pairs": pairs,
+               "decode_p99_ratio": pairs[1]["ratio"],
+               "warmed_compile_delta": int(
+                   sum(c.value for c in counters) - warmed)}
+        assert row["warmed_compile_delta"] == 0, row
+        return row, outs_ref, bursts_ref
+
+    with DecodeEngine(bundle, **common) as eng:
+        fused_row, fused_outs, fused_bursts = measure("fused", eng)
+    with DisaggEngine(bundle, **common) as eng:
+        # one re-measure round allowed (run_paged protocol): ~0.3 ms
+        # token steps make the p99 slice jittery on a shared host
+        for _attempt in range(2):
+            dis_row, dis_outs, dis_bursts = measure("disagg", eng)
+            if dis_row["decode_p99_ratio"] <= 1.1:
+                break
+        dis_row["handoffs"] = eng.stats()["handoffs"]
+    assert dis_outs == fused_outs and dis_bursts == fused_bursts, \
+        "disaggregation changed tokens"
+    report["interference"] = {
+        "fused": fused_row, "disagg": dis_row,
+        "outputs_checked": "token-identical across arms (greedy)",
+    }
+    assert dis_row["decode_p99_ratio"] <= 1.1, (
+        f"disagg decode p99 moved {dis_row['decode_p99_ratio']}x "
+        f"under the prefill burst — the round-22 bar is 1.1x")
+
+    # ---- spill arm: working set ≥ 4× HBM, host-tier hit parity ----
+    n_fam = int(os.environ.get("DISAGG_SPILL_FAMILIES", "40"))
+    families = [rng.integers(0, vocab, size=16).astype(np.int32)
+                for _ in range(n_fam)]
+    prompts = []
+    for _ in range(2):  # sweep 2 re-matches what sweep 1 spilled
+        for f in families:
+            prompts.append(np.concatenate(
+                [f, rng.integers(0, vocab, size=4).astype(np.int32)]))
+    spill_common = dict(max_slots=2, max_t=32, max_prompt=24,
+                        prompt_align=4, max_new_tokens=4,
+                        page_tokens=8)
+    arms = {}
+    for name, kw in (("all_hbm", dict(pool_tokens=4096)),
+                     ("spill", dict(pool_tokens=160,
+                                    spill_pages=2 * n_fam + 16))):
+        with DecodeEngine(bundle, **spill_common, **kw) as eng:
+            warmed = sum(c.value for c in counters)
+            outs = [list(eng.generate(p, timeout=600))
+                    for p in prompts]
+            st = eng.stats()["prefix_cache"]
+            pool_pages = eng.model.cache.pool_pages
+        arms[name] = {
+            "arm": name, "outs": outs, "pool_pages": pool_pages,
+            "hits": st["hits"], "misses": st["misses"],
+            "hit_rate": round(st["hits"]
+                              / max(st["hits"] + st["misses"], 1), 4),
+            "migrations": st.get("migrations"),
+            "warmed_compile_delta": int(
+                sum(c.value for c in counters) - warmed),
+        }
+    hbm_arm, spill_arm = arms["all_hbm"], arms["spill"]
+    assert spill_arm["outs"] == hbm_arm["outs"], \
+        "the spill tier changed tokens"
+    working_pages = 2 * n_fam
+    spill_arm["working_set_over_hbm"] = round(
+        working_pages / spill_arm["pool_pages"], 2)
+    assert spill_arm["working_set_over_hbm"] >= 4.0
+    assert spill_arm["migrations"]["restore"] > 0, spill_arm
+    assert spill_arm["hit_rate"] >= 0.9 * hbm_arm["hit_rate"], \
+        (spill_arm["hit_rate"], hbm_arm["hit_rate"])
+    for arm in arms.values():
+        del arm["outs"]
+    report["spill"] = {
+        "all_hbm": hbm_arm, "spill": spill_arm,
+        "outputs_checked": "token-identical across arms (greedy)",
+    }
+    report["chip_arm"] = ("queued — set DISAGG_TPU=1 on a multi-chip "
+                          "container (CHIP_QUEUE.md): pools on "
+                          "separate chips, host-DRAM tier behind the "
+                          "real HBM")
+    return report
+
+
 def republish(src_bundle: str, directory: str,
               prefix: str = "model") -> tuple[int, str]:
     """Publish an existing bundle file as the next monotonic version
@@ -959,9 +1129,10 @@ def main() -> None:
     decode_only = "--decode" in sys.argv or mode == "decode"
     swap_only = "--swap" in sys.argv or mode == "swap"
     paged_only = "--paged" in sys.argv or mode == "paged"
+    disagg_only = "--disagg" in sys.argv or mode == "disagg"
     score_only = mode == "score"
     out = os.path.join(REPO, "SERVE_BENCH.json")
-    if swap_only or paged_only:
+    if swap_only or paged_only or disagg_only:
         # merge: refresh only this mode's rows
         report = {}
         if os.path.exists(out):
@@ -969,6 +1140,8 @@ def main() -> None:
                 report = json.load(f)
         if swap_only:
             report["swap_soak"] = run_swap_soak()
+        elif disagg_only:
+            report["disagg"] = run_disagg()
         else:
             report["paged"] = run_paged()
     else:

@@ -1,0 +1,311 @@
+"""Disaggregated serving data plane (round 22).
+
+Prefill and decode run in separate replica pools over private paged
+caches, connected by a page-table handoff (DistServe/Splitwise); cold
+prefix-cache pages spill HBM→host DRAM and restore through the pinned
+staging ring.  The bars, all oracle-anchored:
+
+- token IDENTITY: disagg ≡ fused ≡ step-by-step numpy oracle, bitwise
+  on token ids — pools may only move bytes, never change a token;
+- exactly-once accounting: every page refcount equals its holders and
+  every token-budget reservation is released exactly once across
+  submit → prefill → handoff → decode → spill/restore/COW/eviction,
+  including under seeded handoff-drop chaos;
+- compile-free scale: growing a pool warms ZERO new XLA programs (the
+  replicas share one warmed DecodeModel).
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+from tests.test_paged_decode import VOCAB, _params, oracle_greedy
+from znicz_tpu.observe import metrics as obs_metrics
+from znicz_tpu.resilience.faults import FaultInjected
+from znicz_tpu.serving import DecodeEngine, DisaggEngine
+from znicz_tpu.utils.config import root
+
+
+@pytest.fixture(scope="module")
+def lm_bundle(tmp_path_factory):
+    from benchmarks.serve_bench import train_and_export_lm
+    path = str(tmp_path_factory.mktemp("disagg") / "lm.npz")
+    return train_and_export_lm(path, vocab=VOCAB, epochs=3)
+
+
+@pytest.fixture()
+def chaos_recipe():
+    """Set a fault recipe for one test; always clear it after."""
+    def set_recipe(recipe):
+        root.common.engine.faults = recipe
+    yield set_recipe
+    root.common.engine.faults = None
+
+
+def _assert_tiered_accounting(cache, prefix, tier):
+    """The hierarchical exactly-once invariant: every pool page's
+    refcount equals its holders (slot tables + page-resident trie
+    pins), every trie block lives in EXACTLY one tier (device page
+    XOR host frame), the free list holds no referenced page, and the
+    host tier's occupancy equals the spilled node count."""
+    free = cache._free_pages
+    assert len(set(free)) == len(free), "double-freed page"
+    refs = np.zeros(cache.pool_pages, np.int64)
+    for slot in range(cache.max_slots):
+        for pid in cache.tables[slot]:
+            if int(pid) != cache.trash_page:
+                refs[int(pid)] += 1
+    hosted = 0
+    stack = list(prefix.root.children.values()) if prefix else []
+    while stack:
+        node = stack.pop()
+        assert (node.page is None) != (node.host is None), \
+            "trie block in zero or two tiers"
+        if node.page is not None:
+            refs[node.page] += 1
+        else:
+            hosted += 1
+        stack.extend(node.children.values())
+    assert np.array_equal(refs, cache.ref), (refs, cache.ref)
+    assert all(int(cache.ref[p]) == 0 for p in free)
+    if tier is not None:
+        assert hosted == tier.used, (hosted, tier.used)
+    else:
+        assert hosted == 0
+
+
+def _assert_engine_drained(eng):
+    """After every future resolved: slots and non-trie pages returned
+    in BOTH pools, reservations balanced."""
+    assert eng.balanced(), "token budget unbalanced"
+    for w in eng.prefill_pool.engines():
+        assert w.cache.free_slots == w.cache.max_slots
+        _assert_tiered_accounting(w.cache, w.prefix, w._spill)
+    for w in eng.decode_pool.engines():
+        assert w.cache.free_slots == w.cache.max_slots
+        assert w.cache.pages_used() == 0, "decode pages leaked"
+
+
+# ----------------------------------------------------------------------
+# the core contract: oracle-exact through the handoff
+# ----------------------------------------------------------------------
+def test_disagg_serves_oracle_exact_with_handoffs(lm_bundle):
+    """Concurrent ragged prompts through prefill-pool → handoff →
+    decode-pool come back oracle-exact, every prompt crosses the
+    handoff exactly once, and both pools drain clean."""
+    man, P = _params(lm_bundle)
+    rng = np.random.default_rng(23)
+    prompts = [rng.integers(0, VOCAB, size=int(n)).astype(np.int32)
+               for n in rng.integers(2, 13, size=5)]
+    with DisaggEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=6,
+                      page_tokens=8) as eng:
+        futs = [eng.submit(p) for p in prompts]
+        results = [list(f.result(timeout=240)) for f in futs]
+        st = eng.stats()
+        _assert_engine_drained(eng)
+        # per-pool queue-age gauges registered under this engine
+        fam = obs_metrics.REGISTRY.get("znicz_serving_queue_age_seconds")
+        pools = {k[1] for k, _c in fam.items() if k[0] == eng._obs_id}
+        assert pools == {"prefill", "decode"}, pools
+    for i, (p, got) in enumerate(zip(prompts, results)):
+        assert got == oracle_greedy(man, P, p, 6), f"prompt {i}"
+    assert st["engine"] == "decode-disagg"
+    assert st["handoffs"]["total"] == len(prompts), st["handoffs"]
+    assert st["handoffs"]["pages_moved"] >= len(prompts)
+    assert st["served"] == len(prompts) and st["rejected"] == 0
+
+
+@pytest.mark.slow
+def test_disagg_token_identity_vs_fused_and_compile_free_scale(
+        lm_bundle):
+    """The interference-free claim's correctness half: the fused
+    engine and the disaggregated engine emit BITWISE-identical greedy
+    tokens over the same ragged mix; then the decode pool scales up
+    mid-flight warming ZERO new XLA programs (replicas share one
+    warmed DecodeModel) and the grown pool still matches."""
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(0, VOCAB, size=int(n)).astype(np.int32)
+               for n in rng.integers(1, 14, size=10)]
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=8,
+                      page_tokens=8) as eng:
+        fused = [list(eng.generate(p, timeout=240)) for p in prompts]
+    with DisaggEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=8, page_tokens=8,
+                      decode_replicas=2) as eng:
+        futs = [eng.submit(p) for p in prompts]
+        got = [list(f.result(timeout=240)) for f in futs]
+        assert got == fused, "disaggregation changed tokens"
+        compiled = eng.stats()["programs_compiled"]
+        eng.decode_pool.scale_to(3, "test")
+        eng.prefill_pool.scale_to(2, "test")
+        futs = [eng.submit(p) for p in prompts]
+        regen = [list(f.result(timeout=240)) for f in futs]
+        assert regen == fused, "scale-up changed tokens"
+        assert eng.stats()["programs_compiled"] == compiled, \
+            "pool scale-up compiled a new program"
+        assert eng.stats()["pools"]["decode"]["live"] == 3
+        _assert_engine_drained(eng)
+
+
+# ----------------------------------------------------------------------
+# handoff-drop chaos: retry on a fresh prefill, exactly-once budget
+# ----------------------------------------------------------------------
+def test_handoff_drop_retried_on_fresh_prefill(lm_bundle,
+                                               chaos_recipe):
+    """A dropped handoff re-queues the request at the FRONT with its
+    reservation kept; the fresh prefill (a prefix hit — the trie kept
+    the blocks) hands off again and the tokens are unchanged."""
+    man, P = _params(lm_bundle)
+    chaos_recipe({"disagg.handoff_drop": {"at": [1]}})
+    prompt = np.arange(10, dtype=np.int32) % VOCAB
+    with DisaggEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=5,
+                      page_tokens=8) as eng:
+        got = list(eng.generate(prompt, timeout=240))
+        st = eng.stats()
+        _assert_engine_drained(eng)
+    assert got == oracle_greedy(man, P, prompt, 5), \
+        "the retried request changed tokens"
+    assert st["handoffs"] == {"total": 1, "dropped": 1, "retried": 1,
+                              "pages_moved": st["handoffs"]
+                              ["pages_moved"]}
+    assert st["served"] == 1 and st["rejected"] == 0
+    assert st["prefix_cache"]["hits"] >= 1, \
+        "the retry re-computed what the trie already held"
+
+
+def test_handoff_drop_past_budget_rejects_balanced(lm_bundle,
+                                                   chaos_recipe):
+    """Every retry dropped too: the request fails with FaultInjected,
+    the reservation is released exactly once, and both pools come
+    back clean — no page leaked across the dropped transfers."""
+    chaos_recipe({"disagg.handoff_drop": {"after": 1}})  # persistent
+    with DisaggEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=5, page_tokens=8,
+                      handoff_retry_budget=1) as eng:
+        fut = eng.submit(np.arange(6, dtype=np.int32) % VOCAB)
+        with pytest.raises(FaultInjected, match="retry budget"):
+            fut.result(timeout=240)
+        st = eng.stats()
+        _assert_engine_drained(eng)
+    assert st["handoffs"]["dropped"] == 2  # first + the one retry
+    assert st["handoffs"]["retried"] == 1
+    assert st["rejected"] == 1 and st["served"] == 0
+
+
+# ----------------------------------------------------------------------
+# hierarchical prefix cache: spill → restore, exactly-once
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+def test_spill_restore_exactly_once_token_identical(lm_bundle):
+    """Working set >> HBM pool: cold trie pages spill to the host
+    tier and restore through the staging ring on re-match.  The spill
+    arm must match the all-HBM arm's hit count AND tokens bitwise,
+    with restores actually exercised and the tiered accounting exact
+    at every checkpoint."""
+    rng = np.random.default_rng(41)
+    families = [rng.integers(0, VOCAB, size=16).astype(np.int32)
+                for _ in range(12)]
+    prompts = []
+    for _ in range(2):  # two sweeps: sweep 2 re-matches spilled pages
+        for f in families:
+            prompts.append(np.concatenate(
+                [f, rng.integers(0, VOCAB, size=4).astype(np.int32)]))
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=24,
+                      prompt_align=4, max_new_tokens=4, page_tokens=8,
+                      pool_tokens=2048) as eng:
+        base = [list(eng.generate(p, timeout=240)) for p in prompts]
+        hbm = eng.stats()["prefix_cache"]
+    with DecodeEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=24,
+                      prompt_align=4, max_new_tokens=4, page_tokens=8,
+                      pool_tokens=160, spill_pages=64) as eng:
+        got = []
+        for p in prompts:
+            got.append(list(eng.generate(p, timeout=240)))
+            _assert_tiered_accounting(eng.model.cache, eng.prefix,
+                                      eng._spill)
+        st = eng.stats()["prefix_cache"]
+        # the spill→restore cycle moved real pages both ways
+        assert st["migrations"]["spill"] > 0, st
+        assert st["migrations"]["restore"] > 0, st
+        assert st["spill_pages_used"] == st["spilled_nodes"]
+        # capacity math: the 20-page pool alone could never pin the
+        # 12-family × 2-block working set the hierarchy served
+        assert eng.model.cache.pool_pages < 2 * len(families)
+        # hit parity: spilling must not cost matches (the bar is
+        # equality here; the ISSUE tolerance is 10%)
+        assert st["hits"] == hbm["hits"], (st, hbm)
+        # a hierarchical clear (the swap path) empties BOTH tiers
+        eng.prefix.clear(eng.model.cache, tier=eng._spill)
+        assert eng.model.cache.pages_used() == 0
+        assert eng._spill.used == 0
+    assert got == base, "the spill tier changed tokens"
+
+
+@pytest.mark.slow
+def test_disagg_spill_cow_eviction_chaos_accounting(lm_bundle,
+                                                    chaos_recipe):
+    """The full gauntlet on one engine: prefix sharing with COW
+    forks, pool pressure driving spill AND eviction, handoff-drop
+    chaos mid-stream — every request oracle-exact, every page and
+    every reservation accounted exactly once when the dust settles."""
+    man, P = _params(lm_bundle)
+    chaos_recipe({"disagg.handoff_drop": {"at": [2, 5]}})
+    rng = np.random.default_rng(53)
+    families = [rng.integers(0, VOCAB, size=16).astype(np.int32)
+                for _ in range(6)]
+    prompts = []
+    for _ in range(2):
+        for f in families:
+            fork = f.copy()
+            fork[12:] = (fork[12:] + 1) % VOCAB  # COW off block 1
+            prompts.extend([f, fork])
+    with DisaggEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=4, page_tokens=8,
+                      pool_tokens=128, spill_pages=8,
+                      handoff_retry_budget=2) as eng:
+        results = [list(eng.generate(p, timeout=240)) for p in prompts]
+        st = eng.stats()
+        _assert_engine_drained(eng)
+    for i, (p, got) in enumerate(zip(prompts, results)):
+        assert got == oracle_greedy(man, P, p, 4), f"prompt {i}"
+    assert st["handoffs"]["dropped"] == 2
+    assert st["handoffs"]["retried"] == 2
+    assert st["served"] == len(prompts) and st["rejected"] == 0
+    pc = st["prefix_cache"]
+    assert pc["migrations"]["spill"] > 0, pc
+    assert pc["hits"] > 0, pc
+
+
+# ----------------------------------------------------------------------
+# per-pool autoscaling: repair + growth from queue age
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+def test_pool_autoscaler_repairs_dead_decode_replica(lm_bundle):
+    """A decode replica dies mid-service: the PoolAutoscaler's repair
+    pass respawns it (compile-free — shared warmed model) and traffic
+    keeps serving oracle-exact."""
+    man, P = _params(lm_bundle)
+    prompt = np.arange(8, dtype=np.int32) % VOCAB
+    with DisaggEngine(lm_bundle, max_slots=2, max_t=32, max_prompt=16,
+                      prompt_align=4, max_new_tokens=5, page_tokens=8,
+                      decode_replicas=2, autoscale=True) as eng:
+        assert list(eng.generate(prompt, timeout=240)) \
+            == oracle_greedy(man, P, prompt, 5)
+        compiled = eng.stats()["programs_compiled"]
+        eng.decode_pool.kill_one()
+        deadline = time.monotonic() + 20
+        while eng.decode_pool.live() < 2 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert eng.decode_pool.live() == 2, "repair never happened"
+        assert eng.stats()["programs_compiled"] == compiled, \
+            "the respawned replica compiled"
+        assert list(eng.generate(prompt, timeout=240)) \
+            == oracle_greedy(man, P, prompt, 5)
+        _assert_engine_drained(eng)

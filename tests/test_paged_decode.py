@@ -295,6 +295,7 @@ def test_pool_exhaustion_sheds_then_recovers(lm_bundle):
     assert shed > 0, "pool pressure never shed a prompt"
 
 
+@pytest.mark.slow
 def test_oversized_request_fails_cleanly(lm_bundle):
     """A request whose worst-case span needs more pages than the
     whole pool fails its own future with PoolExhausted — no hang, no
@@ -336,6 +337,7 @@ def _assert_page_accounting(eng):
     assert all(int(cache.ref[p]) == 0 for p in free)
 
 
+@pytest.mark.slow
 def test_matched_pages_survive_own_eviction_pressure(lm_bundle):
     """Regression for the admission ordering race: when pool pressure
     makes the request's OWN just-matched trie leaves the eviction
@@ -402,6 +404,7 @@ def test_spec_greedy_token_identical(lm_bundle, drafter_bundle):
     assert spec["accepted"] + spec["rejected"] > 0, spec
 
 
+@pytest.mark.slow
 def test_spec_self_draft_accepts_everything(lm_bundle):
     """Drafter == verifier: every draft must be accepted (the
     acceptance rule is exact, not probabilistic, under greedy)."""

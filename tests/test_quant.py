@@ -338,6 +338,7 @@ def test_fleet_budget_charges_int8_bytes_and_gauge(fc_setup):
         assert "znicz_quantized_models" in scrape
 
 
+@pytest.mark.slow
 def test_metrics_series_self_scrape(lm_bundles, fc_setup):
     f32, _q = lm_bundles
     _outs, st = _greedy(
@@ -345,6 +346,10 @@ def test_metrics_series_self_scrape(lm_bundles, fc_setup):
         page_tokens=8, pool_tokens=64, kv_quant=True)
     assert st["kv_bytes_per_lane"] > 0
     obs_metrics.quant_canary("scrape_test", "promoted").inc()
+    # registered here through the same helper FleetEngine.stats() uses
+    # so this test stands alone in the slow tier (the live fleet path
+    # is asserted by test_fleet_budget_charges_int8_bytes_and_gauge)
+    obs_metrics.quantized_models("scrape_test").set(1)
     scrape = obs_metrics.REGISTRY.to_prometheus()
     for series in ("znicz_quant_canary_total",
                    "znicz_kv_bytes_per_lane",

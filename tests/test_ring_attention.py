@@ -129,7 +129,7 @@ def test_blocked_rejects_indivisible():
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize(
     "n_shards,block_k",
-    [(2, 4),
+    [pytest.param(2, 4, marks=pytest.mark.slow),
      pytest.param(4, 8, marks=pytest.mark.slow),
      pytest.param(4, 4, marks=pytest.mark.slow)])
 def test_blocked_ring_equals_local_fwd_and_vjp(causal, n_shards,

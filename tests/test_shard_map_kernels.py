@@ -176,6 +176,7 @@ def test_layer_norm_shard_map_rejects_feature_sharded_spec():
 # ----------------------------------------------------------------------
 # ring attention on a (data × model) mesh with the per-hop flash fold
 # ----------------------------------------------------------------------
+@pytest.mark.slow
 @pytest.mark.parametrize("causal", [False, True])
 def test_ring_per_hop_flash_on_data_model_mesh(causal):
     """The ring's block_k (per-hop flash) fold on a (data=2, model=4)

@@ -455,3 +455,164 @@ class StagingRing:
 
     def release(self, slot: int) -> None:
         self._free.put(slot)
+
+
+class PageStager:
+    """Pinned staging rings + ONE uploader thread for KV-page h2d
+    traffic (round 22) — the :class:`StagingRing` machinery the
+    streaming loader runs for training batches, specialized to the
+    serving data plane's unit of transfer: one KV-cache *page* per
+    pool array (a spill restore promoting a cold prefix block back to
+    HBM, or a prefill→decode handoff landing a prompt's pages in the
+    decode pool's cache).
+
+    One ring per page-pool spec (K and V pools have the same page
+    shape but int8-quantized caches add f32 scale pools with their
+    own), so a staged page is a *set* of per-pool buffers travelling
+    together under one slot index tuple.  :meth:`upload` is
+    synchronous for the caller — stage (memcpy into the pinned slot)
+    → enqueue → the uploader thread ``device_put``\\ s and fences —
+    because the caller's very next dispatch consumes the arrays; the
+    ring bound is still load-bearing: concurrent uploaders (several
+    decode-pool replicas accepting handoffs) backpressure at
+    ``acquire`` instead of growing host memory.
+    """
+
+    def __init__(self, shapes_dtypes: list[tuple[tuple, object]],
+                 n_slots: int = 2) -> None:
+        import queue
+        import threading
+        self._rings = [StagingRing(n_slots, tuple(shape), dtype)
+                       for shape, dtype in shapes_dtypes]
+        self._work: "queue.Queue" = queue.Queue()
+        self._thread = threading.Thread(
+            target=self._upload_loop, name="page-uploader", daemon=True)
+        self._thread.start()
+
+    @property
+    def nbytes(self) -> int:
+        return sum(r.nbytes for r in self._rings)
+
+    def _upload_loop(self) -> None:
+        import jax
+        while True:
+            item = self._work.get()
+            if item is None:
+                return
+            slots, fut = item
+            try:
+                out = []
+                for ring, slot in zip(self._rings, slots):
+                    out.append(jax.device_put(ring.buffer(slot)))
+                for arr in out:  # fence: the slot is reusable only
+                    arr.block_until_ready()  # once the copy landed
+                fut.set_result(out)
+            except Exception as exc:  # noqa: BLE001 — caller's error
+                fut.set_exception(exc)
+            finally:
+                for ring, slot in zip(self._rings, slots):
+                    ring.release(slot)
+
+    def upload(self, pages: list[np.ndarray],
+               timeout: float = 30.0) -> list:
+        """Stage one page set and return its device arrays (blocks
+        until the uploader fenced the copies)."""
+        from concurrent.futures import Future
+        if len(pages) != len(self._rings):
+            raise ValueError(f"page set has {len(pages)} arrays, "
+                             f"stager expects {len(self._rings)}")
+        slots: list[int] = []
+        for ring, page in zip(self._rings, pages):
+            slot = ring.acquire(timeout=timeout)
+            if slot is None:
+                for r, s in zip(self._rings, slots):
+                    r.release(s)
+                raise TimeoutError(
+                    "page staging ring full — uploader stalled past "
+                    f"{timeout}s")
+            np.copyto(ring.buffer(slot), page)
+            slots.append(slot)
+        fut: Future = Future()
+        self._work.put((slots, fut))
+        out = fut.result(timeout=timeout)
+        if _metrics.enabled():
+            _metrics.transfer_bytes("h2d").inc(
+                sum(int(p.nbytes) for p in pages))
+        return out
+
+    def shutdown(self) -> None:
+        self._work.put(None)
+        self._thread.join(timeout=5.0)
+
+
+class HostPageTier:
+    """Host-DRAM tier for cold KV pages (round 22) — the capacity
+    layer under the HBM page pool that lets a prefix working set
+    survive past ``pool_pages``.
+
+    Frames are preallocated numpy buffers (one ``(capacity, ...page
+    shape)`` block per pool spec, allocation-free steady state); the
+    free list hands out frame ids with the same exactly-once
+    discipline as :class:`~znicz_tpu.serving.decode.PagedKVCache`
+    page ids — a frame id is held by AT MOST ONE trie node, and a
+    spilled block lives in exactly one tier at a time (HBM page XOR
+    host frame; the accounting invariant
+    tests/test_disagg.py pins).  Restores travel through the
+    :class:`PageStager` ring + uploader thread.
+    """
+
+    def __init__(self, shapes_dtypes: list[tuple[tuple, object]],
+                 capacity_pages: int, stager: PageStager | None = None,
+                 ring_slots: int = 2) -> None:
+        self.capacity = int(capacity_pages)
+        if self.capacity < 1:
+            raise ValueError(
+                f"host tier needs >= 1 page, got {capacity_pages}")
+        self._frames = [np.zeros((self.capacity,) + tuple(shape),
+                                 dtype)
+                        for shape, dtype in shapes_dtypes]
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self._own_stager = stager is None
+        self.stager = (stager if stager is not None
+                       else PageStager(shapes_dtypes,
+                                       n_slots=ring_slots))
+
+    @property
+    def used(self) -> int:
+        return self.capacity - len(self._free)
+
+    @property
+    def full(self) -> bool:
+        return not self._free
+
+    @property
+    def nbytes(self) -> int:
+        return sum(f.nbytes for f in self._frames)
+
+    def store(self, pages: list[np.ndarray]) -> int | None:
+        """Land one exported page set in a free frame; ``None`` when
+        the tier is full (caller falls back to eviction)."""
+        if not self._free:
+            return None
+        hid = self._free.pop()
+        for frame, page in zip(self._frames, pages):
+            np.copyto(frame[hid], page)
+        if _metrics.enabled():
+            _metrics.transfer_bytes("d2h").inc(
+                sum(int(p.nbytes) for p in pages))
+        return hid
+
+    def read(self, hid: int) -> list[np.ndarray]:
+        return [frame[hid] for frame in self._frames]
+
+    def upload(self, hid: int) -> list:
+        """Device arrays for one stored frame, via the staging ring +
+        uploader thread (the restore h2d path)."""
+        return self.stager.upload(self.read(hid))
+
+    def free(self, hid: int) -> None:
+        self._free.append(hid)
+
+    def shutdown(self) -> None:
+        if self._own_stager:
+            self.stager.shutdown()

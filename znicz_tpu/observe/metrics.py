@@ -630,6 +630,33 @@ def kv_bytes_per_lane(engine: str) -> Gauge:
         labels=("engine",)).labels(engine=engine)
 
 
+def kv_page_migrations(engine: str, direction: str) -> Counter:
+    """KV pages moved between tiers/pools (round 22): ``spill`` (HBM
+    → host-DRAM tier, a cold prefix block demoted under pool
+    pressure), ``restore`` (host → HBM through the staging ring — a
+    spilled block matched again), ``handoff`` (prefill pool → decode
+    pool, one count per page carried by a prefill→decode transfer).
+    Spill traffic trending up at a flat hit rate means the working
+    set outgrew HBM and the tier is absorbing it — the intended
+    shape; restores outpacing spills means thrash (tier too small)."""
+    return REGISTRY.counter(
+        "znicz_kv_page_migrations_total",
+        "KV pages moved between cache tiers / serving pools",
+        labels=("engine", "direction")).labels(engine=engine,
+                                               direction=direction)
+
+
+def kv_spill_pages(engine: str) -> Gauge:
+    """Host-DRAM tier occupancy (live callback gauge): KV pages
+    currently spilled out of the HBM pool.  With
+    ``znicz_kv_pages_used`` this is the two-tier residency picture —
+    total cached prefix capacity is the sum."""
+    return REGISTRY.gauge(
+        "znicz_kv_spill_pages",
+        "KV pages resident in the host-DRAM spill tier",
+        labels=("engine",)).labels(engine=engine)
+
+
 def prefix_cache_events(engine: str, event: str) -> Counter:
     """Prefix-sharing admissions: ``hit`` (≥1 full block of the
     prompt reused from the radix cache), ``miss`` (prefilled from
@@ -768,13 +795,18 @@ def serving_breaker_transitions(engine: str, to: str) -> Counter:
         labels=("engine", "to")).labels(engine=engine, to=to)
 
 
-def serving_queue_age_seconds(engine: str) -> Gauge:
+def serving_queue_age_seconds(engine: str, pool: str = "all") -> Gauge:
     """Age of the oldest pending request (live callback gauge) — the
-    breaker's stall signal and a /readyz input."""
+    breaker's stall signal, a /readyz input, and the autoscalers'
+    scale-up trigger.  ``pool`` (round 22) splits the series for
+    disaggregated serving: ``prefill`` and ``decode`` queues age
+    independently (a prompt burst must scale the prefill pool without
+    touching decode residency), while monolithic engines keep the
+    single ``all`` child."""
     return REGISTRY.gauge(
         "znicz_serving_queue_age_seconds",
-        "Age of the oldest request pending in the batcher queue",
-        labels=("engine",)).labels(engine=engine)
+        "Age of the oldest request pending in the serving queue",
+        labels=("engine", "pool")).labels(engine=engine, pool=pool)
 
 
 def last_step_timestamp(workflow: str) -> Gauge:

@@ -167,6 +167,13 @@ SITES = {
         "slips publication; the SwapController's canary must reject "
         "the bundle at the guard margin with the f32 incumbent still "
         "serving",
+    "disagg.handoff_drop":
+        "a prefill→decode page-table handoff is dropped in flight (the "
+        "cross-pool transfer fails after the prefill pool already "
+        "released its pages) — the DisaggEngine must retry the request "
+        "on a fresh prefill pass with its token-budget reservation "
+        "kept, reject it only past the retry budget, and leave the "
+        "budget balanced() with every page reclaimed",
 }
 
 #: spec keys that steer firing rather than ride the payload

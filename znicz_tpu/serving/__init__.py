@@ -53,6 +53,9 @@ from znicz_tpu.serving.decode import (  # noqa: F401
     PoolExhausted,
     PrefixCache,
 )
+from znicz_tpu.serving.disagg import (  # noqa: F401
+    DisaggEngine,
+)
 from znicz_tpu.serving.engine import (  # noqa: F401
     ServingEngine,
     resolve_swap_state,
@@ -60,6 +63,7 @@ from znicz_tpu.serving.engine import (  # noqa: F401
 from znicz_tpu.serving.fleet import (  # noqa: F401
     FleetAutoscaler,
     FleetEngine,
+    PoolAutoscaler,
     ReplicaGroup,
     SharedLadderBudget,
     TenantClass,

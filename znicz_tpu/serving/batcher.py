@@ -394,8 +394,11 @@ class ContinuousBatcher(Logger):
                          if obs_id else None)
         if self._m_state is not None:
             self._m_state.set(_STATE_CODE[_CLOSED])
-            _metrics.serving_queue_age_seconds(obs_id).set_function(
-                self.oldest_age_s)
+            # pool="all": the one-shot batcher is a single queue —
+            # the per-pool children (prefill/decode) belong to the
+            # round-22 disaggregated engine
+            _metrics.serving_queue_age_seconds(
+                obs_id, pool="all").set_function(self.oldest_age_s)
         self._pending = PriorityQueue()
         self._rows = 0
         #: rows pending per tenant (per-tenant queue bounds)

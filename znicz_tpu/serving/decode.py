@@ -268,6 +268,10 @@ class PagedKVCache:
         #: :meth:`DecodeModel.copy_page` must copy on a COW
         self.pool_indices = tuple(i for i, s in enumerate(specs)
                                   if s[1] == "page")
+        #: slot-indexed leaves (LSTM carries) — the rows a
+        #: prefill→decode handoff must carry alongside the pages
+        self.slot_indices = tuple(i for i, s in enumerate(specs)
+                                  if s[1] != "page")
         self.tables = np.full((self.max_slots + 1, self.max_blocks),
                               self.trash_page, np.int32)
         self.ref = np.zeros(self.pool_pages, np.int64)
@@ -365,11 +369,15 @@ class PagedKVCache:
 
 
 class _TrieNode:
-    __slots__ = ("key", "page", "children", "parent", "last_use")
+    __slots__ = ("key", "page", "host", "children", "parent",
+                 "last_use")
 
     def __init__(self, key, page, parent) -> None:
         self.key = key          # the block's token ids (bytes key)
-        self.page = page        # page id (one per attention pool row)
+        self.page = page        # HBM page id, or None while spilled
+        self.host = None        # host-tier frame id, or None (round
+        #                         22: a block lives in EXACTLY one
+        #                         tier — page XOR host)
         self.children: dict = {}
         self.parent = parent
         self.last_use = 0
@@ -406,17 +414,20 @@ class PrefixCache:
     def _key(tokens: np.ndarray) -> bytes:
         return np.ascontiguousarray(tokens, np.int32).tobytes()
 
-    def match(self, tokens: np.ndarray
-              ) -> tuple[list[int], int, tuple | None]:
+    def match_nodes(self, tokens: np.ndarray
+                    ) -> tuple[list, int, tuple | None]:
         """Longest cached prefix of ``tokens`` (capped at ``n-1``):
-        returns ``(full_block_pages, matched_tokens, cow)`` where
-        ``cow`` is ``(donor_page, extra_tokens)`` for a partial
+        returns ``(full_block_nodes, matched_tokens, cow)`` where
+        ``cow`` is ``(donor_node, extra_tokens)`` for a partial
         boundary-block match (``matched_tokens`` already includes
-        ``extra_tokens``) or ``None``."""
+        ``extra_tokens``) or ``None``.  Nodes — not bare page ids —
+        because a matched block may be SPILLED to the host tier
+        (``node.page is None``): the caller restores it before
+        sharing (round 22)."""
         n = int(tokens.shape[0])
         ptok = self.page_tokens
         node = self.root
-        pages: list[int] = []
+        nodes: list[_TrieNode] = []
         matched = 0
         while matched + ptok <= n - 1:
             child = node.children.get(
@@ -425,7 +436,7 @@ class PrefixCache:
                 break
             node = child
             self._tick(node)
-            pages.append(node.page)
+            nodes.append(node)
             matched += ptok
         # boundary refinement: the longest token-level common prefix
         # with any child of the last matched node
@@ -442,9 +453,16 @@ class PrefixCache:
                     best, best_common = child, m
         if best is not None and best_common > 0:
             self._tick(best)
-            return pages, matched + best_common, (best.page,
-                                                  best_common)
-        return pages, matched, None
+            return nodes, matched + best_common, (best, best_common)
+        return nodes, matched, None
+
+    def match(self, tokens: np.ndarray
+              ) -> tuple[list[int], int, tuple | None]:
+        """Page-id view of :meth:`match_nodes` for HBM-only callers
+        (no spill tier: every matched node is resident)."""
+        nodes, matched, cow = self.match_nodes(tokens)
+        return ([node.page for node in nodes], matched,
+                None if cow is None else (cow[0].page, cow[1]))
 
     def insert(self, tokens: np.ndarray, table_row: np.ndarray,
                cache: PagedKVCache) -> int:
@@ -480,14 +498,34 @@ class PrefixCache:
             stack.extend(node.children.values())
         return out
 
+    def spill_candidate(self, cache: PagedKVCache):
+        """The LRU HBM-resident node held by NOTHING but the trie pin
+        (``ref == 1`` — no live sequence maps its page), or None.
+        Safe to demote: the node STAYS in the trie, so the block is
+        still matchable from the host tier — unlike eviction, a spill
+        loses residency, not the hit (round 22).  Interior nodes
+        qualify too: demotion never orphans children."""
+        best = None
+        stack = list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node.page is not None \
+                    and int(cache.ref[node.page]) == 1 \
+                    and (best is None or node.last_use < best.last_use):
+                best = node
+        return best
+
     def evict(self, cache: PagedKVCache, pages_needed: int) -> int:
         """Unpin LRU leaf blocks until ``pages_needed`` pages are
-        free (or the trie is empty).  An unpinned page frees
-        immediately when no live sequence still references it.
-        Returns nodes evicted."""
+        free (or no HBM-resident leaf remains).  An unpinned page
+        frees immediately when no live sequence still references it.
+        Host-resident leaves are skipped — they hold no HBM page, so
+        dropping them frees nothing here.  Returns nodes evicted."""
         evicted = 0
         while cache.free_pages < pages_needed:
-            leaves = self._leaves()
+            leaves = [lf for lf in self._leaves()
+                      if lf.page is not None]
             if not leaves:
                 break
             victim = min(leaves, key=lambda n: n.last_use)
@@ -497,15 +535,29 @@ class PrefixCache:
             evicted += 1
         return evicted
 
-    def clear(self, cache: PagedKVCache) -> int:
+    def spilled_nodes(self) -> int:
+        """Host-tier residents (telemetry + accounting tests)."""
+        count, stack = 0, list(self.root.children.values())
+        while stack:
+            node = stack.pop()
+            stack.extend(node.children.values())
+            if node.host is not None:
+                count += 1
+        return count
+
+    def clear(self, cache: PagedKVCache, tier=None) -> int:
         """Drop the whole trie (weight swap: cached K/V are functions
-        of the OLD weights).  Returns nodes dropped."""
+        of the OLD weights) — BOTH tiers: spilled frames free too.
+        Returns nodes dropped."""
         dropped = 0
         stack = list(self.root.children.values())
         while stack:
             node = stack.pop()
             stack.extend(node.children.values())
-            cache.ref_dec(node.page)
+            if node.page is not None:
+                cache.ref_dec(node.page)
+            elif tier is not None and node.host is not None:
+                tier.free(node.host)
             dropped += 1
         self.root.children.clear()
         self.nodes = 0
@@ -678,6 +730,10 @@ class DecodeModel(Logger):
         self._paged_decode_programs: dict[tuple, "callable"] = {}
         self._verify_programs: dict[tuple, "callable"] = {}
         self._copy_program = None
+        #: round 22 page-I/O family: scatter one staged page (spill
+        #: restore / pool handoff) or one carry row set into a cache
+        self._page_in_program = None
+        self._carry_in_program = None
         self.compile_count = 0
         self.donating = model._donate_choice()
         # the published weight pytree: one immutable tuple-of-tuples
@@ -1103,6 +1159,35 @@ class DecodeModel(Logger):
             return tuple(caches)
         return fn
 
+    def _page_in_fn(self):
+        """Scatter ONE staged page (every pool) into row ``dst`` —
+        the device half of a spill restore or a prefill→decode
+        handoff (round 22).  The page operands arrive via the staging
+        ring + uploader thread; only the cache tuple is donated, so
+        the uploaded arrays stay valid for the caller."""
+        pool_indices = self.cache.pool_indices
+
+        def fn(caches, pages, dst):
+            caches = list(caches)
+            for j, i in enumerate(pool_indices):
+                caches[i] = caches[i].at[dst].set(pages[j])
+            return tuple(caches)
+        return fn
+
+    def _carry_in_fn(self):
+        """Scatter one LSTM carry row set into ``slot`` — the
+        slot-indexed half of the handoff contract (carries summarize
+        the whole prefix in O(H), so they ride the transfer as rows,
+        not pages)."""
+        slot_indices = self.cache.slot_indices
+
+        def fn(caches, rows, slot):
+            caches = list(caches)
+            for j, i in enumerate(slot_indices):
+                caches[i] = caches[i].at[slot].set(rows[j])
+            return tuple(caches)
+        return fn
+
     # ------------------------------------------------------------------
     # AOT compilation
     # ------------------------------------------------------------------
@@ -1234,6 +1319,41 @@ class DecodeModel(Logger):
                 "serving-page")
         return self._copy_program
 
+    def page_in_program(self):
+        if not self.paged:
+            raise RuntimeError("page_in needs the paged cache")
+        if self._page_in_program is None:
+            import jax
+            cache = self.cache
+            page_structs = tuple(
+                jax.ShapeDtypeStruct(
+                    (cache.page_tokens,) + tuple(cache.specs[i][2]),
+                    cache.arrays[i].dtype)
+                for i in cache.pool_indices)
+            self._page_in_program = self._compile(
+                self._page_in_fn(),
+                (self._cache_structs(), page_structs,
+                 jax.ShapeDtypeStruct((), np.dtype(np.int32))),
+                "serving-page")
+        return self._page_in_program
+
+    def carry_in_program(self):
+        if not (self.paged and self.has_lstm):
+            raise RuntimeError("carry_in needs a paged LSTM chain")
+        if self._carry_in_program is None:
+            import jax
+            cache = self.cache
+            row_structs = tuple(
+                jax.ShapeDtypeStruct(tuple(cache.specs[i][2]),
+                                     cache.arrays[i].dtype)
+                for i in cache.slot_indices)
+            self._carry_in_program = self._compile(
+                self._carry_in_fn(),
+                (self._cache_structs(), row_structs,
+                 jax.ShapeDtypeStruct((), np.dtype(np.int32))),
+                "serving-page")
+        return self._carry_in_program
+
     def prompt_ladder(self) -> list[int]:
         return ladder(self.max_prompt, self.prompt_align)
 
@@ -1253,14 +1373,18 @@ class DecodeModel(Logger):
     def fresh_nb(self, t_bucket: int) -> int:
         return self.nb_for(t_bucket - 1)
 
-    def warmup(self, prefix_cache: bool = True) -> int:
+    def warmup(self, prefix_cache: bool = True,
+               page_io: bool = False) -> int:
         """Compile EVERY program family up front — after this, a
         decode loop at any live-batch size, block depth and prompt mix
         performs zero compiles.  Returns programs compiled.
 
         ``prefix_cache=False`` skips the tail-prefill (start>0)
         variants and the COW copy program — engines without prefix
-        sharing never dispatch them."""
+        sharing never dispatch them.  ``page_io=True`` (round 22)
+        adds the page-in scatter (+ the carry scatter on LSTM
+        chains): spill restores and pool handoffs then run
+        compile-free too."""
         before = self.compile_count
         if not self.paged:
             for t_b in self.prompt_ladder():
@@ -1287,6 +1411,10 @@ class DecodeModel(Logger):
                                         site="serving-prefill")
         if prefix_cache:
             self.copy_program()
+        if page_io:
+            self.page_in_program()
+            if self.has_lstm:
+                self.carry_in_program()
         return self.compile_count - before
 
     @property
@@ -1296,18 +1424,59 @@ class DecodeModel(Logger):
                 + len(self._paged_prefill_programs)
                 + len(self._paged_decode_programs)
                 + len(self._verify_programs)
-                + (1 if self._copy_program is not None else 0))
+                + (1 if self._copy_program is not None else 0)
+                + (1 if self._page_in_program is not None else 0)
+                + (1 if self._carry_in_program is not None else 0))
 
     # ------------------------------------------------------------------
-    # dispatch (scheduler thread only — no locking needed on cache)
+    # pool replication (round 22): programs are pure functions of the
+    # cache operands, so ONE warmed DecodeModel serves any number of
+    # same-geometry caches — disaggregated pool replicas scale
+    # compile-free
+    # ------------------------------------------------------------------
+    def make_cache(self) -> PagedKVCache:
+        """A fresh :class:`PagedKVCache` with IDENTICAL geometry to
+        the model's own — the per-replica state of a disaggregated
+        prefill/decode pool member.  Every compiled program accepts
+        it via the ``cache=`` dispatch parameter."""
+        if not self.paged:
+            raise RuntimeError(
+                "pool replication needs the paged cache (flat caches "
+                "are slot-bound to one engine)")
+        cache = self.cache
+        return PagedKVCache(list(cache.specs), self.max_slots,
+                            self.page_tokens, self.max_blocks,
+                            cache.pool_pages)
+
+    def page_shapes(self) -> list[tuple[tuple, object]]:
+        """(shape, dtype) of ONE page per pool array — the frame
+        geometry of the host tier and staging rings."""
+        cache = self.cache
+        return [((cache.page_tokens,) + tuple(cache.specs[i][2]),
+                 np.dtype(cache.arrays[i].dtype))
+                for i in cache.pool_indices]
+
+    def carry_shapes(self) -> list[tuple[tuple, object]]:
+        """(shape, dtype) of one slot's carry rows (LSTM chains)."""
+        cache = self.cache
+        return [(tuple(cache.specs[i][2]),
+                 np.dtype(cache.arrays[i].dtype))
+                for i in cache.slot_indices]
+
+    # ------------------------------------------------------------------
+    # dispatch (ONE thread per cache — no locking needed on a cache;
+    # ``cache=None`` means the model's own.  Pool replicas pass their
+    # private same-geometry cache and reuse every compiled program.)
     # ------------------------------------------------------------------
     def run_prefill(self, tokens: np.ndarray, slot: int,
-                    start: int = 0) -> np.ndarray:
+                    start: int = 0, cache: PagedKVCache | None = None
+                    ) -> np.ndarray:
         """Prefill one prompt window into ``slot``; returns the last
         real position's logits (V,).  ``tokens`` are the positions
         ``start..start+len-1`` — the whole prompt for a fresh
         admission (``start=0``), the unshared tail after a
         prefix-cache hit (paged only)."""
+        cache = cache if cache is not None else self.cache
         n = int(tokens.shape[0])
         if start + n > self.max_prompt:
             raise ValueError(f"prompt of {start + n} tokens exceeds "
@@ -1319,26 +1488,28 @@ class DecodeModel(Logger):
             if start:
                 raise ValueError("flat cache cannot tail-prefill")
             prog = self.prefill_program(t_b)
-            caches, logits = prog(self.cache.arrays, self._weights,
+            caches, logits = prog(cache.arrays, self._weights,
                                   padded, np.asarray(slot, np.int32),
                                   np.asarray(n, np.int32))
-            self.cache.arrays = caches
+            cache.arrays = caches
             return np.asarray(logits, np.float32)[0]
         nb = self.nb_for(start + t_b - 1)
         prog = self.paged_prefill_program(t_b, nb)
         caches, logits = prog(
-            self.cache.arrays, self._weights, padded,
-            self.cache.table_operand(slot, nb),
+            cache.arrays, self._weights, padded,
+            cache.table_operand(slot, nb),
             np.asarray(slot, np.int32), np.asarray(start, np.int32),
             np.asarray(n, np.int32))
-        self.cache.arrays = caches
+        cache.arrays = caches
         return np.asarray(logits, np.float32)[0]
 
     def run_decode(self, tokens: np.ndarray, slots: np.ndarray,
-                   positions: np.ndarray) -> np.ndarray:
+                   positions: np.ndarray,
+                   cache: PagedKVCache | None = None) -> np.ndarray:
         """One token step for ``len(tokens)`` live lanes; pads to the
         covering live-batch bucket (padded lanes ride the scratch
         slot/trash table).  Returns logits (n_live, V)."""
+        cache = cache if cache is not None else self.cache
         n = int(tokens.shape[0])
         b_b = bucket_for(n)
 
@@ -1350,50 +1521,53 @@ class DecodeModel(Logger):
         if not self.paged:
             prog = self.decode_program(b_b)
             caches, logits = prog(
-                self.cache.arrays, self._weights, padded(tokens, 0),
-                padded(slots, self.cache.trash_slot),
+                cache.arrays, self._weights, padded(tokens, 0),
+                padded(slots, cache.trash_slot),
                 padded(positions, 0))
-            self.cache.arrays = caches
+            cache.arrays = caches
             return np.asarray(logits, np.float32)[:n]
         nb = self.nb_for(int(positions.max()))
-        tables = np.full((b_b, nb + 1), self.cache.trash_page,
+        tables = np.full((b_b, nb + 1), cache.trash_page,
                          np.int32)
-        tables[:n, :nb] = self.cache.tables[slots, :nb]
+        tables[:n, :nb] = cache.tables[slots, :nb]
         prog = self.paged_decode_program(b_b, nb)
         caches, logits = prog(
-            self.cache.arrays, self._weights, padded(tokens, 0),
-            tables, padded(slots, self.cache.trash_slot),
+            cache.arrays, self._weights, padded(tokens, 0),
+            tables, padded(slots, cache.trash_slot),
             padded(positions, 0))
-        self.cache.arrays = caches
+        cache.arrays = caches
         return np.asarray(logits, np.float32)[:n]
 
     def run_window(self, windows: np.ndarray, slots: np.ndarray,
                    positions: np.ndarray, lengths: np.ndarray,
-                   site: str = "serving-verify") -> np.ndarray:
+                   site: str = "serving-verify",
+                   cache: PagedKVCache | None = None) -> np.ndarray:
         """Batched window dispatch: ``windows`` (n, W) token windows
         starting at per-lane ``positions`` with ``lengths`` real
         tokens each; ONE forward writes all live K/V through the page
         tables and returns logits (n, W, V)."""
+        cache = cache if cache is not None else self.cache
         n, w_len = windows.shape
         b_b = bucket_for(n)
         nb = self.nb_for(int(positions.max()) + w_len - 1)
         win = np.zeros((b_b, w_len), np.int32)
         win[:n] = windows
-        tables = np.full((b_b, nb + 1), self.cache.trash_page,
+        tables = np.full((b_b, nb + 1), cache.trash_page,
                          np.int32)
-        tables[:n, :nb] = self.cache.tables[slots, :nb]
+        tables[:n, :nb] = cache.tables[slots, :nb]
         pos = np.zeros((b_b,), np.int32)
         pos[:n] = positions
         lens = np.zeros((b_b,), np.int32)
         lens[:n] = lengths
         prog = self.window_program(b_b, int(w_len), nb, site=site)
-        caches, logits = prog(self.cache.arrays, self._weights, win,
+        caches, logits = prog(cache.arrays, self._weights, win,
                               tables, pos, lens)
-        self.cache.arrays = caches
+        cache.arrays = caches
         return np.asarray(logits, np.float32)[:n]
 
     def run_verify(self, windows: np.ndarray, slots: np.ndarray,
-                   positions: np.ndarray) -> np.ndarray:
+                   positions: np.ndarray,
+                   cache: PagedKVCache | None = None) -> np.ndarray:
         """Speculative verification: ``windows`` (n, spec_k+1) token
         windows starting at per-lane ``positions``; logits at every
         window position (n, spec_k+1, V)."""
@@ -1401,15 +1575,56 @@ class DecodeModel(Logger):
             raise RuntimeError("spec_k=0 — no verify family planned")
         lengths = np.full((windows.shape[0],), self.spec_k + 1,
                           np.int32)
-        return self.run_window(windows, slots, positions, lengths)
+        return self.run_window(windows, slots, positions, lengths,
+                               cache=cache)
 
-    def copy_page(self, src: int, dst: int) -> None:
+    def copy_page(self, src: int, dst: int,
+                  cache: PagedKVCache | None = None) -> None:
         """Device-copy one page across every attention pool — the COW
         a partial prefix match pays before its divergent tail."""
+        cache = cache if cache is not None else self.cache
         prog = self.copy_program()
-        self.cache.arrays = prog(self.cache.arrays,
-                                 np.asarray(src, np.int32),
-                                 np.asarray(dst, np.int32))
+        cache.arrays = prog(cache.arrays,
+                            np.asarray(src, np.int32),
+                            np.asarray(dst, np.int32))
+
+    # ------------------------------------------------------------------
+    # page / carry I-O (round 22): the data plane of spill restores
+    # and prefill→decode handoffs
+    # ------------------------------------------------------------------
+    def export_page(self, pid: int,
+                    cache: PagedKVCache | None = None
+                    ) -> list[np.ndarray]:
+        """D2H-copy page ``pid`` out of every attention pool — one
+        (page_tokens, H, Dh) host array per pool, the unit the host
+        tier stores and a handoff ships."""
+        cache = cache if cache is not None else self.cache
+        return [np.asarray(cache.arrays[i][pid])
+                for i in cache.pool_indices]
+
+    def page_in(self, pages, dst: int,
+                cache: PagedKVCache | None = None) -> None:
+        """Scatter one page (device or host arrays, one per pool)
+        into pool row ``dst`` — a spill restore or handoff landing."""
+        cache = cache if cache is not None else self.cache
+        cache.arrays = self.page_in_program()(
+            cache.arrays, tuple(pages), np.asarray(dst, np.int32))
+
+    def export_carry(self, slot: int,
+                     cache: PagedKVCache | None = None
+                     ) -> list[np.ndarray]:
+        """D2H-copy slot ``slot``'s recurrent carry rows (LSTM h/c) —
+        the non-paged half of a handoff."""
+        cache = cache if cache is not None else self.cache
+        return [np.asarray(cache.arrays[i][slot])
+                for i in cache.slot_indices]
+
+    def carry_in(self, rows, slot: int,
+                 cache: PagedKVCache | None = None) -> None:
+        """Scatter carry rows into slot ``slot``."""
+        cache = cache if cache is not None else self.cache
+        cache.arrays = self.carry_in_program()(
+            cache.arrays, tuple(rows), np.asarray(slot, np.int32))
 
     # ------------------------------------------------------------------
     # weight hot-swap (round 13)
@@ -1565,7 +1780,139 @@ class _Live:
         self.t_last = time.monotonic()
 
 
-class DecodeEngine(Logger):
+class _PageSetupMixin:
+    """Paged admission shared by :class:`DecodeEngine` and the
+    disaggregated prefill workers (serving/disagg.py): prefix match →
+    share/COW/alloc → spill-tier room-making.  The host expects
+    ``self.model`` (a :class:`DecodeModel`), ``self.prefix``
+    (:class:`PrefixCache` or None), ``self._spill``
+    (``memory.HostPageTier`` or None), ``self._obs_id`` and the
+    prefix/migration metric children; :meth:`_kv_cache` names the
+    cache the host schedules (a pool worker's private replica cache,
+    the engine's own otherwise)."""
+
+    def _kv_cache(self) -> PagedKVCache:
+        return self.model.cache
+
+    def _setup_pages(self, slot: int, tokens: np.ndarray,
+                     max_new: int) -> int:
+        """Map the request's blocks into ``slot``'s table: shared full
+        blocks by reference, a partially-matched boundary block via
+        copy-on-write, fresh pages for the rest — RESERVING the whole
+        worst-case span (prompt + token budget, capped at max_t) up
+        front, so an admitted request can never be page-starved
+        mid-generation and pool pressure degrades as deterministic
+        admission shedding, never as a truncated neighbor.  Returns
+        the matched token count (the tail prefill starts there).
+        Raises :class:`PoolExhausted` with the slot's table cleaned."""
+        model = self.model
+        cache = self._kv_cache()
+        n = int(tokens.shape[0])
+        nodes: list = []
+        matched = 0
+        cow = None
+        if self.prefix is not None:
+            nodes, matched, cow = self.prefix.match_nodes(tokens)
+        span = min(n + int(max_new), model.max_t)
+        nblocks = -(-span // model.page_tokens)
+        # Two-phase pinning (round 22, generalizing the round-15
+        # pin-before-evict rule to the spill tier).  Phase 1 pins
+        # every HBM-resident matched block into the slot's table
+        # BEFORE any room-making: a restore below may spill or evict
+        # other trie pages, and a matched-but-unpinned HBM page must
+        # never be a victim.  Phase 2 restores host-resident matched
+        # blocks one at a time, pinning each the moment it lands
+        # (ref 2 = trie + slot, so spill_candidate's ref==1 test
+        # can't re-spill it while we restore the next).  Host-
+        # resident blocks are safe to defer: evict() only takes
+        # page-resident leaves, and the host tier frees nothing on
+        # its own.
+        donor_pinned = False
+        try:
+            for b, node in enumerate(nodes):
+                if node.page is not None:
+                    cache.share_block(slot, b, node.page)
+            if cow is not None and cow[0].page is not None:
+                cache.ref[cow[0].page] += 1  # donor pin till copy
+                donor_pinned = True
+            for b, node in enumerate(nodes):
+                if node.page is None:
+                    self._restore_node(node)
+                    cache.share_block(slot, b, node.page)
+            if cow is not None and not donor_pinned:
+                self._restore_node(cow[0])
+                cache.ref[cow[0].page] += 1
+                donor_pinned = True
+            need_new = nblocks - len(nodes)
+            if cache.free_pages < need_new:
+                self._make_room(need_new)
+            base = len(nodes)
+            if cow is not None:
+                pid = cache.new_block(slot, base)
+                # the divergence copy: shared positions of the
+                # boundary block come along, the divergent tail
+                # overwrites its own private copy
+                model.copy_page(cow[0].page, pid, cache=cache)
+                base += 1
+            for b in range(base, nblocks):
+                cache.new_block(slot, b)
+        except PoolExhausted:
+            cache.release_slot_pages(slot)
+            raise
+        finally:
+            if donor_pinned:
+                cache.ref_dec(cow[0].page)
+        if self.prefix is not None:
+            if matched > 0:
+                self._m_prefix_hit.inc()
+                self._m_tok_shared.inc(matched)
+            else:
+                self._m_prefix_miss.inc()
+            self._m_tok_computed.inc(n - matched)
+        return matched
+
+    def _restore_node(self, node) -> None:
+        """Bring one host-resident trie block back to an HBM page
+        through the staging ring; the node's trie pin moves tiers
+        with it (frame freed, fresh page ref 1)."""
+        cache = self._kv_cache()
+        if cache.free_pages < 1:
+            self._make_room(1)
+        pid = cache.alloc_page()  # ref 1 = the trie pin, now on HBM
+        dev = self._spill.upload(node.host)
+        self.model.page_in(dev, pid, cache=cache)
+        self._spill.free(node.host)
+        node.page, node.host = pid, None
+        self._m_mig_restore.inc()
+
+    def _make_room(self, pages_needed: int) -> None:
+        """Free HBM pages for an admission: spill cold shareable
+        blocks to the host tier while it has frames, then fall back
+        to plain trie eviction.  No-op without a prefix cache —
+        new_block raises PoolExhausted and admission requeues."""
+        if self.prefix is None:
+            return
+        cache = self._kv_cache()
+        while cache.free_pages < pages_needed:
+            if self._spill is not None and not self._spill.full:
+                victim = self.prefix.spill_candidate(cache)
+                if victim is not None:
+                    hid = self._spill.store(
+                        self.model.export_page(victim.page,
+                                               cache=cache))
+                    # sole holder was the trie pin → page frees now
+                    cache.ref_dec(victim.page)
+                    victim.page, victim.host = None, hid
+                    self._m_mig_spill.inc()
+                    continue
+            evicted = self.prefix.evict(cache, pages_needed)
+            if evicted:
+                _metrics.prefix_cache_events(
+                    self._obs_id, "evicted").inc(evicted)
+            return
+
+
+class DecodeEngine(_PageSetupMixin, Logger):
     """Continuous-batching token server over a :class:`DecodeModel`.
 
     Lifecycle mirrors :class:`~znicz_tpu.serving.ServingEngine`::
@@ -1615,6 +1962,7 @@ class DecodeEngine(Logger):
                  max_queue_age_ms: float = 10_000.0,
                  kv_quant: bool | None = None,
                  kv_dtype=None,
+                 spill_pages: int | None = None,
                  device=None) -> None:
         super().__init__()
         from znicz_tpu.serving.batcher import TokenBudget
@@ -1680,6 +2028,18 @@ class DecodeEngine(Logger):
             prefix_cache and model.paged and not model.has_lstm)
         self.prefix = (PrefixCache(model.page_tokens)
                        if self.prefix_cache_enabled else None)
+        # round 22: host-DRAM spill tier behind the prefix trie —
+        # cold pages leave HBM for preallocated pinned-style host
+        # frames and restore through the staging-ring uploader, so
+        # the shareable working set is pool_pages + spill_pages
+        if spill_pages is None:
+            spill_pages = int(root.common.engine.get(
+                "kv_spill_pages", 0))
+        self._spill = None
+        if self.prefix_cache_enabled and int(spill_pages) > 0:
+            from znicz_tpu.memory import HostPageTier
+            self._spill = HostPageTier(model.page_shapes(),
+                                       int(spill_pages))
         self._token_budget = None
         if model.paged:
             budget = (int(max_queue_tokens) if max_queue_tokens
@@ -1733,6 +2093,17 @@ class DecodeEngine(Logger):
         # is fixed at construction, so one set() suffices)
         _metrics.kv_bytes_per_lane(self._obs_id).set(
             model.cache.nbytes() / max(1, model.max_slots))
+        # round 22: migration traffic + tier occupancy + queue age
+        self._m_mig_spill = _metrics.kv_page_migrations(
+            self._obs_id, "spill")
+        self._m_mig_restore = _metrics.kv_page_migrations(
+            self._obs_id, "restore")
+        if self._spill is not None:
+            tier = self._spill
+            _metrics.kv_spill_pages(self._obs_id).set_function(
+                lambda: tier.used)
+        _metrics.serving_queue_age_seconds(
+            self._obs_id, pool="all").set_function(self._queue_age)
         self._m_prefix_hit = _metrics.prefix_cache_events(
             self._obs_id, "hit")
         self._m_prefix_miss = _metrics.prefix_cache_events(
@@ -1790,7 +2161,8 @@ class DecodeEngine(Logger):
             return self
         t0 = time.monotonic()
         self.warmup_compiles = self.model.warmup(
-            prefix_cache=self.prefix_cache_enabled)
+            prefix_cache=self.prefix_cache_enabled,
+            page_io=self._spill is not None)
         if self.drafter is not None:
             self.warmup_compiles += self.drafter.warmup()
         self.warmup_seconds = time.monotonic() - t0
@@ -1823,6 +2195,8 @@ class DecodeEngine(Logger):
             self._thread.join(timeout=timeout)
             self._thread = None
         self._started = False
+        if self._spill is not None:
+            self._spill.shutdown()
         # a stopped engine is not shedding: clear the breaker so the
         # process-level /readyz (which scans EVERY engine child of the
         # breaker gauge) doesn't stay not-ready on a dead engine's
@@ -2069,7 +2443,8 @@ class DecodeEngine(Logger):
         if self.prefix is not None:
             # cached K/V are functions of the OLD weights: every
             # shared prefix page is stale the instant the flip lands
-            dropped = self.prefix.clear(self.model.cache)
+            dropped = self.prefix.clear(self.model.cache,
+                                        tier=self._spill)
             if dropped:
                 self.info("prefix cache invalidated by weight swap "
                           "(%d cached blocks dropped)", dropped)
@@ -2205,74 +2580,6 @@ class DecodeEngine(Logger):
         self._release_lane(live)
         if not live.req.future.done():
             live.req.future.set_exception(exc)
-
-    # ------------------------------------------------------------------
-    # paged admission: prefix match → share/COW/alloc → tail prefill
-    # ------------------------------------------------------------------
-    def _setup_pages(self, slot: int, tokens: np.ndarray,
-                     max_new: int) -> int:
-        """Map the request's blocks into ``slot``'s table: shared full
-        blocks by reference, a partially-matched boundary block via
-        copy-on-write, fresh pages for the rest — RESERVING the whole
-        worst-case span (prompt + token budget, capped at max_t) up
-        front, so an admitted request can never be page-starved
-        mid-generation and pool pressure degrades as deterministic
-        admission shedding, never as a truncated neighbor.  Returns
-        the matched token count (the tail prefill starts there).
-        Raises :class:`PoolExhausted` with the slot's table cleaned."""
-        model = self.model
-        cache = model.cache
-        n = int(tokens.shape[0])
-        shared: list[int] = []
-        matched = 0
-        cow = None
-        if self.prefix is not None:
-            shared, matched, cow = self.prefix.match(tokens)
-        span = min(n + int(max_new), model.max_t)
-        nblocks = -(-span // model.page_tokens)
-        need_new = nblocks - len(shared)
-        # Pin the matched pages BEFORE any eviction: mapping the
-        # shared blocks into the slot's table (and holding a
-        # temporary ref on the COW donor) keeps them off the free
-        # list even when evict() below unpins their trie leaves
-        # under pool pressure — otherwise a just-matched page could
-        # free and be re-allocated to another sequence while this
-        # request still maps it.
-        for b, pid in enumerate(shared):
-            cache.share_block(slot, b, pid)
-        if cow is not None:
-            cache.ref[cow[0]] += 1  # donor pin until the copy lands
-        try:
-            if cache.free_pages < need_new \
-                    and self.prefix is not None:
-                evicted = self.prefix.evict(cache, need_new)
-                if evicted:
-                    _metrics.prefix_cache_events(
-                        self._obs_id, "evicted").inc(evicted)
-            base = len(shared)
-            if cow is not None:
-                pid = cache.new_block(slot, base)
-                # the divergence copy: shared positions of the
-                # boundary block come along, the divergent tail
-                # overwrites its own private copy
-                model.copy_page(cow[0], pid)
-                base += 1
-            for b in range(base, nblocks):
-                cache.new_block(slot, b)
-        except PoolExhausted:
-            cache.release_slot_pages(slot)
-            raise
-        finally:
-            if cow is not None:
-                cache.ref_dec(cow[0])
-        if self.prefix is not None:
-            if matched > 0:
-                self._m_prefix_hit.inc()
-                self._m_tok_shared.inc(matched)
-            else:
-                self._m_prefix_miss.inc()
-            self._m_tok_computed.inc(n - matched)
-        return matched
 
     def _admit_cleanup(self, req: _PromptReq, slot: int,
                        exc: Exception) -> None:
@@ -2664,6 +2971,19 @@ class DecodeEngine(Logger):
     # ------------------------------------------------------------------
     # telemetry
     # ------------------------------------------------------------------
+    def _queue_age(self) -> float:
+        """Age of the oldest queued prompt (seconds) — the gauge's
+        read callback.  Racy peek without the lock is fine: the
+        scrape tolerates one-request staleness."""
+        try:
+            head = self._pending.peek()
+        except RuntimeError:  # dict mutated mid-iteration
+            return 0.0
+        if head is None:
+            return 0.0
+        return max(0.0, time.monotonic() - head.t_submit
+                   - head.pause_s)
+
     def stats(self) -> dict:
         from znicz_tpu.serving.engine import _percentile
 
@@ -2700,6 +3020,15 @@ class DecodeEngine(Logger):
                 "misses": int(self._m_prefix_miss.value),
                 "shared_tokens": int(self._m_tok_shared.value),
                 "computed_tokens": int(self._m_tok_computed.value),
+                "spilled_nodes": self.prefix.spilled_nodes(),
+                "spill_pages_used": (self._spill.used
+                                     if self._spill else 0),
+                "spill_capacity": (self._spill.capacity
+                                   if self._spill else 0),
+                "migrations": {
+                    "spill": int(self._m_mig_spill.value),
+                    "restore": int(self._m_mig_restore.value),
+                },
             } if self.prefix is not None else None),
             "speculative": ({
                 "draft_k": self.spec_k,

@@ -1,0 +1,835 @@
+"""Disaggregated decode serving: independent prefill and decode pools
+with a page-table handoff (round 22, ROADMAP item 4).
+
+The fused :class:`~znicz_tpu.serving.decode.DecodeEngine` runs
+admission (compute-bound bucketed prefill) and the token loop
+(memory-bound paged decode) on ONE scheduler thread: a burst of
+prompts runs whole admission waves *between* token steps, so every
+in-flight sequence's inter-token latency absorbs the burst.  DistServe
+(arXiv:2401.09670) and Splitwise (arXiv:2311.18677) measure the same
+interference at datacenter scale and reach the same design: split the
+two phases into separately-scaled replica pools and ship the KV cache
+from prefill to decode.
+
+:class:`DisaggEngine` is that split, TPU-native:
+
+- **One warmed** :class:`~znicz_tpu.serving.decode.DecodeModel` is
+  shared by every worker in both pools.  Programs are pure functions
+  of the cache operands, so each pool replica owns a private
+  same-geometry :class:`~znicz_tpu.serving.decode.PagedKVCache`
+  (:meth:`DecodeModel.make_cache`) and dispatches through the SAME
+  compiled program families — **pool scale-up compiles nothing**
+  (``znicz_xla_compiles_total`` stays flat, the round-12 retrace
+  guard extended to fleets of caches).
+- **Prefill workers** (the prefill :class:`ReplicaGroup`) pop prompts
+  from the shared queue, run the bucketed prompt programs into their
+  private cache — with their own prefix trie + host-DRAM spill tier,
+  so the shareable working set survives past HBM — sample the first
+  token (TTFT stamps here, same admission-eligible clock as the fused
+  engine), then EXPORT the prompt's K/V pages (+ LSTM carry rows) to
+  host memory and hand off.
+- **The handoff** is the contract cross-host disaggregation needs and
+  same-process disaggregation can already exercise: page payloads +
+  first token + sampling state travel as host arrays, land in a
+  decode worker's cache through the pinned staging ring
+  (``memory.PageStager``) and the warmed ``page_in`` scatter, and the
+  token budget reservation rides along (released exactly once at the
+  decode end).  ``GRAFT_CHAOS=1`` drops handoffs in transit
+  (``disagg.handoff_drop``): the request retries on a fresh prefill
+  worker (prefix-hit, so the retry is cheap) with pages reclaimed and
+  the budget still balanced.
+- **Decode workers** accept handoffs between token steps (bounded by
+  their free slots), reserve the full worst-case span up front
+  (fresh private pages — handed-off content is COPIED in, never
+  shared across caches), and run the continuous token loop exactly
+  like the fused engine's ``_step``.
+
+Per-pool telemetry: ``znicz_serving_queue_age_seconds{pool=prefill}``
+is the shared prompt queue's head age (scales the prefill pool),
+``{pool=decode}`` is the oldest unaccepted handoff (scales the decode
+pool) — :class:`~znicz_tpu.serving.fleet.PoolAutoscaler` reads both
+and grows/shrinks each pool independently.  Handoff traffic lands on
+``znicz_kv_page_migrations_total{direction=handoff}`` next to the
+spill tier's ``spill``/``restore``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.resilience import faults as _faults
+from znicz_tpu.serving.batcher import (DeadlineExceeded, Overloaded,
+                                       QueueFull, TokenBudget)
+from znicz_tpu.serving.decode import (DecodeModel, PoolExhausted,
+                                      PrefixCache, _Live,
+                                      _PageSetupMixin, _PromptReq)
+from znicz_tpu.serving.fleet import ReplicaGroup
+from znicz_tpu.utils.logger import Logger
+
+__all__ = ["DisaggEngine", "Handoff"]
+
+#: distinguishes same-named engines in the registry's labels
+_DISAGG_SEQ = itertools.count()
+
+
+class _DisaggReq(_PromptReq):
+    """A queued prompt plus its handoff retry ledger."""
+
+    __slots__ = ("handoff_retries",)
+
+    def __init__(self, *args, **kwargs) -> None:
+        super().__init__(*args, **kwargs)
+        self.handoff_retries = 0
+
+
+class Handoff:
+    """One prefill→decode transfer: the request, its first sampled
+    token, the prompt blocks' K/V pages as HOST arrays (one list of
+    per-pool arrays per block — the cross-host wire format), and the
+    LSTM carry rows when the chain has any.  Host arrays, not device
+    references: the payload must outlive the prefill worker's cache
+    (its pages are released the moment the export lands) and must be
+    shippable over a heartbeat channel later."""
+
+    __slots__ = ("req", "first_token", "pages", "carries")
+
+    def __init__(self, req: _DisaggReq, first_token: int,
+                 pages: list, carries: list | None) -> None:
+        self.req = req
+        self.first_token = int(first_token)
+        self.pages = pages
+        self.carries = carries
+
+    @property
+    def n_pages(self) -> int:
+        return len(self.pages)
+
+
+class _PrefillWorker(_PageSetupMixin, Logger):
+    """One prefill-pool replica: private cache + prefix trie + spill
+    tier, serving one prompt at a time off the parent's shared queue.
+    No ``__slots__``: :class:`ReplicaGroup` assigns replica identity
+    attributes."""
+
+    def __init__(self, parent: "DisaggEngine", wid: int) -> None:
+        super().__init__()
+        self.parent = parent
+        self.wid = wid
+        self.model = parent.model
+        self.cache = parent.model.make_cache()
+        self._obs_id = parent._obs_id
+        self.prefix = (PrefixCache(parent.model.page_tokens)
+                       if parent.prefix_cache_enabled else None)
+        self._spill = None
+        if self.prefix is not None and parent.spill_pages > 0:
+            from znicz_tpu.memory import HostPageTier
+            self._spill = HostPageTier(parent.model.page_shapes(),
+                                       parent.spill_pages)
+        # pool workers feed the ENGINE's canonical children — the
+        # fleet reads one engine id, not one per replica
+        self._m_prefix_hit = parent._m_prefix_hit
+        self._m_prefix_miss = parent._m_prefix_miss
+        self._m_tok_shared = parent._m_tok_shared
+        self._m_tok_computed = parent._m_tok_computed
+        self._m_mig_spill = parent._m_mig_spill
+        self._m_mig_restore = parent._m_mig_restore
+        self.served = 0
+        self.breaker_state = "closed"
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    def _kv_cache(self):
+        return self.cache
+
+    def start(self) -> "_PrefillWorker":
+        self._thread = threading.Thread(
+            target=self._loop, name=f"prefill-w{self.wid}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        with self.parent._cond:
+            self._stop = True
+            self.parent._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        if self._spill is not None:
+            self._spill.shutdown()
+
+    def _loop(self) -> None:
+        parent = self.parent
+        while True:
+            with parent._cond:
+                while not self._stop and not parent._prefill_q:
+                    parent._cond.wait(0.05)
+                if not parent._prefill_q:
+                    if self._stop:
+                        return  # drained: a shrink loses no request
+                    continue
+                req = parent._prefill_q.popleft()
+            now = time.monotonic()
+            if req.expired(now):
+                # TTFT deadline passed while queued: fail fast, the
+                # prompt never costs a prefill
+                parent._refund(req)
+                parent._m_rejected.inc()
+                waited_ms = (now - req.t_submit - req.pause_s) * 1e3
+                if not req.future.done():
+                    req.future.set_exception(DeadlineExceeded(
+                        f"TTFT deadline passed after {waited_ms:.0f}"
+                        f"ms in the prefill queue"))
+                continue
+            self._serve(req)
+
+    def _serve(self, req: _DisaggReq) -> None:
+        parent = self.parent
+        model = self.model
+        cache = self.cache
+        slot = cache.acquire()
+        try:
+            # prompt blocks only (max_new=0): the decode worker owns
+            # the generation span's reservation — page pressure here
+            # is prefix-trie pressure, absorbed by spill + eviction
+            matched = self._setup_pages(slot, req.tokens, 0)
+            logits = model.run_prefill(req.tokens[matched:], slot,
+                                       matched, cache=cache)
+        except Exception as exc:  # noqa: BLE001 — isolate the prompt
+            cache.release_slot_pages(slot)
+            cache.release(slot)
+            parent._refund(req)
+            parent._m_rejected.inc()
+            self.warning("prefill failed: %s", exc)
+            if not req.future.done():
+                req.future.set_exception(exc)
+            return
+        if self.prefix is not None:
+            self.prefix.insert(req.tokens, cache.tables[slot], cache)
+        token = parent._sample(logits, self._rng())
+        ttft = time.monotonic() - req.t_submit - req.pause_s
+        req.future.ttft_s = ttft
+        parent._m_ttft.observe(ttft)
+        parent._ttft_win.append(ttft)
+        parent._m_tok_prompt.inc(req.n)
+        parent._m_tok_gen.inc()
+        self.served += 1
+        if (parent.eos_token is not None
+                and token == parent.eos_token) or req.max_new <= 1:
+            cache.release_slot_pages(slot)
+            cache.release(slot)
+            parent._refund(req)
+            parent._m_served.inc()
+            if not req.future.done():
+                req.future.set_result(np.asarray([token], np.int32))
+            return
+        # export the prompt's K/V to host arrays — the handoff
+        # payload — then drop this cache's references (trie pins
+        # keep shareable blocks resident for the NEXT prompt)
+        nblocks = -(-req.n // model.page_tokens)
+        pages = [model.export_page(int(cache.tables[slot, b]),
+                                   cache=cache)
+                 for b in range(nblocks)]
+        carries = (model.export_carry(slot, cache=cache)
+                   if model.has_lstm else None)
+        cache.release_slot_pages(slot)
+        cache.release(slot)
+        parent._route_handoff(Handoff(req, token, pages, carries))
+
+    def _rng(self):
+        return self.parent._worker_rng(self.wid)
+
+
+class _DecodeWorker(Logger):
+    """One decode-pool replica: private cache, an inbox of pending
+    handoffs, and the continuous token loop.  Handoffs are accepted
+    between steps, bounded by free slots — exactly the fused engine's
+    admission point, minus the prefill work."""
+
+    def __init__(self, parent: "DisaggEngine", wid: int) -> None:
+        super().__init__()
+        self.parent = parent
+        self.wid = wid
+        self.model = parent.model
+        self.cache = parent.model.make_cache()
+        self.inbox: deque = deque()
+        self._live: list[_Live] = []
+        self.served = 0
+        self.breaker_state = "closed"
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "_DecodeWorker":
+        self._thread = threading.Thread(
+            target=self._loop, name=f"decode-w{self.wid}",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        with self.parent._cond:
+            self._stop = True
+            self.parent._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def inbox_age(self) -> float:
+        """Age of the oldest unaccepted handoff (the decode pool's
+        scaling signal) — racy peek, scrape-tolerant."""
+        try:
+            h = self.inbox[0]
+        except IndexError:
+            return 0.0
+        return max(0.0, time.monotonic() - h.req.t_submit
+                   - h.req.pause_s)
+
+    def _loop(self) -> None:
+        parent = self.parent
+        while True:
+            intake: list[Handoff] = []
+            with parent._cond:
+                if not self.inbox and not self._live:
+                    if self._stop:
+                        return
+                    parent._cond.wait(0.05)
+                free = self.cache.free_slots
+                while self.inbox and len(intake) < free:
+                    intake.append(self.inbox.popleft())
+            for h in intake:
+                self._accept(h)
+            if self._live:
+                self._step()
+
+    def _accept(self, h: Handoff) -> None:
+        """Land one handoff: reserve the FULL worst-case span in
+        fresh private pages (handed-off content is copied, never
+        shared across caches), upload the payload through the staging
+        ring, and join the live batch."""
+        parent = self.parent
+        model = self.model
+        cache = self.cache
+        req = h.req
+        slot = cache.acquire()
+        span = min(req.n + req.max_new, model.max_t)
+        nblocks = -(-span // model.page_tokens)
+        try:
+            for b in range(nblocks):
+                cache.new_block(slot, b)
+        except PoolExhausted:
+            cache.release_slot_pages(slot)
+            cache.release(slot)
+            with parent._cond:
+                if self._live:
+                    # draining lanes will free pages: retry next tick
+                    self.inbox.appendleft(h)
+                    return
+            # an empty cache cannot hold it — ever
+            parent._refund(req)
+            parent._m_rejected.inc()
+            if not req.future.done():
+                req.future.set_exception(PoolExhausted(
+                    f"handoff of {req.n} prompt tokens cannot fit "
+                    f"the decode pool ({cache.pool_pages} pages)"))
+            return
+        for b, pages in enumerate(h.pages):
+            dev = parent._stager.upload(pages)
+            model.page_in(dev, int(cache.tables[slot, b]),
+                          cache=cache)
+        if h.carries is not None:
+            rows = parent._carry_stager.upload(h.carries)
+            model.carry_in(rows, slot, cache=cache)
+        parent._m_mig_handoff.inc(h.n_pages)
+        self._live.append(_Live(req, slot, h.first_token))
+
+    def _finish(self, s: _Live) -> None:
+        self.cache.release_slot_pages(s.slot)
+        self.cache.release(s.slot)
+        parent = self.parent
+        parent._refund(s.req)
+        parent._m_served.inc()
+        self.served += 1
+        if not s.req.future.done():
+            s.req.future.set_result(
+                np.asarray(s.generated, np.int32))
+
+    def _fail(self, s: _Live, exc: Exception) -> None:
+        self.cache.release_slot_pages(s.slot)
+        self.cache.release(s.slot)
+        self.parent._refund(s.req)
+        if not s.req.future.done():
+            s.req.future.set_exception(exc)
+
+    def _step(self) -> None:
+        parent = self.parent
+        live = self._live
+        tokens = np.asarray([s.generated[-1] for s in live], np.int32)
+        slots = np.asarray([s.slot for s in live], np.int32)
+        positions = np.asarray([s.pos for s in live], np.int32)
+        try:
+            logits = self.model.run_decode(tokens, slots, positions,
+                                           cache=self.cache)
+        except Exception as exc:  # noqa: BLE001 — the step is shared
+            self.warning("decode step failed for %d lanes: %s",
+                         len(live), exc)
+            for s in live:
+                self._fail(s, exc)
+            self._live = []
+            return
+        now = time.monotonic()
+        rng = parent._worker_rng(self.wid)
+        still: list[_Live] = []
+        for i, s in enumerate(live):
+            token = parent._sample(logits[i], rng)
+            dt = now - s.t_last
+            s.t_last = now
+            s.pos += 1
+            s.generated.append(int(token))
+            parent._m_token.observe(dt)
+            parent._token_win.append(dt)
+            parent._m_tok_gen.inc()
+            if ((parent.eos_token is not None
+                 and int(token) == parent.eos_token)
+                    or len(s.generated) >= s.req.max_new
+                    or s.pos >= self.model.max_t):
+                self._finish(s)
+            else:
+                still.append(s)
+        self._live = still
+
+
+class DisaggEngine(Logger):
+    """Prefill/decode-disaggregated token server (round 22).
+
+    Same request contract as :class:`DecodeEngine` (``submit`` →
+    future of generated ids, ``generate`` sync, greedy arms
+    token-identical to the numpy oracle), different data plane: a
+    prefill :class:`ReplicaGroup` and a decode :class:`ReplicaGroup`
+    over ONE warmed :class:`DecodeModel`, joined by host-array page
+    handoffs.  See the module docstring for the design; knobs:
+
+    - ``prefill_replicas`` / ``decode_replicas`` — initial pool
+      sizes (``max_*_replicas`` bound the autoscaler);
+    - ``spill_pages`` — per-prefill-worker host-DRAM tier capacity
+      (``engine.kv_spill_pages``; 0 disables the tier);
+    - ``handoff_retry_budget`` — dropped-handoff retries before the
+      request fails (the chaos site ``disagg.handoff_drop``);
+    - ``autoscale`` — run a :class:`~znicz_tpu.serving.fleet.
+      PoolAutoscaler` on a maintenance thread, growing each pool
+      independently from its ``znicz_serving_queue_age_seconds``
+      child.
+    """
+
+    def __init__(self, model, *, prefill_replicas: int = 1,
+                 decode_replicas: int = 1,
+                 max_prefill_replicas: int = 4,
+                 max_decode_replicas: int = 4,
+                 max_slots: int = 4, max_t: int = 64,
+                 max_prompt: int | None = None,
+                 prompt_align: int = 8,
+                 max_new_tokens: int = 32,
+                 eos_token: int | None = None,
+                 temperature: float = 0.0, seed: int = 0,
+                 max_queue: int = 256,
+                 page_tokens: int | None = None,
+                 pool_tokens: int | None = None,
+                 prefix_cache: bool | None = None,
+                 spill_pages: int | None = None,
+                 max_queue_tokens: int | None = None,
+                 handoff_retry_budget: int = 1,
+                 autoscale: bool = False,
+                 queue_age_up_s: float = 0.25,
+                 idle_down_s: float = 5.0,
+                 device=None) -> None:
+        super().__init__()
+        from znicz_tpu.utils.config import root
+        if not isinstance(model, DecodeModel):
+            model = DecodeModel(model, max_slots=max_slots,
+                                max_t=max_t, max_prompt=max_prompt,
+                                prompt_align=prompt_align,
+                                device=device, paged=True,
+                                page_tokens=page_tokens,
+                                pool_tokens=pool_tokens, spec_k=0)
+        if not model.paged:
+            raise ValueError(
+                "disaggregation needs the paged cache: the handoff "
+                "ships pages, a flat cache has none")
+        self.model = model
+        if prefix_cache is None:
+            prefix_cache = bool(root.common.engine.get(
+                "prefix_cache", True))
+        self.prefix_cache_enabled = bool(
+            prefix_cache and not model.has_lstm)
+        if spill_pages is None:
+            spill_pages = int(root.common.engine.get(
+                "kv_spill_pages", 0))
+        self.spill_pages = int(spill_pages)
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token = eos_token
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.max_queue = int(max_queue)
+        self.handoff_retry_budget = max(0, int(handoff_retry_budget))
+        budget = (int(max_queue_tokens) if max_queue_tokens
+                  else 16 * model.pool_tokens)
+        self._token_budget = TokenBudget(budget)
+        wf_name = model.model.manifest.get("workflow", "model")
+        self._obs_id = f"{wf_name}#disagg{next(_DISAGG_SEQ)}"
+        self._m_submitted = _metrics.serving_requests(
+            self._obs_id, "submitted")
+        self._m_served = _metrics.serving_requests(self._obs_id,
+                                                   "served")
+        self._m_rejected = _metrics.serving_requests(self._obs_id,
+                                                     "rejected")
+        self._m_ttft = _metrics.serving_ttft_seconds(self._obs_id)
+        self._m_token = _metrics.serving_token_seconds(self._obs_id)
+        self._m_tok_prompt = _metrics.serving_tokens(self._obs_id,
+                                                     "prompt")
+        self._m_tok_gen = _metrics.serving_tokens(self._obs_id,
+                                                  "generated")
+        self._m_prefix_hit = _metrics.prefix_cache_events(
+            self._obs_id, "hit")
+        self._m_prefix_miss = _metrics.prefix_cache_events(
+            self._obs_id, "miss")
+        self._m_tok_shared = _metrics.prefix_tokens(self._obs_id,
+                                                    "shared")
+        self._m_tok_computed = _metrics.prefix_tokens(self._obs_id,
+                                                      "computed")
+        self._m_mig_spill = _metrics.kv_page_migrations(
+            self._obs_id, "spill")
+        self._m_mig_restore = _metrics.kv_page_migrations(
+            self._obs_id, "restore")
+        self._m_mig_handoff = _metrics.kv_page_migrations(
+            self._obs_id, "handoff")
+        _metrics.kv_spill_pages(self._obs_id).set_function(
+            self._spill_used)
+        _metrics.serving_queue_age_seconds(
+            self._obs_id, pool="prefill").set_function(
+                self._prefill_queue_age)
+        _metrics.serving_queue_age_seconds(
+            self._obs_id, pool="decode").set_function(
+                self._decode_queue_age)
+        self._ttft_win: deque = deque(maxlen=4096)
+        self._token_win: deque = deque(maxlen=4096)
+        self._prefill_q: deque = deque()
+        self._cond = threading.Condition()
+        self._rng_lock = threading.Lock()
+        self._rngs: dict[int, np.random.Generator] = {}
+        self.handoffs_total = 0
+        self.handoff_drops = 0
+        self.handoff_retries_total = 0
+        self.warmup_compiles = 0
+        self.warmup_seconds = 0.0
+        self._started = False
+        self._wid = itertools.count()
+        self.prefill_pool = ReplicaGroup(
+            self._obs_id, "prefill", "v0",
+            lambda: _PrefillWorker(self, next(self._wid)),
+            target=int(prefill_replicas),
+            max_replicas=int(max_prefill_replicas))
+        self.decode_pool = ReplicaGroup(
+            self._obs_id, "decode", "v0",
+            lambda: _DecodeWorker(self, next(self._wid)),
+            target=int(decode_replicas),
+            max_replicas=int(max_decode_replicas))
+        self._stager = None
+        self._carry_stager = None
+        self._autoscaler = None
+        self._maint: threading.Thread | None = None
+        self._maint_stop = threading.Event()
+        if autoscale:
+            from znicz_tpu.serving.fleet import PoolAutoscaler
+            self._autoscaler = PoolAutoscaler(
+                {"prefill": self.prefill_pool,
+                 "decode": self.decode_pool},
+                self._obs_id, queue_age_up_s=queue_age_up_s,
+                idle_down_s=idle_down_s)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> "DisaggEngine":
+        if self._started:
+            return self
+        from znicz_tpu.memory import PageStager
+        t0 = time.monotonic()
+        # ONE warmup serves both pools: every worker cache is
+        # geometry-identical, so the program dicts are shared and a
+        # later scale-up compiles nothing
+        self.warmup_compiles = self.model.warmup(
+            prefix_cache=self.prefix_cache_enabled, page_io=True)
+        self.warmup_seconds = time.monotonic() - t0
+        self._stager = PageStager(self.model.page_shapes())
+        if self.model.has_lstm:
+            self._carry_stager = PageStager(self.model.carry_shapes())
+        self._started = True
+        self.prefill_pool.scale_to(self.prefill_pool.target,
+                                   reason="start")
+        self.decode_pool.scale_to(self.decode_pool.target,
+                                  reason="start")
+        if self._autoscaler is not None:
+            self._maint_stop.clear()
+            self._maint = threading.Thread(
+                target=self._maintenance, name="disagg-autoscale",
+                daemon=True)
+            self._maint.start()
+        self.info(
+            "disagg '%s': %d AOT programs warmed in %.2fs, pools "
+            "prefill:%d + decode:%d (slots=%d/cache, page_tokens=%d, "
+            "prefix_cache=%s, spill_pages=%d/prefill-worker)",
+            self.model.model.manifest.get("workflow", "?"),
+            self.warmup_compiles, self.warmup_seconds,
+            self.prefill_pool.live(), self.decode_pool.live(),
+            self.model.max_slots, self.model.page_tokens,
+            self.prefix_cache_enabled, self.spill_pages)
+        return self
+
+    def shutdown(self, timeout: float = 60.0) -> None:
+        """Drain both pools in dataflow order: prefill workers finish
+        the prompt queue (routing their handoffs), then decode
+        workers finish every inbox and live lane."""
+        if self._maint is not None:
+            self._maint_stop.set()
+            self._maint.join(timeout=10.0)
+            self._maint = None
+        targets = (self.prefill_pool.target, self.decode_pool.target)
+        self.prefill_pool.scale_to(0, reason="shutdown")
+        self.decode_pool.scale_to(0, reason="shutdown")
+        # a later start() restores the configured pool sizes
+        self.prefill_pool.target, self.decode_pool.target = targets
+        if self._stager is not None:
+            self._stager.shutdown()
+            self._stager = None
+        if self._carry_stager is not None:
+            self._carry_stager.shutdown()
+            self._carry_stager = None
+        self._started = False
+
+    def __enter__(self) -> "DisaggEngine":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    def _maintenance(self) -> None:
+        while not self._maint_stop.wait(0.05):
+            self._autoscaler.tick()
+
+    # ------------------------------------------------------------------
+    # request path
+    # ------------------------------------------------------------------
+    def submit(self, prompt, *, max_new_tokens: int | None = None,
+               deadline_ms: float | None = None) -> Future:
+        """Enqueue a prompt; returns a future of the generated ids
+        (first sampled token onward) — the :class:`DecodeEngine`
+        contract.  Token-denominated admission: the queue is bounded
+        by the work it holds, and the reservation travels WITH the
+        request across the handoff (released exactly once wherever
+        the request exits)."""
+        if not self._started:
+            raise RuntimeError("engine not started")
+        tokens = np.ascontiguousarray(prompt, np.int32).reshape(-1)
+        if tokens.shape[0] < 1:
+            raise ValueError("empty prompt")
+        if tokens.shape[0] > self.model.max_prompt:
+            raise ValueError(
+                f"prompt of {tokens.shape[0]} tokens exceeds "
+                f"max_prompt {self.model.max_prompt}")
+        req = _DisaggReq(tokens,
+                         max_new_tokens if max_new_tokens is not None
+                         else self.max_new_tokens, deadline_ms)
+        with self._cond:
+            if len(self._prefill_q) >= self.max_queue:
+                self._m_rejected.inc()
+                raise QueueFull(
+                    f"prefill queue full ({len(self._prefill_q)} "
+                    f"prompts pending, limit {self.max_queue})")
+            want = req.n + req.max_new
+            if not self._token_budget.try_acquire(want):
+                self._m_rejected.inc()
+                raise QueueFull(
+                    f"token budget full ({self._token_budget.used} "
+                    f"of {self._token_budget.capacity} tokens held; "
+                    f"request wants {want})")
+            req.charged = want
+            self._prefill_q.append(req)
+            self._cond.notify_all()
+        self._m_submitted.inc()
+        return req.future
+
+    def generate(self, prompt, timeout: float | None = None,
+                 **kwargs) -> np.ndarray:
+        return self.submit(prompt, **kwargs).result(timeout=timeout)
+
+    def _refund(self, req: _PromptReq) -> None:
+        if req.charged:
+            self._token_budget.release(req.charged)
+            req.charged = 0
+
+    def _sample(self, logits: np.ndarray,
+                rng: np.random.Generator) -> int:
+        if self.temperature <= 0:
+            return int(np.argmax(logits))
+        z = logits / self.temperature
+        z = z - z.max()
+        p = np.exp(z)
+        p /= p.sum()
+        return int(rng.choice(len(p), p=p))
+
+    def _worker_rng(self, wid: int) -> np.random.Generator:
+        with self._rng_lock:
+            rng = self._rngs.get(wid)
+            if rng is None:
+                rng = np.random.default_rng(self.seed * 1009 + wid)
+                self._rngs[wid] = rng
+            return rng
+
+    # ------------------------------------------------------------------
+    # the handoff (prefill worker thread → decode worker inbox)
+    # ------------------------------------------------------------------
+    def _route_handoff(self, h: Handoff) -> None:
+        req = h.req
+        if _faults.fire("disagg.handoff_drop") is not None:
+            # the payload is lost in transit: the prefill worker
+            # already released its pages, so recovery = redo the
+            # prefill (a prefix HIT now — its trie kept the blocks)
+            self.handoff_drops += 1
+            if req.handoff_retries >= self.handoff_retry_budget:
+                self._refund(req)
+                self._m_rejected.inc()
+                if not req.future.done():
+                    req.future.set_exception(_faults.FaultInjected(
+                        f"handoff dropped {req.handoff_retries + 1} "
+                        f"times (retry budget "
+                        f"{self.handoff_retry_budget})"))
+                return
+            req.handoff_retries += 1
+            self.handoff_retries_total += 1
+            _metrics.recoveries("handoff_retry").inc()
+            self.warning(
+                "handoff dropped (chaos) — retrying prompt of %d "
+                "tokens on a fresh prefill (%d/%d)", req.n,
+                req.handoff_retries, self.handoff_retry_budget)
+            with self._cond:
+                # front of the queue: the reservation is still held,
+                # the work is still pending (round-16 retry contract)
+                self._prefill_q.appendleft(req)
+                self._cond.notify_all()
+            return
+        worker = self.decode_pool.pick()
+        if worker is None:
+            self._refund(req)
+            self._m_rejected.inc()
+            if not req.future.done():
+                req.future.set_exception(Overloaded(
+                    "no live decode replica to accept the handoff"))
+            return
+        with self._cond:
+            worker.inbox.append(h)
+            self.handoffs_total += 1
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # telemetry
+    # ------------------------------------------------------------------
+    def _prefill_queue_age(self) -> float:
+        try:
+            req = self._prefill_q[0]
+        except IndexError:
+            return 0.0
+        return max(0.0, time.monotonic() - req.t_submit - req.pause_s)
+
+    def _decode_queue_age(self) -> float:
+        return max((w.inbox_age()
+                    for w in self.decode_pool.engines()),
+                   default=0.0)
+
+    def _spill_used(self) -> int:
+        return sum(w._spill.used for w in self.prefill_pool.engines()
+                   if getattr(w, "_spill", None) is not None)
+
+    @property
+    def breaker_state(self) -> str:
+        return "closed"
+
+    def ready(self) -> bool:
+        return bool(self._started and self.prefill_pool.live()
+                    and self.decode_pool.live())
+
+    def balanced(self) -> bool:
+        """Exactly-once accounting across submit → prefill → handoff
+        → decode: true when idle with every reservation returned."""
+        return self._token_budget.balanced()
+
+    def stats(self) -> dict:
+        from znicz_tpu.serving.engine import _percentile
+
+        def window(win):
+            vals = sorted(win)
+            if not vals:
+                return {}
+            return {"p50": round(1e3 * _percentile(vals, 50), 3),
+                    "p95": round(1e3 * _percentile(vals, 95), 3),
+                    "p99": round(1e3 * _percentile(vals, 99), 3),
+                    "mean": round(1e3 * sum(vals) / len(vals), 3),
+                    "window": len(vals)}
+
+        return {
+            "engine": "decode-disagg",
+            "pools": {
+                "prefill": {"live": self.prefill_pool.live(),
+                            "target": self.prefill_pool.target,
+                            "queue_age_s": round(
+                                self._prefill_queue_age(), 4)},
+                "decode": {"live": self.decode_pool.live(),
+                           "target": self.decode_pool.target,
+                           "queue_age_s": round(
+                               self._decode_queue_age(), 4)},
+            },
+            "handoffs": {
+                "total": self.handoffs_total,
+                "dropped": self.handoff_drops,
+                "retried": self.handoff_retries_total,
+                "pages_moved": int(self._m_mig_handoff.value),
+            },
+            "prefix_cache": ({
+                "hits": int(self._m_prefix_hit.value),
+                "misses": int(self._m_prefix_miss.value),
+                "shared_tokens": int(self._m_tok_shared.value),
+                "computed_tokens": int(self._m_tok_computed.value),
+                "spill_pages_used": self._spill_used(),
+                "spill_capacity": self.spill_pages,
+                "migrations": {
+                    "spill": int(self._m_mig_spill.value),
+                    "restore": int(self._m_mig_restore.value),
+                },
+            } if self.prefix_cache_enabled else None),
+            "programs_compiled": self.model.compile_count,
+            "warmup_seconds": round(self.warmup_seconds, 3),
+            "submitted": int(self._m_submitted.value),
+            "served": int(self._m_served.value),
+            "rejected": int(self._m_rejected.value),
+            "queued_prompts": len(self._prefill_q),
+            "ttft_ms": window(self._ttft_win),
+            "token_ms": window(self._token_win),
+            "token_budget": {
+                "capacity": self._token_budget.capacity,
+                "used": self._token_budget.used,
+                "over_released": self._token_budget.over_released,
+            },
+        }
+
+    def serving_status(self) -> dict:
+        """``web_status.gather_status`` hook."""
+        out = {"name": f"disagg:{self.model.model.manifest.get('workflow', '?')}",
+               "initialized": self._started,
+               "stopped": not self._started}
+        out.update(self.stats())
+        return out

@@ -95,6 +95,20 @@ def _percentile(sorted_vals: list[float], q: float) -> float:
     return sorted_vals[idx]
 
 
+def window_p99(win, n0: int = 0) -> float:
+    """p99 of a latency window's tail, skipping the first ``n0``
+    samples.
+
+    The per-pass slice the serve bench and the disagg dryrun use to
+    compare warmed passes: snapshot ``len(win)`` before a pass, then
+    take the p99 of only the observations that pass appended, so
+    cold-start and earlier-pass samples never pollute the comparison.
+    ``win`` is any iterable of latencies (typically an engine's
+    bounded ``_token_win`` deque)."""
+    tail = sorted(list(win)[n0:])
+    return _percentile(tail, 99.0)
+
+
 class ServingEngine(Logger):
     """Continuous-batching server over an exported forward chain.
 

@@ -87,7 +87,7 @@ from znicz_tpu.serving.batcher import (_CLOSED, _HALF_OPEN, _OPEN,
 from znicz_tpu.utils.logger import Logger
 
 __all__ = ["FleetEngine", "TenantClass", "ReplicaGroup",
-           "SharedLadderBudget", "FleetAutoscaler"]
+           "SharedLadderBudget", "FleetAutoscaler", "PoolAutoscaler"]
 
 #: distinguishes same-process fleets in the registry's labels
 _FLEET_SEQ = itertools.count()
@@ -1041,9 +1041,13 @@ class FleetAutoscaler:
     - **down** — the group has been idle (zero queue age and no new
       served work) for ``idle_down_s`` and live > min_replicas.
 
-    Decode groups participate in repair only: their slot occupancy is
-    already the KV-pool's admission currency and replicas are not
-    compile-free there (each carries its own cache + programs)."""
+    Decode groups participate in repair only: a FUSED decode engine's
+    slot occupancy is already the KV-pool's admission currency and a
+    full extra engine re-plans its own programs.  The round-22
+    disaggregated engine lifts that limit — its pool replicas share
+    ONE warmed :class:`~znicz_tpu.serving.decode.DecodeModel` and
+    scale compile-free; :class:`PoolAutoscaler` below is the
+    per-pool (prefill/decode) scaler that exploits it."""
 
     def __init__(self, fleet: FleetEngine, *,
                  queue_age_up_s: float = 0.25,
@@ -1153,4 +1157,103 @@ class FleetAutoscaler:
             events.append(f"scaled {model.model_id}@{v.label} down → "
                           f"{group.live()} (idle)")
             self._last_scale[gkey] = now
+        return events
+
+
+class PoolAutoscaler:
+    """Per-pool replica autoscaling for a disaggregated serving
+    engine (round 22).
+
+    ``pools`` maps a pool name (``"prefill"`` / ``"decode"``) to its
+    :class:`ReplicaGroup`; the scaling signal is that pool's child of
+    ``znicz_serving_queue_age_seconds{engine=<engine_id>,
+    pool=<name>}`` — prefill reads the shared prompt queue's head
+    age, decode the oldest unaccepted handoff — so a prompt burst
+    grows the prefill pool without touching decode residency, and a
+    handoff backlog grows decode without spending prefill compute.
+
+    Unlike :class:`FleetAutoscaler`'s decode caveat, these replicas
+    ARE compile-free: every pool worker shares one warmed
+    :class:`~znicz_tpu.serving.decode.DecodeModel` and owns only a
+    private same-geometry cache (:meth:`DecodeModel.make_cache`), so
+    a scale-up costs cache allocation, not XLA compiles.
+
+    Per pool each :meth:`tick`: **repair** when live < target;
+    **up** when the pool's queue age exceeds ``queue_age_up_s`` and
+    live < max_replicas; **down** after ``idle_down_s`` of zero queue
+    age and no new served work, to ``min_replicas``."""
+
+    def __init__(self, pools: dict[str, ReplicaGroup],
+                 engine_id: str, *,
+                 queue_age_up_s: float = 0.25,
+                 idle_down_s: float = 5.0,
+                 min_replicas: int = 1,
+                 cooldown_s: float = 0.5) -> None:
+        self.pools = dict(pools)
+        self.engine_id = engine_id
+        self.queue_age_up_s = float(queue_age_up_s)
+        self.idle_down_s = float(idle_down_s)
+        self.min_replicas = int(min_replicas)
+        self.cooldown_s = float(cooldown_s)
+        self._last_scale: dict[str, float] = {}
+        self._last_busy: dict[str, float] = {}
+        self._last_served: dict[str, int] = {}
+
+    def _pool_age(self, pool: str) -> float:
+        fam = _metrics.REGISTRY.get("znicz_serving_queue_age_seconds")
+        if fam is None:
+            return 0.0
+        for key, child in fam.items():
+            if key[0] == self.engine_id and key[1] == pool:
+                return float(child.value)
+        return 0.0
+
+    def tick(self) -> list[str]:
+        events: list[str] = []
+        now = time.monotonic()
+        for name, group in self.pools.items():
+            events.extend(self._tick_pool(name, group, now))
+        return events
+
+    def _tick_pool(self, name: str, group: ReplicaGroup,
+                   now: float) -> list[str]:
+        events: list[str] = []
+        live = group.live()
+        if live < group.target:
+            group.scale_to(group.target, reason="repair")
+            _metrics.fleet_scale_events(
+                self.engine_id, f"{self.engine_id}@{name}",
+                "repair").inc()
+            _metrics.recoveries("replica_respawn").inc()
+            events.append(f"repaired pool {name} → "
+                          f"{group.live()} replicas")
+            self._last_scale[name] = now
+            return events
+        age = self._pool_age(name)
+        served = sum(int(getattr(e, "served", 0))
+                     for e in group.engines())
+        busy = age > 0.0 or served != self._last_served.get(name, -1)
+        self._last_served[name] = served
+        if busy:
+            self._last_busy[name] = now
+        if now - self._last_scale.get(name, 0.0) < self.cooldown_s:
+            return events
+        if age > self.queue_age_up_s and live < group.max_replicas:
+            group.scale_to(live + 1, reason="up")
+            _metrics.fleet_scale_events(
+                self.engine_id, f"{self.engine_id}@{name}",
+                "up").inc()
+            events.append(f"scaled pool {name} up → {group.live()} "
+                          f"(queue_age={age:.2f}s)")
+            self._last_scale[name] = now
+        elif (live > self.min_replicas
+              and now - self._last_busy.get(name, now)
+              > self.idle_down_s):
+            group.scale_to(live - 1, reason="down")
+            _metrics.fleet_scale_events(
+                self.engine_id, f"{self.engine_id}@{name}",
+                "down").inc()
+            events.append(f"scaled pool {name} down → "
+                          f"{group.live()} (idle)")
+            self._last_scale[name] = now
         return events

@@ -255,9 +255,13 @@ class WebStatusServer(Logger):
         max_age = root.common.engine.get("ready_max_queue_age_s", None)
         if fam is not None:
             for key, child in fam.items():
-                (engine,) = key
+                # ("engine",) pre-round-22 children, ("engine","pool")
+                # after — /readyz watches the WORST pool per engine
+                engine = key[0]
                 age = round(float(child.value), 3)
-                out["engines"].setdefault(engine, {})["queue_age_s"] = age
+                prior = out["engines"].setdefault(engine, {})
+                age = max(age, prior.get("queue_age_s", 0.0))
+                prior["queue_age_s"] = age
                 if max_age is not None and age > float(max_age):
                     not_ready(f"queue age {age:.1f}s on engine "
                               f"{engine}")
