@@ -385,6 +385,50 @@ def drill_sdc_serving_bitflip() -> dict:
             "corrected_reply": True}
 
 
+def drill_aotcache_corrupt() -> dict:
+    """Rot a persisted AOT executable between sidecar write and
+    cold-start read: the digest gate must quarantine the entry, count
+    ``recoveries{aotcache_fallback}``, and the site must fall back to
+    tracing with a reply bitwise-equal to the traced arm."""
+    from znicz_tpu.export import ExportedModel
+    from znicz_tpu.serving import aot_cache as aot
+    from znicz_tpu.utils.config import root
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "aotcache.corrupt"}),
+                ("znicz_aot_cache_total",
+                 {"site": "serving-aot", "outcome": "corrupt"}),
+                ("znicz_recoveries_total",
+                 {"kind": "aotcache_fallback"}))
+    cache_dir = tempfile.mkdtemp(prefix="chaosm_aot_")
+    prev = root.common.engine.aot_cache
+    try:
+        root.common.engine.aot_cache = cache_dir
+        # traced arm — populates the cache and fixes the reference
+        m1 = ExportedModel.load(_serve_bundle(), max_batch=8)
+        m1.warmup()
+        x = np.random.default_rng(5).normal(size=(4, 16)
+                                            ).astype(np.float32)
+        ref = np.asarray(m1(x))
+        # corrupt arm — the first cache read is rotted mid-payload
+        _recipe({"aotcache.corrupt": {"at": [1]}})
+        m2 = ExportedModel.load(_serve_bundle(), max_batch=8)
+        m2.warmup()
+        out = np.asarray(m2(x))
+    finally:
+        root.common.engine.aot_cache = prev
+        aot._caches.clear()
+    assert d[0] == 1, d[0]
+    assert d[1] >= 1, "corrupt entry not quarantined"
+    assert d[2] >= 1, "fallback not counted"
+    assert m2.compile_count >= 1, "no fallback trace happened"
+    assert np.array_equal(ref, out), "fallback reply not bitwise-equal"
+    quarantined = [f for f in os.listdir(cache_dir)
+                   if f.endswith(".quarantined")]
+    assert quarantined, "no quarantined evidence on disk"
+    return {"injected": d[0], "quarantined": int(d[1]),
+            "fallback_recoveries": int(d[2]), "bitwise_equal": True}
+
+
 def drill_snapshot_write_fail() -> dict:
     d = _Deltas(("znicz_faults_injected_total",
                  {"site": "snapshot.write_fail"}),
@@ -750,6 +794,7 @@ DRILLS = {
     "sdc.flip_param": drill_sdc_flip_param,
     "sdc.flip_grad": drill_sdc_flip_grad,
     "sdc.serving_bitflip": drill_sdc_serving_bitflip,
+    "aotcache.corrupt": drill_aotcache_corrupt,
 }
 
 

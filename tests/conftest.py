@@ -30,6 +30,24 @@ import pytest  # noqa: E402
 from znicz_tpu.utils import prng  # noqa: E402
 from znicz_tpu.utils.config import reset_root  # noqa: E402
 
+# Opt-in persisted AOT executable cache for the suite (round 23):
+# ``ZNICZ_TEST_AOT_CACHE=<dir>`` (or ``=1`` for a throwaway per-run
+# dir) points ``ZNICZ_AOT_CACHE`` at a session-scoped store, so every
+# warmup/region compile after the first run deserializes instead of
+# re-tracing — a large wall-clock cut on repeat runs.  Default is OFF:
+# the suite measures tracing behavior unless explicitly asked not to.
+# Tests that assert on compile COUNTERS (test_retrace_guard.py,
+# test_decode.py, test_export_publish.py, test_fleet.py) opt back out
+# per-module via ``root.common.engine.aot_cache = False``.
+_aot_dir = os.environ.get("ZNICZ_TEST_AOT_CACHE")
+if _aot_dir:
+    if _aot_dir in ("1", "true", "yes"):
+        import tempfile
+        _aot_dir = os.path.join(tempfile.gettempdir(),
+                                "znicz_test_aot_cache")
+        os.makedirs(_aot_dir, exist_ok=True)
+    os.environ["ZNICZ_AOT_CACHE"] = _aot_dir
+
 
 @pytest.fixture(autouse=True)
 def fresh_state(tmp_path):

@@ -25,6 +25,18 @@ from znicz_tpu.serving import (DecodeEngine, DecodeModel, Overloaded,
                                QueueFull)
 from znicz_tpu.serving.batcher import DeadlineExceeded
 
+
+@pytest.fixture(autouse=True)
+def _no_aot_cache():
+    """This module pins compile-count baselines (``compile_count``,
+    warm-ladder deltas).  Under the opt-in suite AOT cache
+    (``ZNICZ_TEST_AOT_CACHE``) warmed programs deserialize instead of
+    compiling and those counts legitimately go to zero — so opt out
+    and always exercise the real tracing path."""
+    from znicz_tpu.utils.config import root
+    root.common.engine.aot_cache = False
+    yield
+
 VOCAB = 12
 
 

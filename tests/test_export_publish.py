@@ -15,6 +15,18 @@ from znicz_tpu.models.samples.wine import build, make_data
 from znicz_tpu.utils import prng
 
 
+@pytest.fixture(autouse=True)
+def _no_aot_cache():
+    """This module pins compile-count baselines (``compile_count``,
+    warm-ladder deltas).  Under the opt-in suite AOT cache
+    (``ZNICZ_TEST_AOT_CACHE``) warmed programs deserialize instead of
+    compiling and those counts legitimately go to zero — so opt out
+    and always exercise the real tracing path."""
+    from znicz_tpu.utils.config import root
+    root.common.engine.aot_cache = False
+    yield
+
+
 def train_wine(device, **overrides):
     prng.seed_all(321)
     wf = build(max_epochs=4, **overrides)

@@ -24,6 +24,18 @@ from znicz_tpu.observe import metrics as obs_metrics
 from znicz_tpu.utils import prng
 
 
+@pytest.fixture(autouse=True)
+def _no_aot_cache():
+    """This module MEASURES tracing: every assertion below is a delta
+    on ``znicz_xla_compiles_total``.  Under the opt-in suite AOT cache
+    (``ZNICZ_TEST_AOT_CACHE``) warmed programs deserialize instead of
+    compiling and those deltas legitimately go to zero — so the guard
+    opts out and always exercises the real tracing path."""
+    from znicz_tpu.utils.config import root
+    root.common.engine.aot_cache = False
+    yield
+
+
 def _build_wf(name: str, max_epochs: int = 2,
               chunked: bool = False) -> StandardWorkflow:
     data, labels = make_blobs(24, 3, 10)

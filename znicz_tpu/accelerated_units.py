@@ -321,20 +321,28 @@ class JitRegion(Logger):
             self.debug("region '%s': compiling for key %s "
                        "(%d units, %d leaves)", self.name, key,
                        len(self.units), len(vectors))
-            # compile/retrace counter: the steady-state retrace guard
-            # asserts this stays flat once every variant is warmed.
-            # jit compiles lazily, so the first dispatch rides inside
-            # the compile span — that is where the trace+compile
-            # cost actually lands.
-            _metrics.xla_compiles(f"region:{self.name}").inc()
-            with _tracing.TRACER.span(f"compile:{self.name}",
-                                      cat="compile"):
-                fn = self._cache[key] = self._build(skips, checks)
-                if checks:
-                    err, out = fn(*leaves)
-                    err.throw()
-                else:
-                    out = fn(*leaves)
+            if not checks:  # checkify programs are never persisted
+                fn = self._persisted_program(
+                    ("step",) + key, self.build_callable(skips),
+                    leaves, donate=True)
+            if fn is not None:
+                self._cache[key] = fn
+                out = fn(*leaves)
+            else:
+                # compile/retrace counter: the steady-state retrace
+                # guard asserts this stays flat once every variant is
+                # warmed.  jit compiles lazily, so the first dispatch
+                # rides inside the compile span — that is where the
+                # trace+compile cost actually lands.
+                _metrics.xla_compiles(f"region:{self.name}").inc()
+                with _tracing.TRACER.span(f"compile:{self.name}",
+                                          cat="compile"):
+                    fn = self._cache[key] = self._build(skips, checks)
+                    if checks:
+                        err, out = fn(*leaves)
+                        err.throw()
+                    else:
+                        out = fn(*leaves)
         elif checks:
             err, out = fn(*leaves)
             err.throw()  # located NaN/inf/OOB report, e.g. "nan
@@ -438,7 +446,6 @@ class JitRegion(Logger):
         if fn is None:
             self.debug("region '%s': compiling %d-step scan chunk",
                        self.name, n_steps)
-            _metrics.xla_compiles(f"region:{self.name}").inc()
             body, invariant = self._analyzed_body(
                 self.build_callable(skips), leaves)
 
@@ -447,11 +454,20 @@ class JitRegion(Logger):
                                           n_steps)
                 return tuple(scanned)
 
-            fn = self._cache[key] = jax.jit(
-                chunk_fn, donate_argnums=tuple(range(len(vectors))))
-            with _tracing.TRACER.span(f"compile:{self.name}",
-                                      cat="compile", chunk=n_steps):
-                out = fn(*leaves)  # first dispatch = trace+compile
+            fn = self._persisted_program(("chunk", n_steps) + key,
+                                         chunk_fn, leaves, donate=True)
+            if fn is not None:
+                self._cache[key] = fn
+                out = fn(*leaves)
+            else:
+                _metrics.xla_compiles(f"region:{self.name}").inc()
+                fn = self._cache[key] = jax.jit(
+                    chunk_fn,
+                    donate_argnums=tuple(range(len(vectors))))
+                with _tracing.TRACER.span(f"compile:{self.name}",
+                                          cat="compile",
+                                          chunk=n_steps):
+                    out = fn(*leaves)  # first dispatch = trace+compile
         else:
             # chunked dispatches bypass RegionUnit._fire (bench /
             # run_chunked drive this directly), so the dispatch gets
@@ -557,7 +573,6 @@ class JitRegion(Logger):
         if fn is None:
             self.debug("region '%s': compiling %d-microbatch "
                        "accumulate-then-apply step", self.name, n_micro)
-            _metrics.xla_compiles(f"region:{self.name}").inc()
             accum_body, invariant = self._analyzed_body(
                 self.build_callable(skips,
                                     accum_phase=("accum", n_micro)),
@@ -570,11 +585,23 @@ class JitRegion(Logger):
                                          n_micro - 1)
                 return apply_body(*merged)
 
-            fn = self._cache[key] = jax.jit(
-                accum_fn, donate_argnums=tuple(range(len(vectors))))
-            with _tracing.TRACER.span(f"compile:{self.name}",
-                                      cat="compile", accum=n_micro):
-                out = fn(*leaves)  # first dispatch = trace+compile
+            # the persisted key hashes the jaxpr of the FULL composed
+            # accum+apply function — the accum body alone is blind to
+            # apply-only constants (lr, momentum), which would let a
+            # wrong optimizer step load
+            fn = self._persisted_program(("accum", n_micro) + key,
+                                         accum_fn, leaves, donate=True)
+            if fn is not None:
+                self._cache[key] = fn
+                out = fn(*leaves)
+            else:
+                _metrics.xla_compiles(f"region:{self.name}").inc()
+                fn = self._cache[key] = jax.jit(
+                    accum_fn,
+                    donate_argnums=tuple(range(len(vectors))))
+                with _tracing.TRACER.span(f"compile:{self.name}",
+                                          cat="compile", accum=n_micro):
+                    out = fn(*leaves)  # first dispatch = trace+compile
         else:
             with _tracing.TRACER.span(f"accum:{self.name}",
                                       cat="region", micro=n_micro):
@@ -605,17 +632,91 @@ class JitRegion(Logger):
         if fn is None:
             self.debug("region '%s': compiling undonated variant "
                        "(phase=%s)", self.name, accum_phase)
-            _metrics.xla_compiles(f"region:{self.name}").inc()
-            with _tracing.TRACER.span(f"compile:{self.name}",
-                                      cat="compile"):
-                fn = self._cache[key] = jax.jit(
-                    self.build_callable(skips, accum_phase=accum_phase))
+            fn = self._persisted_program(
+                ("nodonate", accum_phase) + key,
+                self.build_callable(skips, accum_phase=accum_phase),
+                leaves, donate=False)
+            if fn is not None:
+                self._cache[key] = fn
                 out = fn(*leaves)
+            else:
+                _metrics.xla_compiles(f"region:{self.name}").inc()
+                with _tracing.TRACER.span(f"compile:{self.name}",
+                                          cat="compile"):
+                    fn = self._cache[key] = jax.jit(
+                        self.build_callable(skips,
+                                            accum_phase=accum_phase))
+                    out = fn(*leaves)
         else:
             out = fn(*leaves)
         _metrics.region_steps(self.name).inc()
         for vec, leaf in zip(vectors, out):
             vec.devmem = leaf
+
+    def _persisted_program(self, variant: tuple, fn, leaves,
+                           donate: bool):
+        """Resolve one region program variant through the persisted
+        AOT cache (round 23): a deserialized executable on a hit, an
+        eagerly-compiled-and-stored one on a miss.  Returns ``None``
+        when the cache is disabled or the program is not safely
+        keyable — the caller then takes the lazy ``jax.jit`` path,
+        bit-identical to the pre-cache behavior.
+
+        Region bodies bake unit hyperparameters into the trace, so
+        the key is the **jaxpr hash** of the exact function being
+        jitted (plus operand avals, donation, platform, build): the
+        hit path still traces — that is what computes the key — but
+        skips the XLA compile, which is where nearly all cold-start
+        wall-clock lives.  A deserialized load never touches the
+        ``region:<name>`` compile counter."""
+        from znicz_tpu.serving import aot_cache as _aot
+        cache = _aot.active_cache()
+        if cache is None:
+            return None
+        site = f"region:{self.name}"
+        key = _aot.jaxpr_key(fn, leaves,
+                             extra=(site, donate) + tuple(variant))
+        if key is None:
+            return None
+        donate_argnums = tuple(range(len(leaves))) if donate else ()
+        prog = cache.get(key, site)
+        if prog is not None:
+            prog = _aot.guard_donated(prog, donate_argnums)
+        else:
+            _metrics.xla_compiles(site).inc()
+            with _tracing.TRACER.span(f"compile:{self.name}",
+                                      cat="compile"):
+                prog = jax.jit(fn, donate_argnums=donate_argnums).lower(
+                    *leaves).compile()
+            cache.put(key, prog, site,
+                      meta={"family": site,
+                            "variant": [str(v) for v in variant[:2]]})
+        return self._respecialize_guard(prog, fn, donate_argnums, site)
+
+    @staticmethod
+    def _respecialize_guard(prog, fn, donate_argnums, site):
+        """An AOT ``Compiled`` is pinned to the exact input shardings
+        and devices it was lowered with; lazy ``jax.jit`` transparently
+        respecializes when they change between fires (on a mesh the
+        compiler assigns shardings to a step's outputs, which become
+        the next fire's inputs).  Dispatch the fixed program until it
+        rejects its operands, then hand the variant to a lazy jit —
+        bit-identical to the pre-cache behavior, and counted as a real
+        compile."""
+        fallback = None
+
+        def call(*leaves):
+            nonlocal fallback
+            if fallback is None:
+                try:
+                    return prog(*leaves)
+                except ValueError:
+                    _metrics.xla_compiles(site).inc()
+                    fallback = jax.jit(fn,
+                                       donate_argnums=donate_argnums)
+            return fallback(*leaves)
+
+        return call
 
     def _build(self, skips: tuple[bool, ...], checks: bool = False):
         assert self._vectors is not None

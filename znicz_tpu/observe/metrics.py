@@ -386,6 +386,33 @@ def xla_compiles(site: str) -> Counter:
         "serving AOT buckets)", labels=("site",)).labels(site=site)
 
 
+def aot_cache_events(site: str, outcome: str) -> Counter:
+    """Persisted-AOT-cache verdicts by compile site: ``hit`` (an
+    executable deserialized instead of compiled — must NOT move
+    :func:`xla_compiles`), ``miss`` (no entry; the site traced as it
+    always did) and ``corrupt`` (digest/deserialize failure — entry
+    quarantined, site fell back to tracing, paired with a
+    ``recoveries{kind="aotcache_fallback"}`` increment).  The coldstart
+    bench asserts ``hit>0`` with ``znicz_xla_compiles_total`` flat on
+    its warm arm."""
+    return REGISTRY.counter(
+        "znicz_aot_cache_total",
+        "Persisted AOT executable cache lookups by site and outcome "
+        "(hit=deserialized, miss=traced, corrupt=quarantined+traced)",
+        labels=("site", "outcome")).labels(site=site, outcome=outcome)
+
+
+def aot_cache_bytes(cache: str = "local") -> Gauge:
+    """Resident bytes of the persisted AOT executable store (payloads
+    only; sidecars/metadata excluded).  Bounded by
+    ``engine.aot_cache_bytes`` — the store evicts oldest-first past
+    it."""
+    return REGISTRY.gauge(
+        "znicz_aot_cache_bytes",
+        "Bytes of serialized executables resident in the AOT cache",
+        labels=("cache",)).labels(cache=cache)
+
+
 def unit_run_seconds(unit: str) -> Histogram:
     """Per-unit ``run()`` wall time (host control plane)."""
     return REGISTRY.histogram(

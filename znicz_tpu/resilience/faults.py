@@ -174,6 +174,14 @@ SITES = {
         "on a fresh prefill pass with its token-budget reservation "
         "kept, reject it only past the retry budget, and leave the "
         "budget balanced() with every page reclaimed",
+    "aotcache.corrupt":
+        "a persisted AOT executable's payload bytes rot between the "
+        "sha256 sidecar write and the next cold-start read (torn "
+        "write, bit rot, truncated copy) — the cache's digest gate "
+        "must quarantine the entry (renamed aside, never retried), "
+        "count recoveries{aotcache_fallback}, and fall back to "
+        "tracing with outputs bitwise-equal to the traced arm; a "
+        "wrong program must never load",
 }
 
 #: spec keys that steer firing rather than ride the payload

@@ -255,6 +255,12 @@ def publish_bundle(workflow, directory: str,
     source = f"publish:{prefix}"
     _metrics.publishes_total(source).inc()
     mark_artifact_written(source)
+    # round 23: pack the trainer's persisted AOT programs for this
+    # architecture beside the weights (best-effort, after the weights
+    # + sidecar are fully durable) — a scale-out replica or hot-swap
+    # candidate imports them and comes up compile-free
+    from znicz_tpu.serving import aot_cache as _aot
+    _aot.publish_programs(directory, prefix, version, final)
     return version, final
 
 
@@ -320,6 +326,17 @@ class PublicationWatcher(Logger):
             self.version = version
             if fell_back:
                 _metrics.recoveries("publish_fallback").inc()
+            # round 23: import the programs pack published beside the
+            # weights into the local AOT cache BEFORE surfacing the
+            # bundle, so warmup() deserializes instead of compiling.
+            # A corrupt pack is rejected inside import_programs (the
+            # fallback counted); the verified WEIGHTS still surface —
+            # programs are an accelerant, never a gate.
+            from znicz_tpu.serving import aot_cache as _aot
+            imported = _aot.import_programs(path)
+            if imported:
+                self.debug("imported %d published program(s) for v%d",
+                           imported, version)
             return version, path, manifest, params
         return None
 

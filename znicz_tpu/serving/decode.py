@@ -735,6 +735,9 @@ class DecodeModel(Logger):
         self._page_in_program = None
         self._carry_in_program = None
         self.compile_count = 0
+        #: programs DESERIALIZED from the persisted AOT cache (round
+        #: 23) — residency without a trace; never counted as compiles
+        self.load_count = 0
         self.donating = model._donate_choice()
         # the published weight pytree: one immutable tuple-of-tuples
         # (one entry per plan op, None for absent leaves) every
@@ -1191,15 +1194,46 @@ class DecodeModel(Logger):
     # ------------------------------------------------------------------
     # AOT compilation
     # ------------------------------------------------------------------
-    def _compile(self, fn, in_structs: tuple, site: str):
+    def _compile(self, fn, in_structs: tuple, site: str,
+                 family: str | None = None, geom: tuple = ()):
         import jax
         donate = (0,) if self.donating else ()
+        # round 23: the persisted executable store is consulted BEFORE
+        # tracing.  The key covers the program family + bucket
+        # geometry explicitly (two families can share a site), the
+        # bundle's architecture digest, the operand structs, the
+        # decode-plan knobs that shape a body without shaping its
+        # operands, donation, platform and build — any mismatch is a
+        # plain miss and this compiles exactly as before.
+        from znicz_tpu.serving import aot_cache as _aot
+        cache = _aot.active_cache()
+        key = digest = None
+        if cache is not None:
+            family = family or site
+            digest = _aot.program_digest(self.model.manifest)
+            key = _aot.entry_key(
+                family, digest=digest, geometry=geom,
+                structs=in_structs, donate=self.donating,
+                extra=("decode", self.paged, self.page_tokens,
+                       self.kv_quant, str(self.kv_dtype), self.spec_k,
+                       self.max_t, self.vocab))
+            loaded = cache.get(key, site)
+            if loaded is not None:
+                # a deserialized load is NOT a compile — compile_count
+                # and the per-site xla_compiles series stay flat
+                self.load_count += 1
+                return _aot.guard_donated(loaded, donate)
         with _tracing.TRACER.span(f"aot_compile:{site}",
                                   cat="compile"):
             compiled = jax.jit(fn, donate_argnums=donate).lower(
                 *in_structs).compile()
         _metrics.xla_compiles(site).inc()
         self.compile_count += 1
+        if cache is not None:
+            cache.put(key, compiled, site,
+                      meta={"family": family,
+                            "program_digest": digest,
+                            "geometry": [str(g) for g in geom]})
         return compiled
 
     def _cache_structs(self) -> tuple:
@@ -1233,7 +1267,8 @@ class DecodeModel(Logger):
                  jax.ShapeDtypeStruct((1, t_bucket), i32),
                  jax.ShapeDtypeStruct((), i32),
                  jax.ShapeDtypeStruct((), i32)),
-                "serving-prefill")
+                "serving-prefill", family="prefill",
+                geom=(t_bucket,))
             self._prefill_programs[t_bucket] = prog
         return prog
 
@@ -1247,7 +1282,8 @@ class DecodeModel(Logger):
                 self._decode_fn(b_bucket),
                 (self._cache_structs(), self._weight_structs(),
                  vec, vec, vec),
-                "serving-decode")
+                "serving-decode", family="decode",
+                geom=(b_bucket,))
             self._decode_programs[b_bucket] = prog
         return prog
 
@@ -1264,7 +1300,8 @@ class DecodeModel(Logger):
                  jax.ShapeDtypeStruct((1, t_bucket), i32),
                  jax.ShapeDtypeStruct((nb + 1,), i32),
                  scalar, scalar, scalar),
-                "serving-prefill")
+                "serving-prefill", family="paged-prefill",
+                geom=key)
             self._paged_prefill_programs[key] = prog
         return prog
 
@@ -1280,7 +1317,8 @@ class DecodeModel(Logger):
                 (self._cache_structs(), self._weight_structs(),
                  vec, jax.ShapeDtypeStruct((b_bucket, nb + 1), i32),
                  vec, vec),
-                "serving-decode")
+                "serving-decode", family="paged-decode",
+                geom=key)
             self._paged_decode_programs[key] = prog
         return prog
 
@@ -1298,7 +1336,7 @@ class DecodeModel(Logger):
                  jax.ShapeDtypeStruct((b_bucket, w_len), i32),
                  jax.ShapeDtypeStruct((b_bucket, nb + 1), i32),
                  vec, vec),
-                site)
+                site, family="window", geom=key)
             self._verify_programs[key] = prog
         return prog
 
@@ -1316,7 +1354,7 @@ class DecodeModel(Logger):
                 (self._cache_structs(),
                  jax.ShapeDtypeStruct((), i32),
                  jax.ShapeDtypeStruct((), i32)),
-                "serving-page")
+                "serving-page", family="copy")
         return self._copy_program
 
     def page_in_program(self):
@@ -1334,7 +1372,7 @@ class DecodeModel(Logger):
                 self._page_in_fn(),
                 (self._cache_structs(), page_structs,
                  jax.ShapeDtypeStruct((), np.dtype(np.int32))),
-                "serving-page")
+                "serving-page", family="page-in")
         return self._page_in_program
 
     def carry_in_program(self):
@@ -1351,7 +1389,7 @@ class DecodeModel(Logger):
                 self._carry_in_fn(),
                 (self._cache_structs(), row_structs,
                  jax.ShapeDtypeStruct((), np.dtype(np.int32))),
-                "serving-page")
+                "serving-page", family="carry-in")
         return self._carry_in_program
 
     def prompt_ladder(self) -> list[int]:
@@ -1384,14 +1422,19 @@ class DecodeModel(Logger):
         sharing never dispatch them.  ``page_io=True`` (round 22)
         adds the page-in scatter (+ the carry scatter on LSTM
         chains): spill restores and pool handoffs then run
-        compile-free too."""
-        before = self.compile_count
+        compile-free too.
+
+        "Compiled" means MADE RESIDENT: programs deserialized from the
+        persisted AOT cache (round 23) count toward the return value
+        (they satisfy the same zero-compiles-at-serve-time contract)
+        but never toward ``compile_count``."""
+        before = self.compile_count + self.load_count
         if not self.paged:
             for t_b in self.prompt_ladder():
                 self.prefill_program(t_b)
             for b_b in self.batch_ladder():
                 self.decode_program(b_b)
-            return self.compile_count - before
+            return (self.compile_count + self.load_count) - before
         for t_b in self.prompt_ladder():
             for nb in self.block_ladder():
                 if nb < self.fresh_nb(t_b):
@@ -1415,7 +1458,7 @@ class DecodeModel(Logger):
             self.page_in_program()
             if self.has_lstm:
                 self.carry_in_program()
-        return self.compile_count - before
+        return (self.compile_count + self.load_count) - before
 
     @property
     def programs_live(self) -> int:
@@ -3044,6 +3087,9 @@ class DecodeEngine(_PageSetupMixin, Logger):
             "block_buckets": self.model.block_ladder(),
             "programs_compiled": self.model.compile_count
             + (self.drafter.compile_count if self.drafter else 0),
+            "programs_loaded": getattr(self.model, "load_count", 0)
+            + (getattr(self.drafter, "load_count", 0)
+               if self.drafter else 0),
             "programs_live": self.model.programs_live
             + (self.drafter.programs_live if self.drafter else 0),
             "warmup_seconds": round(self.warmup_seconds, 3),
@@ -3084,6 +3130,8 @@ class DecodeEngine(_PageSetupMixin, Logger):
                 "over_released": self._token_budget.over_released,
             } if self._token_budget is not None else None),
         }
+        from . import aot_cache as _aot
+        out["aot_cache"] = _aot.status()
         return out
 
     @property

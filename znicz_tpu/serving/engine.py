@@ -577,6 +577,7 @@ class ServingEngine(Logger):
                 "max_delay_ms": self.max_delay_ms,
                 "buckets_warmed": sorted(self._staging),
                 "programs_compiled": self.model.compile_count,
+                "programs_loaded": getattr(self.model, "load_count", 0),
                 "programs_live": len(self.model._programs),
                 "warmup_seconds": round(self.warmup_seconds, 3),
                 "submitted": self.requests_submitted,
@@ -602,6 +603,8 @@ class ServingEngine(Logger):
                         "suspect": self.sdc_suspect,
                         **self._audit_stats},
             }
+        from . import aot_cache as _aot
+        out["aot_cache"] = _aot.status()
         if lat:
             out["latency_ms"] = {
                 "p50": round(1e3 * _percentile(lat, 50), 3),
