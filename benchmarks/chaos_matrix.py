@@ -768,6 +768,40 @@ def drill_checkpoint_signal_corrupt() -> dict:
     return {"injected": d[0], "fallback": os.path.basename(good)}
 
 
+def drill_observe_recorder_stall() -> dict:
+    """Round 24: the flight recorder's journal write stalls (disk
+    full / torn device).  The contract is DEGRADE-TO-COUNTING: the
+    stalled event is dropped (``znicz_flightrecord_dropped_total``),
+    ``record()`` returns False without raising, and the very next
+    event lands in the journal normally — ops journaling may NEVER
+    block or fail a dispatch, swap or restart."""
+    from znicz_tpu.observe import recorder as rec
+    d = _Deltas(("znicz_faults_injected_total",
+                 {"site": "observe.recorder_stall"}),
+                ("znicz_flightrecord_dropped_total", {}),
+                ("znicz_flightrecord_events_total", {"kind": "swap"}))
+    prev = rec._RECORDER  # don't lazy-create just to restore
+    with tempfile.TemporaryDirectory() as tmp:
+        r = rec.FlightRecorder(tmp, segment_events=4)
+        rec.set_recorder(r)
+        try:
+            _recipe({"observe.recorder_stall": {"at": [1]}})
+            dropped_ok = rec.record("swap", engine="cm_rs",
+                                    outcome="promoted", version=1)
+            landed_ok = rec.record("swap", engine="cm_rs",
+                                   outcome="promoted", version=2)
+        finally:
+            rec.set_recorder(prev)
+        assert dropped_ok is False, "stalled write did not report drop"
+        assert landed_ok is True, "recorder did not recover after drop"
+        journal = r.dump_since(0, kinds=["swap"])
+        assert len(journal) == 1 and journal[0]["version"] == 2, journal
+    assert d[0] == 1, f"injected {d[0]} != 1"
+    assert d[1] == 1, f"dropped counter moved {d[1]} != 1"
+    assert d[2] == 1, f"journaled counter moved {d[2]} != 1"
+    return {"injected": d[0], "dropped": d[1], "journaled": d[2]}
+
+
 #: the COMPLETE site → drill registry (test_chaos_matrix pins
 #: coverage against resilience.faults.SITES)
 DRILLS = {
@@ -795,6 +829,7 @@ DRILLS = {
     "sdc.flip_grad": drill_sdc_flip_grad,
     "sdc.serving_bitflip": drill_sdc_serving_bitflip,
     "aotcache.corrupt": drill_aotcache_corrupt,
+    "observe.recorder_stall": drill_observe_recorder_stall,
 }
 
 

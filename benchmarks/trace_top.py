@@ -15,6 +15,17 @@ ZeRO-1 (round 7) update-path traces: the reduce-scatter + all-gather
 pair must show up as comm time halved against the replicated
 all-reduce, not smeared into the fusion names.
 
+``--requests`` (round 24) switches to the REQUEST-trace reader: the
+input is a Chrome-trace JSON from ``/trace.json`` (or
+``SpanTracer.export``), and the summary groups ``cat="request"``
+spans by their ``trace_id`` — one parented span tree per request,
+minted at ``submit()`` and threaded through every hop — then prints
+the per-phase latency decomposition (queue vs prefill vs handoff vs
+decode, p50/p95/p99), outcome counts, event counts (handoff drops,
+breaker sheds, deadline evictions) and the slowest requests with
+their per-phase breakdown.  This is how "where does my p99 live" is
+read off a serving process.
+
 ``--spans`` (round 9) merges a HOST-span file — the
 ``host_spans.trace.json`` that :func:`znicz_tpu.observe.profile_window`
 drops beside the device trace, or any Chrome-trace JSON from
@@ -66,10 +77,12 @@ def classify(name: str) -> str:
 
 
 def parse_argv(argv: list) -> tuple:
-    """``(positional_args, spans_path)`` — ``--spans`` may appear
-    anywhere; its value may be the span file or the profile dir
-    ``profile_window`` wrote (``host_spans.trace.json`` inside)."""
+    """``(positional_args, spans_path, requests_mode)`` — ``--spans``
+    may appear anywhere; its value may be the span file or the profile
+    dir ``profile_window`` wrote (``host_spans.trace.json`` inside).
+    ``--requests`` flips to the request-trace reader (round 24)."""
     spans = None
+    requests_mode = False
     rest: list = []
     i = 0
     while i < len(argv):
@@ -78,10 +91,13 @@ def parse_argv(argv: list) -> tuple:
                 raise SystemExit("--spans requires a path")
             spans = argv[i + 1]
             i += 2
+        elif argv[i] == "--requests":
+            requests_mode = True
+            i += 1
         else:
             rest.append(argv[i])
             i += 1
-    return rest, spans
+    return rest, spans, requests_mode
 
 
 def load_host_spans(path: str) -> tuple:
@@ -146,10 +162,101 @@ def print_span_merge(spans_path: str, device_total: float,
               f"({100 * host_gap / covered:.1f}%)")
 
 
+def _pctl(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile over an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def load_request_trace(path: str) -> list:
+    """``cat="request"`` events from a Chrome-trace JSON file (the
+    ``/trace.json`` page saved to disk, or ``SpanTracer.export``
+    output; a directory means its ``host_spans.trace.json``)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "host_spans.trace.json")
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as fh:
+        data = json.load(fh)
+    return [ev for ev in data.get("traceEvents", [])
+            if (ev.get("args") or {}).get("trace_id")]
+
+
+def summarize_requests(events: list, top: int = 5) -> dict:
+    """Group request-scoped spans by trace_id → per-phase p50/p95/p99
+    decomposition + outcome/event counts.  Returns the summary dict
+    (also printed) so tests and dryruns can assert on it."""
+    by_trace: dict = collections.defaultdict(
+        lambda: {"phases": {}, "events": [], "outcome": None,
+                 "total_ms": None, "name": None})
+    phase_ms: dict = collections.defaultdict(list)
+    outcomes: collections.Counter = collections.Counter()
+    event_counts: collections.Counter = collections.Counter()
+    for ev in events:
+        args = ev.get("args") or {}
+        tid = args["trace_id"]
+        rec = by_trace[tid]
+        dur_ms = ev.get("dur", 0) / 1e3
+        if ev.get("ph") == "X" and int(args.get(
+                "parent_span_id", -1)) == 0:
+            rec["outcome"] = args.get("outcome", "?")
+            rec["total_ms"] = dur_ms
+            rec["name"] = ev.get("name")
+            outcomes[rec["outcome"]] += 1
+        elif ev.get("ph") == "X" and "phase" in args:
+            phase = args["phase"]
+            rec["phases"][phase] = (rec["phases"].get(phase, 0.0)
+                                    + dur_ms)
+            phase_ms[phase].append(dur_ms)
+        elif ev.get("ph") in ("i", "I"):
+            name = ev.get("name", "?")
+            rec["events"].append(name)
+            event_counts[name] += 1
+    print(f"requests: {len(by_trace)} trace(s)  outcomes: "
+          + (", ".join(f"{k}={v}" for k, v in sorted(outcomes.items()))
+             or "-"))
+    print(f"{'phase':>10s} {'count':>7s} {'p50 ms':>9s} "
+          f"{'p95 ms':>9s} {'p99 ms':>9s} {'total ms':>10s}")
+    decomposition: dict = {}
+    for phase in sorted(phase_ms,
+                        key=lambda p: -sum(phase_ms[p])):
+        vals = sorted(phase_ms[phase])
+        row = {"count": len(vals),
+               "p50_ms": round(_pctl(vals, 50), 3),
+               "p95_ms": round(_pctl(vals, 95), 3),
+               "p99_ms": round(_pctl(vals, 99), 3),
+               "total_ms": round(sum(vals), 3)}
+        decomposition[phase] = row
+        print(f"{phase:>10s} {row['count']:7d} {row['p50_ms']:9.3f} "
+              f"{row['p95_ms']:9.3f} {row['p99_ms']:9.3f} "
+              f"{row['total_ms']:10.3f}")
+    for name, count in event_counts.most_common():
+        print(f"    {count:6d}x  {name}")
+    slowest = sorted(
+        ((tid, rec) for tid, rec in by_trace.items()
+         if rec["total_ms"] is not None),
+        key=lambda kv: -kv[1]["total_ms"])[:top]
+    for tid, rec in slowest:
+        phases = "  ".join(f"{p}={ms:.2f}ms" for p, ms in
+                           sorted(rec["phases"].items(),
+                                  key=lambda kv: -kv[1]))
+        print(f"  {tid}: {rec['total_ms']:.2f} ms "
+              f"[{rec['outcome']}] {phases}"
+              + (f"  events={rec['events']}" if rec["events"]
+                 else ""))
+    return {"requests": len(by_trace), "outcomes": dict(outcomes),
+            "phases": decomposition, "events": dict(event_counts)}
+
+
 def main() -> None:
-    args, spans_path = parse_argv(sys.argv[1:])
+    args, spans_path, requests_mode = parse_argv(sys.argv[1:])
     if not args:
         raise SystemExit(__doc__.split("\n\n")[1])
+    if requests_mode:
+        summarize_requests(load_request_trace(args[0]))
+        return
     trace = find_trace(args[0])
     n_steps = int(args[1]) if len(args) > 1 else None
     with gzip.open(trace, "rt") as fh:

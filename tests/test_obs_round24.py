@@ -1,0 +1,289 @@
+"""Round 24 observability: request-scoped tracing, metrics
+federation, and the ops flight recorder.
+
+Pins the three contracts the round is built on:
+
+- :class:`RequestTrace` — one trace_id rides the request object
+  across threads; phases land as parented complete spans in the
+  process tracer; ``phase_begin`` is idempotent (retries are charged
+  to the phase that absorbed them); ``finish`` closes dangling phases
+  and the first outcome wins; the ``NULL_TRACE`` path is a true no-op.
+- :class:`FlightRecorder` — bounded ring of sealed (sha256) JSONL
+  segments; crash-torn tails are skipped, restarts resume the seq
+  monotone, and a stalled write DROPS (counted) instead of raising.
+- :class:`Federator` — child registries/heartbeats fold into
+  ``znicz_fed_*`` gauges with process/pool labels; a dead source ages
+  on its staleness gauge instead of freezing numbers; a failing fold
+  never raises into the maintenance thread.
+
+Plus the ``trace_top.py --requests`` aggregation over a synthetic
+span tree.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from znicz_tpu.observe import metrics as obs_metrics
+from znicz_tpu.observe import tracing as obs_tracing
+from znicz_tpu.observe.federation import FEDERATORS, Federator
+from znicz_tpu.observe.recorder import FlightRecorder
+from znicz_tpu.observe.tracing import (NULL_TRACE, TRACER, RequestTrace,
+                                       adopt_pending_trace,
+                                       new_request_trace,
+                                       set_pending_trace)
+from znicz_tpu.utils.config import root
+
+
+# ----------------------------------------------------------------------
+# request-scoped tracing
+# ----------------------------------------------------------------------
+def _request_events(since: int, trace_id: str) -> list:
+    return [ev for ev in TRACER.to_chrome_trace(since)["traceEvents"]
+            if (ev.get("args") or {}).get("trace_id") == trace_id]
+
+
+def test_request_trace_span_tree():
+    mark = TRACER.mark()
+    tr = RequestTrace("request", model="m", tenant="t")
+    tr.phase_begin("queue")
+    dur = tr.phase_end("queue", engine="e#0")
+    assert dur > 0.0
+    tr.phase_begin("decode")
+    tr.event("fleet_route", version="v1")
+    tr.finish("ok")
+    events = _request_events(mark, tr.trace_id)
+    roots = [ev for ev in events if ev["ph"] == "X"
+             and ev["args"]["parent_span_id"] == 0]
+    assert len(roots) == 1
+    assert roots[0]["args"]["outcome"] == "ok"
+    assert roots[0]["args"]["span_id"] == 1
+    assert roots[0]["args"]["model"] == "m"
+    phases = {ev["args"]["phase"]: ev for ev in events
+              if ev["ph"] == "X" and "phase" in ev["args"]}
+    # finish() closed the dangling decode phase
+    assert set(phases) == {"queue", "decode"}
+    assert all(ev["args"]["parent_span_id"] == 1
+               for ev in phases.values())
+    instants = [ev for ev in events if ev["ph"] in ("i", "I")]
+    assert [ev["name"] for ev in instants] == ["req.fleet_route"]
+    assert tr.phases["queue"] == pytest.approx(dur)
+
+
+def test_request_trace_idempotent_begin_and_unbegun_end():
+    tr = RequestTrace()
+    # a phase that never began closes as a no-op
+    assert tr.phase_end("prefill") == 0.0
+    t0 = obs_tracing.now_us()
+    tr.phase_begin("handoff")
+    tr.phase_begin("handoff")  # retry re-entering keeps the FIRST t0
+    assert tr._phase_t0["handoff"] <= obs_tracing.now_us()
+    first = tr._phase_t0["handoff"]
+    assert first >= t0 - 1e3
+    tr.phase_begin("handoff")
+    assert tr._phase_t0["handoff"] == first
+    assert tr.phase_end("handoff") >= 0.0
+    tr.finish("failed")
+    mark = TRACER.mark()
+    tr.finish("ok")  # idempotent: first outcome won, nothing emitted
+    assert not _request_events(mark, tr.trace_id)
+
+
+def test_null_trace_under_gate():
+    prev = root.common.engine.get("telemetry", True)
+    root.common.engine.telemetry = False
+    try:
+        tr = new_request_trace("request")
+        assert tr is NULL_TRACE
+        tr.phase_begin("queue")
+        assert tr.phase_end("queue") == 0.0
+        tr.event("x")
+        tr.finish("ok")
+    finally:
+        root.common.engine.telemetry = prev
+    assert isinstance(new_request_trace("request"), RequestTrace)
+
+
+def test_pending_trace_adoption_channel():
+    tr = RequestTrace()
+    set_pending_trace(tr)
+    assert adopt_pending_trace() is tr
+    # the pop clears: a later submit on the same thread starts clean
+    assert adopt_pending_trace() is None
+
+
+# ----------------------------------------------------------------------
+# flight recorder
+# ----------------------------------------------------------------------
+def test_flight_recorder_ring_seal_verify(tmp_path):
+    rec = FlightRecorder(str(tmp_path), segment_events=4,
+                         max_segments=2)
+    for i in range(20):
+        assert rec.record("swap", engine="e#0", outcome="promoted",
+                          version=i)
+    names = sorted(os.listdir(tmp_path))
+    segs = [n for n in names if n.endswith(".jsonl")]
+    assert len(segs) <= 3  # ring: max_segments sealed + active
+    v = rec.verify()
+    assert v["sealed_bad"] == 0 and v["sealed_good"] >= 1
+    events = rec.dump_since(0)
+    seqs = [ev["seq"] for ev in events]
+    assert seqs == sorted(seqs)
+    assert seqs[-1] == 20  # newest survives the ring trim
+    # filters: kind + since + limit
+    assert rec.dump_since(18) == events[-2:]
+    assert len(rec.dump_since(0, kinds=["nope"])) == 0
+    assert len(rec.dump_since(0, limit=3)) == 3
+
+
+def test_flight_recorder_restart_resumes_seq(tmp_path):
+    rec = FlightRecorder(str(tmp_path), segment_events=100)
+    rec.record("scale", delta=1)
+    rec.record("scale", delta=2)
+    rec2 = FlightRecorder(str(tmp_path), segment_events=100)
+    rec2.record("scale", delta=3)
+    seqs = [ev["seq"] for ev in rec2.dump_since(0)]
+    assert seqs == sorted(set(seqs))  # monotone across the restart
+    assert seqs[-1] > 2
+
+
+def test_flight_recorder_torn_tail_skipped(tmp_path):
+    rec = FlightRecorder(str(tmp_path), segment_events=100)
+    rec.record("swap", outcome="promoted")
+    seg = os.path.join(str(tmp_path), sorted(os.listdir(tmp_path))[0])
+    with open(seg, "a") as fh:
+        fh.write('{"t": 1.0, "seq": 99, "kind": "tor')  # crash window
+    events = FlightRecorder(str(tmp_path)).dump_since(0)
+    assert [ev["kind"] for ev in events] == ["swap"]
+
+
+def test_flight_recorder_stall_drops_and_recovers(tmp_path):
+    rec = FlightRecorder(str(tmp_path))
+    dropped = obs_metrics.flightrecord_dropped().value
+    root.common.engine.faults = {"observe.recorder_stall": {"at": [1]}}
+    try:
+        assert rec.record("breaker", to="open") is False
+        assert rec.record("breaker", to="closed") is True
+    finally:
+        root.common.engine.faults = None
+    assert obs_metrics.flightrecord_dropped().value == dropped + 1
+    kinds = [ev["to"] for ev in rec.dump_since(0)]
+    assert kinds == ["closed"]  # the stalled event is GONE, not stuck
+
+
+# ----------------------------------------------------------------------
+# metrics federation
+# ----------------------------------------------------------------------
+def test_federator_registry_fold_pool_sentinel():
+    obs_metrics.serving_queue_age_seconds("fedA#0", "prefill").set(1.5)
+    obs_metrics.serving_queue_age_seconds("fedA#0", "decode").set(0.5)
+    obs_metrics.serving_queue_age_seconds("fedB#0").set(2.5)
+    obs_metrics.serving_queue_age_seconds("other#0").set(9.0)
+    obs_metrics.serving_requests("fedA#0", "ok").inc(3)
+
+    def pool_of(engine):
+        if engine == "fedA#0":
+            return ""  # ours: keep the series' own pool label
+        if engine == "fedB#0":
+            return "solo"  # ours: fold under an explicit pool
+        return None  # not ours: skip
+
+    fed = Federator("gang24")
+    try:
+        assert fed.max_age_s() == 0.0  # no sources yet
+        fed.add_registry("self", pool_of=pool_of)
+        assert fed.max_age_s() == float("inf")  # never folded
+        summary = fed.scrape()
+        assert summary["sources_ok"] == 1
+        assert fed.max_age_s() < 5.0
+        fam = obs_metrics.REGISTRY.get("znicz_fed_queue_age_seconds")
+        folded = {key: child.value for key, child in fam.items()
+                  if key[0] == "gang24"}
+        assert folded[("gang24", "self", "prefill")] == 1.5
+        assert folded[("gang24", "self", "decode")] == 0.5
+        assert folded[("gang24", "self", "solo")] == 2.5
+        assert not any(v == 9.0 for v in folded.values())
+        req = obs_metrics.REGISTRY.get("znicz_fed_requests")
+        vals = {key: child.value for key, child in req.items()
+                if key[0] == "gang24"}
+        assert vals[("gang24", "self", "ok")] >= 3.0
+        children = fed.status()["children"]
+        assert "self/prefill" in children and "self/solo" in children
+    finally:
+        fed.close()
+    assert fed not in FEDERATORS
+
+
+def test_federator_dead_source_ages_never_raises():
+    fed = Federator("gang24b")
+    try:
+        fed.add_http("http://127.0.0.1:9/metrics", "dead",
+                     timeout_s=0.2)
+        summary = fed.scrape()  # must not raise
+        assert summary["sources_ok"] == 0
+        assert fed.max_age_s() == float("inf")
+        st = fed.status()["sources"][0]
+        assert st["errors"] == 1 and st["age_s"] is None
+    finally:
+        fed.close()
+
+
+def test_federator_heartbeat_channel(tmp_path):
+    import time as _time
+    for i in range(2):
+        with open(tmp_path / f"hb_{i:04d}.json", "w") as fh:
+            json.dump({"process": i, "step": 10 + i,
+                       "time": _time.time(), "pid": 1}, fh)
+    fed = Federator("gang24c")
+    try:
+        fed.add_heartbeats(str(tmp_path), 3)  # member 2 never wrote
+        summary = fed.scrape()
+        assert summary["children"] == 2
+        fam = obs_metrics.REGISTRY.get("znicz_fed_step")
+        steps = {key[1]: child.value for key, child in fam.items()
+                 if key[0] == "gang24c"}
+        assert steps == {"p0": 10.0, "p1": 11.0}
+        ages = obs_metrics.REGISTRY.get(
+            "znicz_fed_heartbeat_age_seconds")
+        for key, child in ages.items():
+            if key[0] == "gang24c":
+                assert child.value < 60.0
+    finally:
+        fed.close()
+
+
+# ----------------------------------------------------------------------
+# trace_top --requests aggregation
+# ----------------------------------------------------------------------
+def test_trace_top_requests_summary(capsys):
+    from benchmarks.trace_top import summarize_requests
+
+    def span(tid, phase, dur_ms, parent=1, **extra):
+        return {"ph": "X", "name": f"req.{phase}", "dur": dur_ms * 1e3,
+                "args": {"trace_id": tid, "span_id": 2,
+                         "parent_span_id": parent, "phase": phase,
+                         **extra}}
+
+    events = []
+    for i, (tid, out) in enumerate(
+            [("t-1", "ok"), ("t-2", "ok"), ("t-3", "expired")]):
+        events += [span(tid, "queue", 1.0 + i),
+                   span(tid, "decode", 10.0 + i),
+                   {"ph": "X", "name": "request", "dur": 12e3,
+                    "args": {"trace_id": tid, "span_id": 1,
+                             "parent_span_id": 0, "outcome": out}}]
+    events.append({"ph": "i", "name": "req.deadline_evicted",
+                   "args": {"trace_id": "t-3", "span_id": 9,
+                            "parent_span_id": 1}})
+    summary = summarize_requests(events)
+    assert summary["requests"] == 3
+    assert summary["outcomes"] == {"ok": 2, "expired": 1}
+    assert summary["phases"]["queue"]["count"] == 3
+    assert summary["phases"]["decode"]["p99_ms"] == pytest.approx(12.0)
+    assert summary["events"] == {"req.deadline_evicted": 1}
+    printed = capsys.readouterr().out
+    assert "outcomes: expired=1, ok=2" in printed
+    assert "deadline_evicted" in printed

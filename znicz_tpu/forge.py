@@ -232,6 +232,9 @@ class ForgeRegistry(Logger):
         sidecar = f"{path}.sha256"
         if os.path.exists(sidecar):
             os.replace(sidecar, f"{dest}.sha256")
+        from znicz_tpu.observe import recorder as _recorder
+        _recorder.record("bundle_quarantine", model=name,
+                         bundle=os.path.basename(path))
         return dest
 
     def fetch(self, name: str, version: str | None = None) -> str:

@@ -13,6 +13,17 @@ introspection — plotters and ``veles/web_status.py``):
   merged with device traces by ``trace_top.py --spans``.
 - :func:`profile_window` — capture a ``jax.profiler`` device trace +
   the window's host spans around any region.
+- :mod:`znicz_tpu.observe.recorder` (round 24) — the ops flight
+  recorder: a bounded crash-safe JSONL journal of consequential ops
+  events (swaps, canary verdicts, restarts, quarantines, breaker
+  transitions), served at ``/flightrecord``.
+- :mod:`znicz_tpu.observe.federation` (round 24) — gang-level
+  metrics federation: supervisor/fleet scrape loops fold child
+  ``/metrics`` pages, in-process child registries and the heartbeat
+  channel into ``znicz_fed_*`` series with process/pool labels.
+- :class:`RequestTrace` (round 24) — the request-scoped trace
+  context minted at ``submit()`` that rides a request through every
+  hop and renders its life as a parented span tree in /trace.json.
 
 Master gate: ``root.common.engine.telemetry`` (default on;
 near-zero overhead — hot sites check :func:`enabled` first).
@@ -23,10 +34,25 @@ from znicz_tpu.observe.metrics import (  # noqa: F401
     REGISTRY,
     MetricsRegistry,
     enabled,
+    window_p99,
 )
 from znicz_tpu.observe.tracing import (  # noqa: F401
+    NULL_TRACE,
     TRACER,
+    RequestTrace,
     SpanTracer,
+    adopt_pending_trace,
+    new_request_trace,
     now_us,
     profile_window,
+    set_pending_trace,
+)
+from znicz_tpu.observe.recorder import (  # noqa: F401
+    FlightRecorder,
+    get_recorder,
+    record,
+    set_recorder,
+)
+from znicz_tpu.observe.federation import (  # noqa: F401
+    Federator,
 )

@@ -1238,3 +1238,139 @@ def checkpoint_on_signal() -> Counter:
     return REGISTRY.counter(
         "znicz_checkpoint_on_signal_total",
         "Preemption-triggered barriered checkpoints")._solo()
+
+
+# ----------------------------------------------------------------------
+# round 24: correlated observability — exact windowed percentiles as
+# canonical gauges (the number SERVE_BENCH rows print and /metrics
+# exports must be the SAME number), flight-recorder health, and the
+# federated gang-level series the supervisor/fleet scrape loops write
+# ----------------------------------------------------------------------
+def _percentile(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def window_p99(win, n0: int = 0) -> float:
+    """p99 of a latency window's tail, skipping the first ``n0``
+    samples.
+
+    The per-pass slice the serve bench and the dryruns use to compare
+    warmed passes: snapshot ``len(win)`` before a pass, then take the
+    p99 of only the observations that pass appended, so cold-start and
+    earlier-pass samples never pollute the comparison.  ``win`` is any
+    iterable of latencies (typically an engine's bounded phase deque).
+    Promoted here (round 24) from ``serving.engine`` so the bench-side
+    helper and the :func:`phase_p99_seconds` callback gauges are one
+    implementation."""
+    tail = sorted(list(win)[n0:])
+    return _percentile(tail, 99.0)
+
+
+def phase_p99_seconds(engine: str, phase: str) -> Gauge:
+    """Exact windowed p99 of one serving phase (``queue`` /
+    ``prefill`` / ``handoff`` / ``decode`` / ``ttft`` / ``token``) as
+    a live callback gauge over the engine's bounded phase window —
+    the per-phase decomposition of tail latency the disagg split is
+    justified by, readable from ONE scrape instead of a bench
+    stopwatch."""
+    return REGISTRY.gauge(
+        "znicz_phase_p99_seconds",
+        "Exact windowed p99 latency per serving phase",
+        labels=("engine", "phase")).labels(engine=engine, phase=phase)
+
+
+def trace_requests(engine: str, outcome: str) -> Counter:
+    """Request traces closed per engine by outcome (``ok`` / ``shed``
+    / ``expired`` / ``failed``) — the denominator for /trace.json
+    request-tree sampling (the span ring is bounded; this counter is
+    not)."""
+    return REGISTRY.counter(
+        "znicz_trace_requests_total",
+        "Request-scoped traces finished, by outcome",
+        labels=("engine", "outcome")).labels(engine=engine,
+                                             outcome=outcome)
+
+
+def flightrecord_events(kind: str) -> Counter:
+    """Ops events journaled by the flight recorder, by kind (swap,
+    canary, breaker, restart, quarantine, autoscale, ...)."""
+    return REGISTRY.counter(
+        "znicz_flightrecord_events_total",
+        "Flight-recorder events journaled, by kind",
+        labels=("kind",)).labels(kind=kind)
+
+
+def flightrecord_dropped() -> Counter:
+    """Flight-recorder events DROPPED because the journal write
+    stalled or failed (disk full, torn device, injected
+    ``observe.recorder_stall``) — telemetry degrades to counting
+    here and never blocks a dispatch or a swap."""
+    return REGISTRY.counter(
+        "znicz_flightrecord_dropped_total",
+        "Flight-recorder events dropped on journal write "
+        "stall/failure")._solo()
+
+
+def fed_sources(gang: str) -> Gauge:
+    """Child sources (worker /metrics endpoints, in-process child
+    registries, heartbeat channels) a federator folds per scrape."""
+    return REGISTRY.gauge(
+        "znicz_fed_sources",
+        "Sources folded into the federated gang-level scrape",
+        labels=("gang",)).labels(gang=gang)
+
+
+def fed_scrape_age_seconds(gang: str, source: str) -> Gauge:
+    """Seconds since ``source`` was last folded successfully (live
+    callback gauge) — the federated view's staleness bound: a child
+    whose exporter died shows up HERE, not as silently frozen
+    numbers."""
+    return REGISTRY.gauge(
+        "znicz_fed_scrape_age_seconds",
+        "Staleness of each federated source's last successful fold",
+        labels=("gang", "source")).labels(gang=gang, source=source)
+
+
+def fed_queue_age_seconds(gang: str, process: str, pool: str) -> Gauge:
+    """Federated copy of each child's oldest-pending-request age,
+    labeled by process AND pool — one scrape answers 'which pool is
+    backed up on which host'."""
+    return REGISTRY.gauge(
+        "znicz_fed_queue_age_seconds",
+        "Federated per-child serving queue age by process and pool",
+        labels=("gang", "process", "pool")).labels(
+        gang=gang, process=process, pool=pool)
+
+
+def fed_requests(gang: str, process: str, event: str) -> Gauge:
+    """Federated snapshot of each child's request lifecycle counters
+    (summed over that child's engines) — a gauge, not a counter: the
+    federator republishes the child's last-seen totals."""
+    return REGISTRY.gauge(
+        "znicz_fed_requests",
+        "Federated per-child serving request totals by event",
+        labels=("gang", "process", "event")).labels(
+        gang=gang, process=process, event=event)
+
+
+def fed_heartbeat_age_seconds(gang: str, process: str) -> Gauge:
+    """Federated heartbeat staleness per gang member (fed from the
+    supervisor's heartbeat channel fold)."""
+    return REGISTRY.gauge(
+        "znicz_fed_heartbeat_age_seconds",
+        "Federated seconds since each gang member's last heartbeat",
+        labels=("gang", "process")).labels(gang=gang, process=process)
+
+
+def fed_step(gang: str, process: str) -> Gauge:
+    """Federated per-member step counter — 'which host is slow' read
+    straight off the spread of this family's children."""
+    return REGISTRY.gauge(
+        "znicz_fed_step",
+        "Federated per-member training/serving step counter",
+        labels=("gang", "process")).labels(gang=gang, process=process)

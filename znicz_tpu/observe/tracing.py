@@ -32,6 +32,7 @@ lookup per span.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -51,6 +52,13 @@ def now_us() -> float:
     return (time.perf_counter() - _EPOCH) * 1e6
 
 
+#: True while a :func:`profile_window` device trace is open — the ONLY
+#: time a host span pays for a ``jax.profiler.TraceAnnotation`` (there
+#: is nobody to see the annotation otherwise, and the decode token
+#: loop opens a span per step, so the idle cost is a hot-path tax)
+_DEVICE_TRACE_OPEN = False
+
+
 def _trace_annotation(name: str):
     """A ``jax.profiler.TraceAnnotation`` for ``name`` when jax is
     importable (it always is in this framework; the guard keeps the
@@ -60,6 +68,60 @@ def _trace_annotation(name: str):
         return jax.profiler.TraceAnnotation(name)
     except Exception:  # noqa: BLE001 — tracer must never break the host loop
         return None
+
+
+class _NullSpan:
+    """The span handed out when telemetry is off — a shared, stateless
+    no-op context manager."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One open host span (class-based: the generator-frame cost of
+    ``@contextmanager`` is measurable at decode-step cadence)."""
+
+    __slots__ = ("_tracer", "_name", "_cat", "_args", "_ann", "_t0",
+                 "_depth")
+
+    def __init__(self, tracer, name, cat, args) -> None:
+        self._tracer = tracer
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def __enter__(self):
+        stack = self._tracer._stack()
+        self._depth = len(stack)
+        stack.append(self._name)
+        self._ann = (_trace_annotation(self._name)
+                     if _DEVICE_TRACE_OPEN else None)
+        if self._ann is not None:
+            self._ann.__enter__()
+        self._t0 = now_us()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = now_us()
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+        self._tracer._stack().pop()
+        self._tracer._append({
+            "ph": "X", "name": self._name, "cat": self._cat,
+            "pid": self._tracer._pid,
+            "tid": threading.get_native_id(),
+            "ts": self._t0, "dur": t1 - self._t0,
+            "args": {**self._args, "depth": self._depth}})
+        return False
 
 
 class SpanTracer:
@@ -100,34 +162,19 @@ class SpanTracer:
             self._events.clear()
 
     # ------------------------------------------------------------------
-    @contextmanager
     def span(self, name: str, cat: str = "host", **args):
         """Record a span around the with-body.  Nesting is tracked per
-        thread (the ``depth`` arg on the event); inside the span a
-        ``jax.profiler.TraceAnnotation`` is open so a concurrently
-        captured device trace carries the same span on its host lane."""
+        thread (the ``depth`` arg on the event); while a
+        :func:`profile_window` device trace is open a
+        ``jax.profiler.TraceAnnotation`` rides the span so the
+        captured device trace carries it on its host lane.  This is
+        the decode loop's per-step hot path: a class-based context
+        manager (no generator frame) and the annotation gated on an
+        open device trace keep the always-on cost to two clock reads
+        and one ring append."""
         if not _metrics.enabled():
-            yield
-            return
-        stack = self._stack()
-        depth = len(stack)
-        stack.append(name)
-        ann = _trace_annotation(name)
-        if ann is not None:
-            ann.__enter__()
-        t0 = now_us()
-        try:
-            yield
-        finally:
-            t1 = now_us()
-            if ann is not None:
-                ann.__exit__(None, None, None)
-            stack.pop()
-            self._append({
-                "ph": "X", "name": name, "cat": cat,
-                "pid": self._pid, "tid": threading.get_native_id(),
-                "ts": t0, "dur": t1 - t0,
-                "args": {**args, "depth": depth}})
+            return _NULL_SPAN
+        return _Span(self, name, cat, args)
 
     def complete(self, name: str, t0_us: float, t1_us: float,
                  cat: str = "host", **args) -> None:
@@ -173,6 +220,141 @@ class SpanTracer:
 TRACER = SpanTracer()
 
 
+# ----------------------------------------------------------------------
+# round 24: request-scoped trace context
+# ----------------------------------------------------------------------
+#: process-unique trace-id sequence (pid-prefixed so merged traces
+#: from a gang of processes never collide)
+_TRACE_SEQ = itertools.count(1)
+
+
+class RequestTrace:
+    """Trace context minted at ``submit()`` and riding the REQUEST
+    object (not a thread-local) through every hop it takes — batcher
+    queue, prefill dispatch, the disagg handoff payload, the decode
+    token loop — because a request crosses threads and pools while a
+    single logical trace must survive all of them.
+
+    Phases are begun/ended from whatever thread owns the request at
+    that moment; each closed phase lands in the process tracer as a
+    ``cat="request"`` complete span parented under the request's root
+    span (``trace_id``/``span_id``/``parent_span_id`` in ``args``), so
+    ``/trace.json`` renders one request's life as a span tree and
+    ``trace_top.py --requests`` can aggregate per-phase percentiles.
+    :meth:`phase_end` returns the phase duration in seconds so the
+    engine can feed its windowed-p99 gauges from the same clock.
+    """
+
+    __slots__ = ("trace_id", "name", "args", "t0_us", "_phase_t0",
+                 "_span_seq", "phases", "events", "_finished")
+
+    def __init__(self, name: str = "request", **args) -> None:
+        self.trace_id = f"{os.getpid():x}-{next(_TRACE_SEQ):06x}"
+        self.name = name
+        self.args = dict(args)
+        self.t0_us = now_us()
+        self._phase_t0: dict[str, float] = {}
+        #: root span is 1; child spans/events count up from 2
+        self._span_seq = itertools.count(2)
+        self.phases: dict[str, float] = {}
+        self.events: list[str] = []
+        self._finished = False
+
+    def phase_begin(self, phase: str) -> None:
+        """Open ``phase`` (idempotent: a retry re-entering the same
+        phase keeps the FIRST begin, so retried work is charged to the
+        phase that absorbed it)."""
+        self._phase_t0.setdefault(phase, now_us())
+
+    def phase_end(self, phase: str, **args) -> float:
+        """Close ``phase`` and record it as a child span; returns the
+        phase duration in seconds (0.0 when the phase never began)."""
+        t0 = self._phase_t0.pop(phase, None)
+        if t0 is None:
+            return 0.0
+        t1 = now_us()
+        dur_s = (t1 - t0) / 1e6
+        self.phases[phase] = self.phases.get(phase, 0.0) + dur_s
+        TRACER.complete(f"req.{phase}", t0, t1, cat="request",
+                        trace_id=self.trace_id,
+                        span_id=next(self._span_seq),
+                        parent_span_id=1, phase=phase, **args)
+        return dur_s
+
+    def event(self, name: str, **args) -> None:
+        """An instant under the request's root span (breaker shed,
+        deadline eviction, handoff drop, swap pause, routing choice)."""
+        self.events.append(name)
+        TRACER.instant(f"req.{name}", cat="request",
+                       trace_id=self.trace_id,
+                       span_id=next(self._span_seq),
+                       parent_span_id=1, **args)
+
+    def finish(self, outcome: str = "ok", **args) -> None:
+        """Close the root span (idempotent — the first outcome
+        wins)."""
+        if self._finished:
+            return
+        self._finished = True
+        for phase in list(self._phase_t0):  # close any dangling phase
+            self.phase_end(phase)
+        TRACER.complete(self.name, self.t0_us, now_us(), cat="request",
+                        trace_id=self.trace_id, span_id=1,
+                        parent_span_id=0, outcome=outcome,
+                        **{**self.args, **args})
+
+
+class _NullTrace:
+    """The no-op trace every call site holds when telemetry is off —
+    keeps the instrumentation unconditional at one attribute call."""
+
+    __slots__ = ()
+    trace_id = "-"
+    phases: dict = {}
+    events: list = []
+
+    def phase_begin(self, phase: str) -> None:
+        pass
+
+    def phase_end(self, phase: str, **args) -> float:
+        return 0.0
+
+    def event(self, name: str, **args) -> None:
+        pass
+
+    def finish(self, outcome: str = "ok", **args) -> None:
+        pass
+
+
+NULL_TRACE = _NullTrace()
+
+
+def new_request_trace(name: str = "request", **args):
+    """Mint a request trace (:class:`NULL_TRACE` when telemetry is
+    off, so call sites never branch)."""
+    if not _metrics.enabled():
+        return NULL_TRACE
+    return RequestTrace(name, **args)
+
+
+#: fleet→engine adoption channel: FleetEngine mints the trace (so the
+#: routing decision is on it), parks it here, and the engine's
+#: synchronous same-thread submit() adopts it instead of minting a new
+#: one — no API change on every submit signature in between
+_PENDING = threading.local()
+
+
+def set_pending_trace(trace) -> None:
+    _PENDING.trace = trace
+
+
+def adopt_pending_trace():
+    """Pop the thread's parked trace (None when nothing was parked)."""
+    trace = getattr(_PENDING, "trace", None)
+    _PENDING.trace = None
+    return trace
+
+
 @contextmanager
 def profile_window(outdir: str, n_steps: int | None = None,
                    device: bool = True, tracer: SpanTracer | None = None):
@@ -197,11 +379,13 @@ def profile_window(outdir: str, n_steps: int | None = None,
         tracer = TRACER
     os.makedirs(outdir, exist_ok=True)
     started = False
+    global _DEVICE_TRACE_OPEN
     if device:
         try:
             import jax
             jax.profiler.start_trace(outdir)
             started = True
+            _DEVICE_TRACE_OPEN = True
         except Exception as exc:  # noqa: BLE001 — an open trace must not kill the run
             import logging
             logging.getLogger("znicz_tpu.observe").warning(
@@ -215,6 +399,7 @@ def profile_window(outdir: str, n_steps: int | None = None,
     finally:
         if started:
             import jax
+            _DEVICE_TRACE_OPEN = False
             try:
                 jax.profiler.stop_trace()
             except Exception:  # noqa: BLE001 — already stopped elsewhere

@@ -182,6 +182,12 @@ SITES = {
         "count recoveries{aotcache_fallback}, and fall back to "
         "tracing with outputs bitwise-equal to the traced arm; a "
         "wrong program must never load",
+    "observe.recorder_stall":
+        "a flight-recorder journal write stalls/fails as if the disk "
+        "filled or the device tore — the recorder must DROP the event "
+        "(counting znicz_flightrecord_dropped_total) and return "
+        "immediately: no dispatch, swap or restart may ever block on "
+        "or fail from ops journaling",
 }
 
 #: spec keys that steer firing rather than ride the payload

@@ -416,6 +416,11 @@ class IntegritySentinel(Logger):
         pidx = process_info()[0]
         self.quarantined = True
         sup = getattr(wf, "_worker_supervisor", None)
+        from znicz_tpu.observe import recorder as _recorder
+        _recorder.record("sdc_quarantine", detector=detector,
+                         culprits=",".join(str(c) for c in culprits),
+                         process=pidx,
+                         last_good=self.last_good_snapshot)
         self.warning("SDC quarantine (%s): culprits=%s, self=%d, "
                      "last_good=%s", detector, culprits, pidx,
                      self.last_good_snapshot)

@@ -60,6 +60,7 @@ import time
 from typing import Callable, Sequence
 
 from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.observe import recorder as _recorder
 from znicz_tpu.resilience import faults as _faults
 from znicz_tpu.utils.config import root
 from znicz_tpu.utils.logger import Logger
@@ -826,11 +827,23 @@ class ElasticSupervisor(Logger):
                 stall_timeout_s=self.stall_timeout_s,
                 start_grace_s=self.start_grace_s)
             self.monitor.register_gauges()
+            fed = None
+            if _metrics.enabled():
+                # the supervisor IS the gang's metrics folder: every
+                # poll folds the heartbeat channel into znicz_fed_*
+                # children (per-member step + staleness), so one
+                # scrape of this process answers "which worker is
+                # behind" (round 24)
+                from znicz_tpu.observe.federation import Federator
+                fed = Federator("elastic")
+                fed.add_heartbeats(hb_dir, n)
             procs = self._spawn(attempt, n, hb_dir, resume)
             dead: dict[int, str] = {}
             try:
                 while True:
                     time.sleep(self.poll_interval_s)
+                    if fed is not None:
+                        fed.scrape()
                     rcs = [proc.poll() for proc in procs]
                     if all(rc == 0 for rc in rcs):
                         self.summary.update({
@@ -911,6 +924,9 @@ class ElasticSupervisor(Logger):
                         time.sleep(self.poll_interval_s)
             finally:
                 self._fold_heartbeats(hb_dir, n)
+                if fed is not None:
+                    fed.scrape()  # final fold before the dir goes cold
+                    fed.close()
                 self._kill(procs)
                 self._close_logs(procs)
             # Only ROOT-CAUSE hosts are gone; everyone else rejoins:
@@ -949,14 +965,20 @@ class ElasticSupervisor(Logger):
                 kind = dead.get(i, "loss")
                 losses[kind] = losses.get(kind, 0) + 1
                 _metrics.host_losses(kind).inc()
+                _recorder.record("host_loss", process=i, cause=kind,
+                                 attempt=attempt)
             for i in sorted(preempted):
                 losses["preempt"] = losses.get("preempt", 0) + 1
                 _metrics.host_losses("preempt").inc()
+                _recorder.record("host_loss", process=i,
+                                 cause="preempt", attempt=attempt)
             for i in sorted(sdc_hosts):
                 losses["sdc"] = losses.get("sdc", 0) + 1
                 self.blocklist.add(i)
                 _metrics.host_losses("sdc").inc()
                 _metrics.sdc_quarantined("host").inc()
+                _recorder.record("sdc_quarantine", process=i,
+                                 scope="host", attempt=attempt)
             if self.blocklist:
                 self.summary["blocklisted"] = sorted(self.blocklist)
             survivors = n - n_lost
@@ -978,6 +1000,11 @@ class ElasticSupervisor(Logger):
             attempt += 1
             n = survivors
             _metrics.elastic_restarts().inc()
+            _recorder.record("elastic_restart", attempt=attempt,
+                             processes=n,
+                             losses=",".join(
+                                 f"{k}:{v}" for k, v in
+                                 sorted(losses.items())))
             self.warning("restarting on the surviving mesh: %d → %d "
                          "process(es) (losses=%s)", n + n_lost, n,
                          losses)

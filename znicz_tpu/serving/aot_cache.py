@@ -376,6 +376,9 @@ class AotCache(Logger):
                 pass
         _metrics.aot_cache_events(site, "corrupt").inc()
         _metrics.recoveries("aotcache_fallback").inc()
+        from znicz_tpu.observe import recorder as _recorder
+        _recorder.record("aotcache_quarantine", key=key[:12],
+                         site=site, reason=reason)
         with self._lock:
             self.corrupt += 1
         self._set_bytes_gauge()

@@ -72,6 +72,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.observe import recorder as _recorder
 from znicz_tpu.observe import tracing as _tracing
 from znicz_tpu.utils.logger import Logger
 
@@ -333,7 +334,7 @@ class Request:
     """One submitted batch of rows riding the queue."""
 
     __slots__ = ("x", "n", "future", "t_submit", "deadline", "attempts",
-                 "tenant", "priority", "retry_budget")
+                 "tenant", "priority", "retry_budget", "trace")
 
     def __init__(self, x: np.ndarray,
                  deadline_ms: float | None = None,
@@ -351,6 +352,13 @@ class Request:
         #: per-request override of the batcher's retry budget (the
         #: fleet sets this from the tenant's SLO class)
         self.retry_budget = retry_budget
+        #: request-scoped trace (round 24): minted at submit (or
+        #: adopted from the fleet router), rides the request through
+        #: queue wait → coalesced dispatch
+        self.trace = (_tracing.adopt_pending_trace()
+                      or _tracing.new_request_trace(
+                          "request", rows=self.n, tenant=tenant or "-"))
+        self.trace.phase_begin("queue")
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None and now >= self.deadline
@@ -472,6 +480,8 @@ class ContinuousBatcher(Logger):
         if state == self._state:
             return
         self.warning("circuit breaker %s → %s", self._state, state)
+        _recorder.record("breaker", engine=self._obs_id or "batcher",
+                         src=self._state, to=state)
         self._state = state
         if state == _OPEN:
             self._opened_at = time.monotonic()
@@ -562,12 +572,16 @@ class ContinuousBatcher(Logger):
                 if self._obs_id:
                     _metrics.serving_requests(self._obs_id,
                                               "shed").inc()
+                req.trace.event("breaker_shed",
+                                engine=self._obs_id or "batcher")
+                self._finish_trace(req, "shed")
                 raise Overloaded(
                     "circuit breaker open — load shed (retry after "
                     f"{self.breaker_cooldown * 1e3:.0f}ms)")
             if tenant_max_rows is not None and tenant is not None \
                     and self.tenant_rows(tenant) + req.n \
                     > int(tenant_max_rows):
+                self._finish_trace(req, "shed")
                 raise QueueFull(
                     f"tenant '{tenant}' queue bound reached "
                     f"({self.tenant_rows(tenant)} rows pending, "
@@ -588,6 +602,7 @@ class ContinuousBatcher(Logger):
                             _metrics.serving_requests(
                                 self._obs_id, "shed").inc()
                 else:
+                    self._finish_trace(req, "shed")
                     raise QueueFull(
                         f"serving queue full ({self._rows} rows "
                         f"pending, limit {self.max_queue})")
@@ -598,11 +613,19 @@ class ContinuousBatcher(Logger):
         # fleet's per-tenant outcome accounting) must never run under
         # the batcher condition
         for ev in preempted:
+            ev.trace.event("preempted",
+                           engine=self._obs_id or "batcher")
+            self._finish_trace(ev, "shed")
             if not ev.future.done():
                 ev.future.set_exception(Overloaded(
                     "preempted by higher-priority traffic while the "
                     "queue was full"))
         return req.future
+
+    def _finish_trace(self, req: Request, outcome: str) -> None:
+        if self._obs_id:
+            _metrics.trace_requests(self._obs_id, outcome).inc()
+        req.trace.finish(outcome)
 
     def flush(self) -> None:
         """Dispatch whatever is pending without waiting out the
@@ -632,6 +655,9 @@ class ContinuousBatcher(Logger):
             if self._obs_id:
                 _metrics.serving_requests(self._obs_id,
                                           "expired").inc()
+            req.trace.event("deadline_evicted",
+                            engine=self._obs_id or "batcher")
+            self._finish_trace(req, "expired")
             req.future.set_exception(DeadlineExceeded(
                 f"deadline passed after "
                 f"{(now - req.t_submit) * 1e3:.0f}ms in queue"))
@@ -689,6 +715,9 @@ class ContinuousBatcher(Logger):
                     rows += req.n
                     batch.append(req)
                     self._account_remove(req)
+                    req.trace.phase_end("queue",
+                                        engine=self._obs_id or "batcher")
+                    req.trace.phase_begin("decode")
                 self._flush_now = False
                 self._cond.notify_all()
             if not batch:  # everything expired / spurious wakeup
@@ -703,6 +732,10 @@ class ContinuousBatcher(Logger):
                 self._dispatch_failed(batch, exc)
             else:
                 self._record_outcome(True)
+                for req in batch:
+                    req.trace.phase_end("decode",
+                                        engine=self._obs_id or "batcher")
+                    self._finish_trace(req, "ok")
                 retried = sum(1 for r in batch if r.attempts)
                 if retried:
                     _metrics.recoveries("serving_retry").inc(retried)
@@ -731,11 +764,16 @@ class ContinuousBatcher(Logger):
                 self._pending.requeue_front(retry)
                 for req in retry:
                     self._account_add(req)
+                    req.trace.event("dispatch_retry",
+                                    engine=self._obs_id or "batcher",
+                                    attempt=req.attempts)
+                    req.trace.phase_begin("queue")
                 self._cond.notify_all()
         failed = [r for r in batch if r not in retry]
         if failed:
             self.warning("batch of %d requests failed: %s",
                          len(failed), exc)
         for req in failed:
+            self._finish_trace(req, "failed")
             if not req.future.done():
                 req.future.set_exception(exc)

@@ -112,6 +112,7 @@ from concurrent.futures import Future
 import numpy as np
 
 from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.observe import recorder as _recorder
 from znicz_tpu.observe import tracing as _tracing
 from znicz_tpu.resilience import faults as _faults
 from znicz_tpu.serving.batcher import (_CLOSED, _HALF_OPEN, _OPEN,
@@ -1785,7 +1786,8 @@ class _PromptReq:
     admit (round-13 documented noise band, fixed in round 15)."""
 
     __slots__ = ("tokens", "n", "max_new", "future", "t_submit",
-                 "deadline", "pause_s", "charged", "tenant", "priority")
+                 "deadline", "pause_s", "charged", "tenant", "priority",
+                 "trace")
 
     def __init__(self, tokens: np.ndarray, max_new: int,
                  deadline_ms: float | None,
@@ -1801,6 +1803,15 @@ class _PromptReq:
         self.priority = int(priority)
         self.deadline = (None if deadline_ms is None
                          else self.t_submit + float(deadline_ms) / 1e3)
+        # request-scoped trace context (round 24): minted HERE at
+        # submit (or adopted from the fleet router, which stamped its
+        # routing decision on it first) and riding the request object
+        # through queue → prefill → [handoff →] decode
+        self.trace = (_tracing.adopt_pending_trace()
+                      or _tracing.new_request_trace(
+                          "request", tokens=self.n,
+                          tenant=tenant or "-"))
+        self.trace.phase_begin("queue")
 
     def expired(self, now: float) -> bool:
         return self.deadline is not None \
@@ -2167,6 +2178,20 @@ class DecodeEngine(_PageSetupMixin, Logger):
         # exact-value windows for dashboard percentiles
         self._ttft_win: deque = deque(maxlen=4096)
         self._token_win: deque = deque(maxlen=4096)
+        # round 24: per-phase latency windows fed by the request
+        # traces, exported as znicz_phase_p99_seconds callback gauges
+        # so SERVE_BENCH rows and /metrics read the SAME exact
+        # windowed p99 (handoff only moves on the disagg subclass)
+        self._phase_win: dict[str, deque] = {
+            p: deque(maxlen=4096)
+            for p in ("queue", "prefill", "handoff", "decode")}
+        for _p, _win in self._phase_win.items():
+            _metrics.phase_p99_seconds(self._obs_id, _p).set_function(
+                lambda w=_win: _metrics.window_p99(w))
+        _metrics.phase_p99_seconds(self._obs_id, "ttft").set_function(
+            lambda w=self._ttft_win: _metrics.window_p99(w))
+        _metrics.phase_p99_seconds(self._obs_id, "token").set_function(
+            lambda w=self._token_win: _metrics.window_p99(w))
         #: queued prompts in priority classes (round 16): the fleet's
         #: high-priority tenants reach a KV slot before any flooded
         #: low class, FIFO within a class
@@ -2296,12 +2321,15 @@ class DecodeEngine(_PageSetupMixin, Logger):
                 self.shed_total += 1
                 _metrics.serving_requests(self._obs_id, "shed").inc()
                 self._m_rejected.inc()
+                req.trace.event("breaker_shed", engine=self._obs_id)
+                self._finish_trace(req, "shed")
                 raise Overloaded(
                     "circuit breaker open — new prompts shed while "
                     "in-flight decodes drain (retry after "
                     f"{self.breaker_cooldown * 1e3:.0f}ms)")
             if len(self._pending) >= self.max_queue:
                 self._m_rejected.inc()
+                self._finish_trace(req, "shed")
                 raise QueueFull(
                     f"decode queue full ({len(self._pending)} prompts "
                     f"pending, limit {self.max_queue})")
@@ -2319,6 +2347,7 @@ class DecodeEngine(_PageSetupMixin, Logger):
                     preempted = self._make_budget_room(req, want)
                     if not self._token_budget.try_acquire(want):
                         self._m_rejected.inc()
+                        self._finish_trace(req, "shed")
                         raise QueueFull(
                             f"decode token budget full "
                             f"({self._token_budget.used} of "
@@ -2328,6 +2357,8 @@ class DecodeEngine(_PageSetupMixin, Logger):
             self._pending.append(req)
             self._cond.notify_all()
         for victim in preempted:  # fail outside the condition
+            victim.trace.event("preempted", engine=self._obs_id)
+            self._finish_trace(victim, "shed")
             if not victim.future.done():
                 victim.future.set_exception(Overloaded(
                     "preempted by higher-priority traffic while the "
@@ -2447,6 +2478,8 @@ class DecodeEngine(_PageSetupMixin, Logger):
     def record_swap_outcome(self, outcome: str) -> None:
         self.swap_counts[outcome] = self.swap_counts.get(outcome, 0) + 1
         _metrics.swaps_total(self._obs_id, outcome).inc()
+        _recorder.record("swap", engine=self._obs_id, outcome=outcome,
+                         version=self.model_version)
 
     def set_model_version(self, version: int) -> None:
         """Label the CURRENTLY loaded bundle's published version."""
@@ -2499,8 +2532,12 @@ class DecodeEngine(_PageSetupMixin, Logger):
             pause_end = time.monotonic()
             self._m_swap_pause.inc(max(0.0, pause_end - req["t0"]))
             for r in self._pending:
-                r.pause_s += max(0.0, pause_end
-                                 - max(r.t_submit, req["t0"]))
+                paused = max(0.0, pause_end
+                             - max(r.t_submit, req["t0"]))
+                r.pause_s += paused
+                if paused > 0.0:
+                    r.trace.event("swap_pause", engine=self._obs_id,
+                                  pause_ms=round(1e3 * paused, 3))
             self._swap_req = None
             self._cond.notify_all()
 
@@ -2511,6 +2548,8 @@ class DecodeEngine(_PageSetupMixin, Logger):
         if state == self._state:
             return
         self.warning("decode breaker %s → %s", self._state, state)
+        _recorder.record("breaker", engine=self._obs_id,
+                         src=self._state, to=state)
         self._state = state
         if state == _OPEN:
             self._opened_at = time.monotonic()
@@ -2568,6 +2607,8 @@ class DecodeEngine(_PageSetupMixin, Logger):
             _metrics.serving_requests(self._obs_id,
                                       "expired").inc()
             self._refund(req)
+            req.trace.event("deadline_evicted", engine=self._obs_id)
+            self._finish_trace(req, "expired")
             req.future.set_exception(DeadlineExceeded(
                 f"TTFT deadline passed after "
                 f"{(now - req.t_submit - req.pause_s) * 1e3:.0f}ms "
@@ -2606,6 +2647,21 @@ class DecodeEngine(_PageSetupMixin, Logger):
                 _metrics.recoveries("serving_retry").inc()
             return out
 
+    # -- request-trace plumbing (round 24) ------------------------------
+    def _end_phase(self, req: _PromptReq, phase: str, **args) -> float:
+        """Close one trace phase and feed the engine's windowed-p99
+        gauge for it from the SAME measurement."""
+        dur = req.trace.phase_end(phase, engine=self._obs_id, **args)
+        if dur > 0.0:
+            win = self._phase_win.get(phase)
+            if win is not None:
+                win.append(dur)
+        return dur
+
+    def _finish_trace(self, req: _PromptReq, outcome: str) -> None:
+        _metrics.trace_requests(self._obs_id, outcome).inc()
+        req.trace.finish(outcome)
+
     def _release_lane(self, live: _Live) -> None:
         if self.model.paged:
             self.model.cache.release_slot_pages(live.slot)
@@ -2615,12 +2671,16 @@ class DecodeEngine(_PageSetupMixin, Logger):
     def _finish(self, live: _Live) -> None:
         self._release_lane(live)
         self._m_served.inc()
+        self._end_phase(live.req, "decode",
+                        tokens=len(live.generated))
+        self._finish_trace(live.req, "ok")
         if not live.req.future.done():
             live.req.future.set_result(
                 np.asarray(live.generated, np.int32))
 
     def _fail_lane(self, live: _Live, exc: Exception) -> None:
         self._release_lane(live)
+        self._finish_trace(live.req, "failed")
         if not live.req.future.done():
             live.req.future.set_exception(exc)
 
@@ -2631,6 +2691,7 @@ class DecodeEngine(_PageSetupMixin, Logger):
         self.model.cache.release(slot)
         self._refund(req)
         self.warning("prefill failed: %s", exc)
+        self._finish_trace(req, "failed")
         if not req.future.done():
             req.future.set_exception(exc)
 
@@ -2644,6 +2705,8 @@ class DecodeEngine(_PageSetupMixin, Logger):
                                self.model.cache.tables[slot],
                                self.model.cache)
         token = self._sample(logits)
+        self._end_phase(req, "prefill", tokens=req.n)
+        req.trace.phase_begin("decode")
         ttft = time.monotonic() - req.t_submit - req.pause_s
         # stamp TTFT onto the future: the fleet's per-tenant latency
         # observes generation requests at TTFT (the admission-bound
@@ -2665,6 +2728,8 @@ class DecodeEngine(_PageSetupMixin, Logger):
                          matched: int) -> None:
         """Single-prompt prefill dispatch for a slot whose pages are
         already set up (``matched`` tokens ride shared pages)."""
+        self._end_phase(req, "queue")
+        req.trace.phase_begin("prefill")
         try:
             with _tracing.TRACER.span("prefill", cat="serving",
                                       tokens=req.n, shared=matched):
@@ -2699,6 +2764,9 @@ class DecodeEngine(_PageSetupMixin, Logger):
             slots[i] = slot
             starts[i] = matched
             lengths[i] = len(tail)
+        for req, _slot, _m in group:
+            self._end_phase(req, "queue")
+            req.trace.phase_begin("prefill")
         try:
             with _tracing.TRACER.span("prefill_window", cat="serving",
                                       lanes=n, w=w_len):

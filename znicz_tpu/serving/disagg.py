@@ -182,6 +182,9 @@ class _PrefillWorker(_PageSetupMixin, Logger):
                 # prompt never costs a prefill
                 parent._refund(req)
                 parent._m_rejected.inc()
+                req.trace.event("deadline_evicted",
+                                engine=parent._obs_id)
+                parent._finish_trace(req, "expired")
                 waited_ms = (now - req.t_submit - req.pause_s) * 1e3
                 if not req.future.done():
                     req.future.set_exception(DeadlineExceeded(
@@ -194,6 +197,8 @@ class _PrefillWorker(_PageSetupMixin, Logger):
         parent = self.parent
         model = self.model
         cache = self.cache
+        parent._end_phase(req, "queue")
+        req.trace.phase_begin("prefill")
         slot = cache.acquire()
         try:
             # prompt blocks only (max_new=0): the decode worker owns
@@ -207,6 +212,7 @@ class _PrefillWorker(_PageSetupMixin, Logger):
             cache.release(slot)
             parent._refund(req)
             parent._m_rejected.inc()
+            parent._finish_trace(req, "failed")
             self.warning("prefill failed: %s", exc)
             if not req.future.done():
                 req.future.set_exception(exc)
@@ -214,6 +220,8 @@ class _PrefillWorker(_PageSetupMixin, Logger):
         if self.prefix is not None:
             self.prefix.insert(req.tokens, cache.tables[slot], cache)
         token = parent._sample(logits, self._rng())
+        parent._end_phase(req, "prefill", tokens=req.n,
+                          worker=self.wid)
         ttft = time.monotonic() - req.t_submit - req.pause_s
         req.future.ttft_s = ttft
         parent._m_ttft.observe(ttft)
@@ -227,12 +235,18 @@ class _PrefillWorker(_PageSetupMixin, Logger):
             cache.release(slot)
             parent._refund(req)
             parent._m_served.inc()
+            parent._finish_trace(req, "ok")
             if not req.future.done():
                 req.future.set_result(np.asarray([token], np.int32))
             return
         # export the prompt's K/V to host arrays — the handoff
         # payload — then drop this cache's references (trie pins
         # keep shareable blocks resident for the NEXT prompt)
+        # (the handoff phase opens HERE and, idempotently, survives a
+        # dropped-handoff retry: the retried prefill re-begins its own
+        # phase but the handoff span keeps the FIRST begin, so the
+        # whole retry loop is charged to the hop that lost the payload)
+        req.trace.phase_begin("handoff")
         nblocks = -(-req.n // model.page_tokens)
         pages = [model.export_page(int(cache.tables[slot, b]),
                                    cache=cache)
@@ -334,6 +348,7 @@ class _DecodeWorker(Logger):
             # an empty cache cannot hold it — ever
             parent._refund(req)
             parent._m_rejected.inc()
+            parent._finish_trace(req, "failed")
             if not req.future.done():
                 req.future.set_exception(PoolExhausted(
                     f"handoff of {req.n} prompt tokens cannot fit "
@@ -347,6 +362,10 @@ class _DecodeWorker(Logger):
             rows = parent._carry_stager.upload(h.carries)
             model.carry_in(rows, slot, cache=cache)
         parent._m_mig_handoff.inc(h.n_pages)
+        parent._end_phase(req, "handoff", pages=h.n_pages,
+                          worker=self.wid,
+                          retries=req.handoff_retries)
+        req.trace.phase_begin("decode")
         self._live.append(_Live(req, slot, h.first_token))
 
     def _finish(self, s: _Live) -> None:
@@ -356,6 +375,9 @@ class _DecodeWorker(Logger):
         parent._refund(s.req)
         parent._m_served.inc()
         self.served += 1
+        parent._end_phase(s.req, "decode",
+                          tokens=len(s.generated))
+        parent._finish_trace(s.req, "ok")
         if not s.req.future.done():
             s.req.future.set_result(
                 np.asarray(s.generated, np.int32))
@@ -364,6 +386,7 @@ class _DecodeWorker(Logger):
         self.cache.release_slot_pages(s.slot)
         self.cache.release(s.slot)
         self.parent._refund(s.req)
+        self.parent._finish_trace(s.req, "failed")
         if not s.req.future.done():
             s.req.future.set_exception(exc)
 
@@ -518,6 +541,21 @@ class DisaggEngine(Logger):
                 self._decode_queue_age)
         self._ttft_win: deque = deque(maxlen=4096)
         self._token_win: deque = deque(maxlen=4096)
+        # per-phase latency windows behind the canonical
+        # znicz_phase_p99_seconds gauges: the request trace's
+        # phase_end() is the ONE measurement both the span tree and
+        # these gauges report (round 24)
+        self._phase_win: dict[str, deque] = {
+            p: deque(maxlen=4096)
+            for p in ("queue", "prefill", "handoff", "decode")}
+        for _p, _win in self._phase_win.items():
+            _metrics.phase_p99_seconds(self._obs_id, _p).set_function(
+                lambda w=_win: _metrics.window_p99(w))
+        _metrics.phase_p99_seconds(self._obs_id, "ttft").set_function(
+            lambda w=self._ttft_win: _metrics.window_p99(w))
+        _metrics.phase_p99_seconds(self._obs_id, "token").set_function(
+            lambda w=self._token_win: _metrics.window_p99(w))
+        self._federator = None
         self._prefill_q: deque = deque()
         self._cond = threading.Condition()
         self._rng_lock = threading.Lock()
@@ -570,14 +608,24 @@ class DisaggEngine(Logger):
         if self.model.has_lstm:
             self._carry_stager = PageStager(self.model.carry_shapes())
         self._started = True
+        if _metrics.enabled() and self._federator is None:
+            # the disagg maintenance thread doubles as the gang's
+            # metrics folder: one in-process source re-labels this
+            # engine's series under {process, pool} fed children
+            from znicz_tpu.observe.federation import Federator
+            self._federator = Federator(self._obs_id)
+            self._federator.add_registry(
+                "self",
+                pool_of=lambda eng: ("" if eng == self._obs_id
+                                     else None))
         self.prefill_pool.scale_to(self.prefill_pool.target,
                                    reason="start")
         self.decode_pool.scale_to(self.decode_pool.target,
                                   reason="start")
-        if self._autoscaler is not None:
+        if self._autoscaler is not None or self._federator is not None:
             self._maint_stop.clear()
             self._maint = threading.Thread(
-                target=self._maintenance, name="disagg-autoscale",
+                target=self._maintenance, name="disagg-maint",
                 daemon=True)
             self._maint.start()
         self.info(
@@ -610,6 +658,9 @@ class DisaggEngine(Logger):
         if self._carry_stager is not None:
             self._carry_stager.shutdown()
             self._carry_stager = None
+        if self._federator is not None:
+            self._federator.close()
+            self._federator = None
         self._started = False
 
     def __enter__(self) -> "DisaggEngine":
@@ -620,7 +671,10 @@ class DisaggEngine(Logger):
 
     def _maintenance(self) -> None:
         while not self._maint_stop.wait(0.05):
-            self._autoscaler.tick()
+            if self._autoscaler is not None:
+                self._autoscaler.tick()
+            if self._federator is not None:
+                self._federator.scrape()
 
     # ------------------------------------------------------------------
     # request path
@@ -648,12 +702,14 @@ class DisaggEngine(Logger):
         with self._cond:
             if len(self._prefill_q) >= self.max_queue:
                 self._m_rejected.inc()
+                self._finish_trace(req, "shed")
                 raise QueueFull(
                     f"prefill queue full ({len(self._prefill_q)} "
                     f"prompts pending, limit {self.max_queue})")
             want = req.n + req.max_new
             if not self._token_budget.try_acquire(want):
                 self._m_rejected.inc()
+                self._finish_trace(req, "shed")
                 raise QueueFull(
                     f"token budget full ({self._token_budget.used} "
                     f"of {self._token_budget.capacity} tokens held; "
@@ -672,6 +728,21 @@ class DisaggEngine(Logger):
         if req.charged:
             self._token_budget.release(req.charged)
             req.charged = 0
+
+    def _end_phase(self, req: _PromptReq, phase: str,
+                   **args) -> float:
+        """Close one trace phase; the SAME measurement feeds the
+        windowed-p99 gauge family (round 24)."""
+        dur = req.trace.phase_end(phase, engine=self._obs_id, **args)
+        if dur > 0.0:
+            win = self._phase_win.get(phase)
+            if win is not None:
+                win.append(dur)
+        return dur
+
+    def _finish_trace(self, req: _PromptReq, outcome: str) -> None:
+        _metrics.trace_requests(self._obs_id, outcome).inc()
+        req.trace.finish(outcome)
 
     def _sample(self, logits: np.ndarray,
                 rng: np.random.Generator) -> int:
@@ -701,9 +772,12 @@ class DisaggEngine(Logger):
             # already released its pages, so recovery = redo the
             # prefill (a prefix HIT now — its trie kept the blocks)
             self.handoff_drops += 1
+            req.trace.event("handoff_drop", engine=self._obs_id,
+                            retries=req.handoff_retries)
             if req.handoff_retries >= self.handoff_retry_budget:
                 self._refund(req)
                 self._m_rejected.inc()
+                self._finish_trace(req, "failed")
                 if not req.future.done():
                     req.future.set_exception(_faults.FaultInjected(
                         f"handoff dropped {req.handoff_retries + 1} "
@@ -727,6 +801,7 @@ class DisaggEngine(Logger):
         if worker is None:
             self._refund(req)
             self._m_rejected.inc()
+            self._finish_trace(req, "failed")
             if not req.future.done():
                 req.future.set_exception(Overloaded(
                     "no live decode replica to accept the handoff"))

@@ -85,28 +85,12 @@ def resolve_swap_state(state) -> tuple:
 #: distinguishes same-named engines in the registry's labels
 _ENGINE_SEQ = itertools.count()
 
-
-def _percentile(sorted_vals: list[float], q: float) -> float:
-    """Nearest-rank percentile of an already-sorted list."""
-    if not sorted_vals:
-        return 0.0
-    idx = min(len(sorted_vals) - 1,
-              max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
-    return sorted_vals[idx]
-
-
-def window_p99(win, n0: int = 0) -> float:
-    """p99 of a latency window's tail, skipping the first ``n0``
-    samples.
-
-    The per-pass slice the serve bench and the disagg dryrun use to
-    compare warmed passes: snapshot ``len(win)`` before a pass, then
-    take the p99 of only the observations that pass appended, so
-    cold-start and earlier-pass samples never pollute the comparison.
-    ``win`` is any iterable of latencies (typically an engine's
-    bounded ``_token_win`` deque)."""
-    tail = sorted(list(win)[n0:])
-    return _percentile(tail, 99.0)
+# round 24: the exact-windowed percentile helpers moved to
+# observe.metrics so bench rows and the znicz_phase_p99_seconds
+# callback gauges share one implementation; re-exported here because
+# the benches and dryruns import them from serving.engine
+_percentile = _metrics._percentile
+window_p99 = _metrics.window_p99
 
 
 class ServingEngine(Logger):
@@ -400,6 +384,9 @@ class ServingEngine(Logger):
         weights)."""
         self.swap_counts[outcome] = self.swap_counts.get(outcome, 0) + 1
         _metrics.swaps_total(self._obs_id, outcome).inc()
+        from znicz_tpu.observe import recorder as _recorder
+        _recorder.record("swap", engine=self._obs_id, outcome=outcome,
+                         version=self.model_version)
 
     def set_model_version(self, version: int) -> None:
         """Label the CURRENTLY loaded bundle's published version (an

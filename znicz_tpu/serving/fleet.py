@@ -79,6 +79,8 @@ from concurrent.futures import Future
 import numpy as np
 
 from znicz_tpu.observe import metrics as _metrics
+from znicz_tpu.observe import recorder as _recorder
+from znicz_tpu.observe import tracing as _tracing
 from znicz_tpu.resilience import faults as _faults
 from znicz_tpu.serving.batcher import (_CLOSED, _HALF_OPEN, _OPEN,
                                        _STATE_CODE, DeadlineExceeded,
@@ -386,6 +388,10 @@ class ReplicaGroup(Logger):
         if delta:
             self.target = n if reason != "repair" else self.target
             self._m_replicas.set(self.live())
+            _recorder.record("scale",
+                             group=f"{self.model_id}@{self.version}",
+                             reason=reason, delta=delta,
+                             live=self.live())
             self.info("replica group %s@%s scaled to %d (%s)",
                       self.model_id, self.version, self.live(), reason)
         return delta
@@ -399,6 +405,9 @@ class ReplicaGroup(Logger):
                 return False
             eng = self._replicas.pop(0)
         self._m_replicas.set(self.live())
+        _recorder.record("replica_loss",
+                         group=f"{self.model_id}@{self.version}",
+                         live=self.live())
         eng.shutdown(timeout=30.0)
         self.warning("replica of %s@%s lost (chaos) — %d live",
                      self.model_id, self.version, self.live())
@@ -423,6 +432,10 @@ class ReplicaGroup(Logger):
                 getattr(eng, "sdc_replica", "?"))
         self._m_replicas.set(self.live())
         _metrics.sdc_quarantined("replica").inc()
+        _recorder.record("sdc_quarantine",
+                         group=f"{self.model_id}@{self.version}",
+                         replica=getattr(eng, "sdc_replica", "?"),
+                         live=self.live())
         self.warning(
             "replica %s of %s@%s QUARANTINED by the SDC shadow audit "
             "— %d live", getattr(eng, "sdc_replica", "?"),
@@ -550,6 +563,7 @@ class FleetEngine(Logger):
         self._replicate = replicate
         self._device = None  # resolved once, shared by one-shot models
         self.autoscaler = (FleetAutoscaler(self) if autoscale else None)
+        self._federator = None
         self._started = False
 
     # ------------------------------------------------------------------
@@ -740,6 +754,14 @@ class FleetEngine(Logger):
         for model in self._models.values():
             for v in model.versions.values():
                 v.group.scale_to(max(1, v.group.target), reason="up")
+        if _metrics.enabled() and self._federator is None:
+            # tick() doubles as the fleet's federation cadence: one
+            # in-process source re-labels every replica engine's
+            # series under its model@version "pool"
+            from znicz_tpu.observe.federation import Federator
+            self._federator = Federator(self._obs_id)
+            self._federator.add_registry("self",
+                                         pool_of=self._fed_pool_of)
         self._started = True
         self.info("fleet '%s': %d models resident, tenants=%s",
                   self._obs_id, len(self._models),
@@ -752,7 +774,20 @@ class FleetEngine(Logger):
                 for eng in v.group.engines():
                     eng.shutdown(timeout=timeout)
                 v.group.scale_to(0, reason="down")
+        if self._federator is not None:
+            self._federator.close()
+            self._federator = None
         self._started = False
+
+    def _fed_pool_of(self, eng_label: str):
+        """Map a replica engine's label to its ``model@version`` fed
+        pool (None: not one of this fleet's replicas)."""
+        for model in self._models.values():
+            for v in model.versions.values():
+                for e in v.group.engines():
+                    if getattr(e, "_obs_id", None) == eng_label:
+                        return f"{model.model_id}@{v.label}"
+        return None
 
     def __enter__(self) -> "FleetEngine":
         return self.start()
@@ -785,17 +820,29 @@ class FleetEngine(Logger):
         if model is None:
             raise KeyError(f"unknown model '{model_id}' "
                            f"(known: {sorted(self._models)})")
+        # the trace is minted HERE — the fleet's routing decision is
+        # the request's first hop — and handed to the engine's submit
+        # via the pending-trace channel (round 24)
+        trace = _tracing.new_request_trace("request", model=model_id,
+                                           tenant=tname)
+
+        def _shed(event: str) -> None:
+            _metrics.trace_requests(self._obs_id, "shed").inc()
+            trace.event(event, fleet=self._obs_id, tenant=tname)
+            trace.finish("shed")
         probe = False
         with self._lock:
             state.breaker_tick(t0)
             if state.state == _OPEN:
                 state.count("shed")
+                _shed("breaker_shed")
                 raise Overloaded(
                     f"tenant '{tname}' breaker open — load shed "
                     f"(retry after {state.cooldown * 1e3:.0f}ms)")
             if state.state == _HALF_OPEN:
                 if state.probe_inflight:
                     state.count("shed")
+                    _shed("breaker_shed")
                     raise Overloaded(
                         f"tenant '{tname}' breaker half-open — probe "
                         f"in flight")
@@ -810,6 +857,7 @@ class FleetEngine(Logger):
                 # it feeds the tenant breaker so a flooding tenant
                 # degrades to instant rejection
                 state.record_outcome(False, probe)
+            _shed("rate_limit_shed")
             raise Overloaded(
                 f"tenant '{tname}' rate limit — token bucket empty "
                 f"(rate={cls.rate}/s, burst={cls.burst})")
@@ -825,8 +873,16 @@ class FleetEngine(Logger):
             with self._lock:
                 state.count("shed")
                 state.record_outcome(False, probe)
+            _shed("no_replica_shed")
             raise Overloaded(
                 f"no live replica for {model_id}@{v.label}")
+        # the A/B choice + replica pick land on the trace, then the
+        # trace parks on this thread for the engine's request
+        # constructor to adopt (same-thread synchronous submit)
+        trace.event("fleet_route", fleet=self._obs_id,
+                    model=model_id, version=v.label,
+                    replica=getattr(engine, "sdc_replica", "?"))
+        _tracing.set_pending_trace(trace)
         try:
             if model.kind == "lm":
                 future = engine.submit(
@@ -839,11 +895,19 @@ class FleetEngine(Logger):
                     priority=cls.priority, retry_budget=retry_budget,
                     tenant_max_rows=cls.max_queue_rows)
         except Exception as exc:  # noqa: BLE001 — probe must not leak
+            # an engine that raised before constructing its request
+            # never adopted the parked trace — clear it so the NEXT
+            # request on this thread cannot inherit it
+            leftover = _tracing.adopt_pending_trace()
+            if leftover is not None:
+                _metrics.trace_requests(self._obs_id, "shed").inc()
+                leftover.finish("shed")
             with self._lock:
                 state.count("shed" if isinstance(
                     exc, (QueueFull, DeadlineExceeded)) else "failed")
                 state.record_outcome(False, probe)
             raise
+        _tracing.adopt_pending_trace()  # engine took it; clear if not
         with self._lock:
             state.count("submitted")
         future.add_done_callback(
@@ -902,6 +966,8 @@ class FleetEngine(Logger):
             self._kill_replica(payload, events)
         if self.autoscaler is not None:
             events.extend(self.autoscaler.tick())
+        if self._federator is not None:
+            self._federator.scrape()
         return events
 
     def _flood_tenant(self) -> str:

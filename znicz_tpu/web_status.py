@@ -26,7 +26,12 @@ training and every resident serving engine at once.  Round 18:
 ``/readyz`` on process 0 additionally folds per-process heartbeat
 ages from ``znicz_heartbeat_age_seconds`` (aggregate pod health —
 a stale peer makes the pod not ready past
-``engine.ready_max_heartbeat_s``, unset = report-only).
+``engine.ready_max_heartbeat_s``, unset = report-only).  Round 24:
+``/flightrecord`` serves the ops flight recorder's journal
+(``?since=<seq>&kind=<k1,k2>`` filters), and ``/readyz`` folds the
+federation view — each :class:`~znicz_tpu.observe.federation.
+Federator` source's scrape staleness, bounded by
+``engine.ready_max_fed_age_s`` (unset = report-only).
 """
 
 from __future__ import annotations
@@ -128,6 +133,27 @@ class WebStatusServer(Logger):
                     from znicz_tpu.observe import tracing
                     body = json.dumps(
                         tracing.TRACER.to_chrome_trace()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/flightrecord"):
+                    # round 24: the ops flight recorder's journal —
+                    # ?since=<seq> and ?kind=<k1,k2> filter; newest
+                    # 256 events by default so the page stays bounded
+                    from urllib.parse import parse_qs, urlparse
+                    from znicz_tpu.observe import recorder
+                    q = parse_qs(urlparse(self.path).query)
+                    since = int(q.get("since", ["0"])[0] or 0)
+                    kinds = None
+                    if q.get("kind"):
+                        kinds = [k for k in
+                                 q["kind"][0].split(",") if k]
+                    rec = recorder.get_recorder()
+                    if rec is None:
+                        payload = {"events": [], "status": None}
+                    else:
+                        events = rec.dump_since(since, kinds=kinds)
+                        payload = {"events": events[-256:],
+                                   "status": rec.status()}
+                    body = json.dumps(payload).encode()
                     ctype = "application/json"
                 elif self.path == "/" or self.path.startswith("/index"):
                     body = status_server.render_html().encode()
@@ -324,6 +350,25 @@ class WebStatusServer(Logger):
                 if max_snap is not None and age > float(max_snap):
                     not_ready(f"no good artifact from {source} for "
                               f"{age:.0f}s")
+        # round 24: the federated view — when this process folds a
+        # gang's children (supervisor/fleet/disagg federators), report
+        # each source's scrape staleness; not-ready only when
+        # engine.ready_max_fed_age_s is set AND a source is staler
+        # (unset = report-only: a paused fold must not 503 a healthy
+        # serving process)
+        try:
+            from znicz_tpu.observe import federation
+            feds = federation.status()
+        except Exception:  # noqa: BLE001 — probe must answer anyway
+            feds = []
+        if feds:
+            out["federation"] = feds
+            max_fed = root.common.engine.get("ready_max_fed_age_s",
+                                             None)
+            if max_fed is not None:
+                worst = federation.max_age_s()
+                if worst > float(max_fed):
+                    not_ready(f"federated scrape {worst:.1f}s stale")
         return out
 
     # ------------------------------------------------------------------
